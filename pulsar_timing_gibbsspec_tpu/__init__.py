@@ -1,0 +1,52 @@
+"""TPU-native blocked Gibbs sampler for pulsar-timing-array free-spectrum analysis.
+
+A ground-up JAX/XLA re-design of the capabilities of
+``astrolamb/pulsar_timing_gibbsspec`` (blocked Gibbs periodogram sampler after
+van Haasteren & Vallisneri 2014, arXiv:1407.1838).  The compute path is
+jit-compiled JAX — conditional-draw kernels composed in ``lax.scan`` sweeps,
+``vmap`` over pulsars/chains, ``shard_map`` over a device mesh for the
+45-pulsar array — while host-side ingestion (par/tim parsing, design matrices,
+priors, chain I/O) stays NumPy/C++.
+
+Layout
+------
+``data/``      host ingestion: par/tim readers, timing design matrix, Fourier
+               GP basis, injection simulator
+``models/``    priors, PSDs, ORFs, signal model + PTA container,
+               ``model_general`` factory (kwarg surface of the reference's
+               ``model_definition.py``)
+``ops/``       JAX device kernels: preconditioned solves, conditional draws,
+               MH scans, autocorrelation
+``sampler/``   Gibbs sampler backends (``numpy`` oracle, ``jax`` device path)
+               and the user-facing facade
+``parallel/``  meshes, collectives (psum common-spectrum reduction),
+               shard_map'd sweeps
+``native/``    C++ host components (acor-style ACT, chain writer)
+``utils/``     profiling, logging, config
+"""
+
+from .config import settings
+
+__version__ = "0.1.0"
+
+
+def __getattr__(name):
+    # lazy so that importing the package never pulls in jax before the
+    # caller has had a chance to set platform/precision env vars
+    if name == "model_general":
+        from .models.factory import model_general
+
+        return model_general
+    if name in ("PulsarBlockGibbs", "PTABlockGibbs"):
+        from .sampler import gibbs
+
+        return getattr(gibbs, name)
+    raise AttributeError(name)
+
+__all__ = [
+    "settings",
+    "model_general",
+    "PulsarBlockGibbs",
+    "PTABlockGibbs",
+    "__version__",
+]

@@ -1,0 +1,49 @@
+"""Global execution configuration.
+
+The reference implementation (``pulsar_gibbs.py``) is float64 NumPy on a
+single CPU.  On TPU, float64 is software-emulated: the batched 45x160x160
+Cholesky at the heart of the sweep measures ~2500x slower in f64 than f32 on
+v5e.  The device path therefore defaults to float32 and makes it safe with
+Jacobi (diagonal) preconditioning of ``Sigma = T^T N^-1 T + diag(phi^-1)``
+(see ``ops/linalg.py``), which reduces the condition number by several orders
+of magnitude.  ``settings.precision = "f64"`` forces double precision for
+validation runs; the NumPy oracle backend is always float64.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+
+@dataclasses.dataclass
+class Settings:
+    """Process-wide knobs (read at model-compile time, not per-op)."""
+
+    #: device compute precision: "f32" (default, preconditioned) or "f64"
+    precision: str = os.environ.get("PTGIBBS_PRECISION", "f32")
+
+    #: sweeps per device dispatch in the jitted sampler (chain is written
+    #: back to host every chunk; also the checkpoint cadence)
+    chunk_size: int = 100
+
+    #: number of grid points for the numerical rho_k conditional CDF
+    #: (reference uses 1000, pulsar_gibbs.py:228)
+    rho_grid_size: int = 1000
+
+    def apply(self):
+        """Push precision into the JAX config.  Called once at model-compile
+        entry (not from dtype accessors — enabling x64 is a process-wide,
+        effectively one-way switch that must precede any traced op)."""
+        if self.precision == "f64":
+            import jax
+
+            jax.config.update("jax_enable_x64", True)
+
+    def real_dtype(self):
+        import jax.numpy as jnp
+
+        return jnp.float64 if self.precision == "f64" else jnp.float32
+
+
+settings = Settings()

@@ -1,0 +1,15 @@
+from .dataset import Pulsar, load_pulsar, load_directory, get_tspan
+from .partim import parse_par, parse_tim
+from .fourier import fourier_basis
+from .design import design_matrix
+
+__all__ = [
+    "Pulsar",
+    "load_pulsar",
+    "load_directory",
+    "get_tspan",
+    "parse_par",
+    "parse_tim",
+    "fourier_basis",
+    "design_matrix",
+]

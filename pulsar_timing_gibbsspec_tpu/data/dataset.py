@@ -1,0 +1,137 @@
+"""Pulsar data container and loaders.
+
+``Pulsar`` is the host-side ingestion product this framework's model layer
+consumes — the same contract the reference has with ``enterprise.Pulsar``
+(residuals, TOA uncertainties, backend flags, design matrix; see reference
+``pulsar_gibbs.py:71`` for residuals and ``:123`` for the backend-flag
+selection input).  If the optional ``enterprise`` package is importable, its
+higher-fidelity loader may be used instead via ``from_enterprise``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from pathlib import Path
+
+import numpy as np
+
+from .design import design_matrix
+from .partim import parse_par, parse_tim
+
+DAY = 86400.0
+
+
+@dataclasses.dataclass
+class Pulsar:
+    """Host-side per-pulsar data (all times/uncertainties in seconds)."""
+
+    name: str
+    toas: np.ndarray            # (n,) TOA epochs [s] (MJD * 86400)
+    toaerrs: np.ndarray         # (n,) TOA uncertainties [s]
+    residuals: np.ndarray       # (n,) timing residuals [s]
+    freqs: np.ndarray           # (n,) observing frequency [MHz]
+    backend_flags: np.ndarray   # (n,) backend/receiver label per TOA (str)
+    Mmat: np.ndarray            # (n, m) timing design matrix
+    fitpars: list               # fitted timing parameter names
+    flags: dict = dataclasses.field(default_factory=dict)  # extra flag columns
+
+    @property
+    def ntoa(self) -> int:
+        return len(self.toas)
+
+    @property
+    def tspan(self) -> float:
+        return float(self.toas.max() - self.toas.min())
+
+    def backends(self) -> list:
+        return sorted(set(self.backend_flags.tolist()))
+
+
+def _backend_labels(tim) -> np.ndarray:
+    """Backend label per TOA: '-f' flag if present (NANOGrav convention,
+    matched by enterprise's ``selections.by_backend`` used at reference
+    ``pulsar_gibbs.py:123``), else '-be', else the site code."""
+    out = []
+    for fl, site in zip(tim.flags, tim.sites):
+        out.append(fl.get("f", fl.get("be", site)))
+    return np.asarray(out, dtype=object)
+
+
+def load_pulsar(par_path, tim_path, inject: dict | None = None) -> Pulsar:
+    """Load one pulsar from par/tim.
+
+    ``inject`` (optional): kwargs for
+    :func:`~pulsar_timing_gibbsspec_tpu.data.simulate.inject_residuals`
+    (e.g. ``dict(log10_A=np.log10(2e-15), gamma=13/3, nmodes=30)``); when
+    given, residuals are regenerated with a known injection instead of the
+    (unavailable without tempo2) observed post-fit residuals.
+    """
+    par = parse_par(par_path)
+    tim = parse_tim(tim_path)
+    M = design_matrix(par, tim)
+
+    residuals = np.zeros_like(tim.mjds)
+    if inject is not None:
+        from .fourier import fourier_basis
+        from .simulate import inject_residuals
+
+        kw = dict(inject)
+        nmodes = kw.pop("nmodes", 30)
+        Tspan = kw.pop("Tspan", float(np.ptp(tim.mjds) * DAY))
+        if Tspan <= 0:
+            raise ValueError(
+                f"{par.name}: cannot inject a red-noise realization with "
+                f"Tspan={Tspan} (need >=2 distinct TOA epochs)")
+        F, f = fourier_basis(tim.mjds, nmodes, Tspan)
+        residuals, _ = inject_residuals(
+            par.name, F, f, Tspan, tim.errs, M, **kw)
+
+    return Pulsar(
+        name=par.name,
+        toas=tim.mjds * DAY,
+        toaerrs=tim.errs,
+        residuals=residuals,
+        freqs=tim.freqs,
+        backend_flags=_backend_labels(tim),
+        Mmat=M,
+        fitpars=list(par.fitted),
+        flags={"pta": tim.flags[0].get("pta", "") if tim.flags else ""},
+    )
+
+
+def load_directory(dirpath, inject: dict | None = None, names=None) -> list:
+    """Load every ``<name>.par``/``<name>.tim`` pair under ``dirpath``."""
+    dirpath = Path(dirpath)
+    psrs = []
+    for parf in sorted(dirpath.glob("*.par")):
+        timf = parf.with_suffix(".tim")
+        if not timf.exists():
+            continue
+        if names is not None and parf.stem not in names:
+            continue
+        psrs.append(load_pulsar(parf, timf, inject=inject))
+    return psrs
+
+
+def get_tspan(psrs) -> float:
+    """Common span [s] across pulsars (reference uses
+    ``model_utils.get_tspan`` at ``model_definition.py:195`` to set the
+    frequency grid ``f_i = i/Tspan``)."""
+    tmin = min(p.toas.min() for p in psrs)
+    tmax = max(p.toas.max() for p in psrs)
+    return float(tmax - tmin)
+
+
+def from_enterprise(epsr) -> Pulsar:
+    """Adapter from an ``enterprise.Pulsar`` (optional dependency)."""
+    return Pulsar(
+        name=epsr.name,
+        toas=np.asarray(epsr.toas, dtype=np.float64),
+        toaerrs=np.asarray(epsr.toaerrs, dtype=np.float64),
+        residuals=np.asarray(epsr.residuals, dtype=np.float64),
+        freqs=np.asarray(epsr.freqs, dtype=np.float64),
+        backend_flags=np.asarray(epsr.backend_flags, dtype=object),
+        Mmat=np.asarray(epsr.Mmat, dtype=np.float64),
+        fitpars=list(epsr.fitpars),
+        flags={"pta": epsr.flags["pta"][0] if "pta" in epsr.flags else ""},
+    )

@@ -1,0 +1,105 @@
+"""Linear timing-model design matrix.
+
+In the reference, the design matrix ``M`` comes out of tempo2/PINT via
+``enterprise``'s ``Pulsar`` object and enters the sampler only as the
+timing-model block of the combined basis ``T`` (reference
+``pulsar_gibbs.py:499`` pulls it through ``pta.get_basis``); its columns are
+then analytically marginalized with an (effectively) infinite prior variance.
+Because only the *column space* of ``M`` matters for that marginalization,
+this module builds an equivalent linear basis directly from the fitted
+parameters listed in the par file, using the standard leading-order timing
+partials:
+
+- phase offset, spin frequency and derivatives  -> ``1, t, t^2 (, t^3)``
+- sky position                                  -> annual sin/cos
+- proper motion                                 -> ``t *`` annual sin/cos
+- parallax                                      -> semi-annual sin/cos
+- DM and derivatives                            -> ``1/nu^2 (, t/nu^2)``
+- Keplerian binary parameters                   -> orbital-phase harmonics
+  (2 harmonics; +2 more when Shapiro-sensitive params M2/SINI are fitted,
+  since the Shapiro delay is sharply peaked at conjunction)
+
+The matrix is full column rank over the shipped ``simulated_data/`` corpus
+and is consumed after SVD orthonormalization or column normalization (see
+``models/signals.py``, mirroring the reference's ``tm_svd``/``tm_norm``
+options at ``model_definition.py:42-46``).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .partim import ParFile, TimFile
+
+DAY = 86400.0
+YEAR = 365.25 * DAY
+
+
+def design_matrix(par: ParFile, tim: TimFile) -> np.ndarray:
+    """Build the (n_toa, n_col) timing design matrix for the fitted params."""
+    t = (tim.mjds - tim.mjds.mean()) * DAY            # seconds, centered
+    tyr = 2.0 * np.pi * t / YEAR                      # annual phase
+    cols = [np.ones_like(t)]                          # overall phase offset
+
+    fitted = set(par.fitted)
+
+    # spin frequency and derivatives
+    if "F0" in fitted:
+        cols.append(t)
+    if "F1" in fitted:
+        cols.append(t**2)
+    if "F2" in fitted:
+        cols.append(t**3)
+
+    # astrometry: position -> annual; proper motion -> t * annual;
+    # parallax -> semi-annual
+    if fitted & {"RAJ", "DECJ", "ELONG", "ELAT", "LAMBDA", "BETA"}:
+        cols += [np.sin(tyr), np.cos(tyr)]
+    if fitted & {"PMRA", "PMDEC", "PMELONG", "PMELAT", "PMLAMBDA", "PMBETA"}:
+        cols += [t * np.sin(tyr), t * np.cos(tyr)]
+    if "PX" in fitted:
+        cols += [np.sin(2 * tyr), np.cos(2 * tyr)]
+
+    # dispersion measure
+    nu2 = (tim.freqs / 1400.0) ** 2
+    nu2 = np.where(nu2 > 0, nu2, 1.0)
+    if "DM" in fitted and np.ptp(tim.freqs) > 0:
+        cols.append(1.0 / nu2)
+    if "DM1" in fitted and np.ptp(tim.freqs) > 0:
+        cols.append(t / nu2)
+
+    # binary: harmonics of the orbital phase
+    kepler = {"PB", "T0", "TASC", "A1", "OM", "ECC", "EPS1", "EPS2",
+              "PBDOT", "XDOT", "OMDOT", "M2", "SINI", "KIN", "KOM", "GAMMA"}
+    fitted_binary = fitted & kepler
+    pb = par.get("PB")
+    if fitted_binary and pb:
+        t0 = par.get("T0", par.get("TASC", tim.mjds.mean()))
+        phase = 2.0 * np.pi * ((tim.mjds - t0) / pb)
+        n_harm = 2
+        if fitted_binary & {"M2", "SINI", "KIN"}:
+            n_harm = 4
+        for k in range(1, n_harm + 1):
+            cols += [np.sin(k * phase), np.cos(k * phase)]
+
+    M = np.column_stack(cols)
+    return _drop_degenerate(M)
+
+
+def _drop_degenerate(M: np.ndarray, rtol: float = 1e-10) -> np.ndarray:
+    """Drop columns that are numerically inside the span of earlier ones.
+
+    The rank test runs on unit-normalized columns; raw timing partials span
+    ~18 orders of magnitude (t^2 in s^2 vs the ones column) and would
+    otherwise defeat a scale-blind singular-value threshold.
+    """
+    norms = np.linalg.norm(M, axis=0)
+    Mn = M / np.where(norms > 0, norms, 1.0)
+    keep = []
+    for j in range(Mn.shape[1]):
+        if norms[j] == 0:
+            continue
+        s = np.linalg.svd(Mn[:, keep + [j]], compute_uv=False)
+        if s[-1] > rtol * s[0]:
+            keep.append(j)
+    return M[:, keep]

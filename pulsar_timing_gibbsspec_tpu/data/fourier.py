@@ -1,0 +1,40 @@
+"""Fourier (rank-reduced GP) basis.
+
+Sin/cos pairs at ``f_j = j / Tspan`` — the basis every red-noise and GW
+signal in the reference rides on (enterprise's
+``createfourierdesignmatrix_red``; consumed at ``pulsar_gibbs.py:95-105``
+where GW basis indices are located, and at ``:208-209`` where sin/cos pairs
+are folded into ``tau``).  Columns are interleaved ``[sin f_1, cos f_1,
+sin f_2, ...]`` so that the sampler's pairwise reduction over ``[::2]`` /
+``[1::2]`` strides matches reference semantics.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+DAY = 86400.0
+
+
+def fourier_basis(toas_mjd: np.ndarray, nmodes: int, Tspan: float,
+                  modes: np.ndarray | None = None):
+    """Return ``(F, f)``: basis (n, 2*nmodes) and per-column frequencies.
+
+    Parameters
+    ----------
+    toas_mjd : TOA epochs in MJD
+    nmodes : number of frequencies
+    Tspan : span in seconds defining the fundamental ``1/Tspan``
+    modes : optional explicit frequency list [Hz], overrides the linear grid
+    """
+    t = toas_mjd * DAY
+    if modes is None:
+        f = np.arange(1, nmodes + 1) / Tspan
+    else:
+        f = np.asarray(modes, dtype=np.float64)
+        nmodes = len(f)
+    F = np.zeros((len(t), 2 * nmodes))
+    arg = 2.0 * np.pi * t[:, None] * f[None, :]
+    F[:, ::2] = np.sin(arg)
+    F[:, 1::2] = np.cos(arg)
+    return F, np.repeat(f, 2)

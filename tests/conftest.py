@@ -1,0 +1,42 @@
+"""Test configuration.
+
+Forces JAX onto the CPU backend with 8 virtual devices so multi-device
+sharding paths are exercised without TPU hardware (the strategy SURVEY.md §4
+prescribes in place of the reference's absent multi-node test story).  Must
+run before anything imports jax.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+
+import numpy as np
+import pytest
+
+REFDATA = "/root/reference/simulated_data"
+
+
+@pytest.fixture(scope="session")
+def j1713():
+    from pulsar_timing_gibbsspec_tpu.data import load_pulsar
+
+    return load_pulsar(
+        f"{REFDATA}/J1713+0747.par",
+        f"{REFDATA}/J1713+0747.tim",
+        inject=dict(log10_A=np.log10(2e-15), gamma=13.0 / 3.0, nmodes=30),
+    )
+
+
+@pytest.fixture(scope="session")
+def psrs8():
+    from pathlib import Path
+
+    from pulsar_timing_gibbsspec_tpu.data import load_directory
+
+    names = sorted(p.stem for p in Path(REFDATA).glob("*.par"))[:8]
+    return load_directory(
+        REFDATA, names=set(names),
+        inject=dict(log10_A=np.log10(2e-15), gamma=13.0 / 3.0))

@@ -1,0 +1,90 @@
+import numpy as np
+import pytest
+
+from pulsar_timing_gibbsspec_tpu.data import (
+    design_matrix, fourier_basis, get_tspan, load_directory, load_pulsar,
+    parse_par, parse_tim,
+)
+from pulsar_timing_gibbsspec_tpu.data.simulate import inject_residuals, powerlaw_psd
+
+REFDATA = "/root/reference/simulated_data"
+
+
+def test_parse_par_j1713():
+    par = parse_par(f"{REFDATA}/J1713+0747.par")
+    assert par.name == "J1713+0747"
+    assert par["F0"] == pytest.approx(218.811843786, rel=1e-9)
+    assert "F0" in par.fitted and "F1" in par.fitted
+    assert "PEPOCH" not in par.fitted        # no fit flag on epochs
+    assert par.get("PB") == pytest.approx(67.825, rel=1e-3)
+
+
+def test_parse_tim_j1713():
+    tim = parse_tim(f"{REFDATA}/J1713+0747.tim")
+    assert len(tim.mjds) == 720
+    assert np.all(np.diff(tim.mjds) >= 0)
+    assert tim.errs.min() > 1e-8 and tim.errs.max() < 1e-5   # ~0.1 us range
+    assert tim.flags[0].get("f") == "test"
+
+
+def test_design_matrix_full_rank():
+    par = parse_par(f"{REFDATA}/J1713+0747.par")
+    tim = parse_tim(f"{REFDATA}/J1713+0747.tim")
+    M = design_matrix(par, tim)
+    assert M.shape[0] == 720
+    # at least offset + spin + astrometry terms
+    assert M.shape[1] >= 7
+    Mn = M / np.linalg.norm(M, axis=0)
+    s = np.linalg.svd(Mn, compute_uv=False)
+    assert s[-1] > 1e-10 * s[0]
+    # quadratic spin-down partial must be in the span (F1 is fitted)
+    t2 = ((tim.mjds - tim.mjds.mean()) * 86400.0) ** 2
+    c, *_ = np.linalg.lstsq(M, t2, rcond=None)
+    assert np.linalg.norm(t2 - M @ c) < 1e-8 * np.linalg.norm(t2)
+
+
+def test_fourier_basis_interleaving():
+    t = np.linspace(50000, 55000, 100)
+    F, f = fourier_basis(t, nmodes=5, Tspan=5000 * 86400.0)
+    assert F.shape == (100, 10)
+    assert f[0] == f[1] == 1.0 / (5000 * 86400.0)
+    # column 0 is sin, column 1 is cos of the same frequency
+    arg = 2 * np.pi * t * 86400.0 * f[0]
+    np.testing.assert_allclose(F[:, 0], np.sin(arg), atol=1e-12)
+    np.testing.assert_allclose(F[:, 1], np.cos(arg), atol=1e-12)
+
+
+def test_powerlaw_psd_scaling():
+    f = np.array([1e-8, 2e-8])
+    df = 1e-9
+    p1 = powerlaw_psd(f, -14.0, 3.0, df)
+    p2 = powerlaw_psd(f, -13.0, 3.0, df)
+    np.testing.assert_allclose(p2 / p1, 100.0)      # A^2 scaling
+    # steeper spectrum falls faster
+    p3 = powerlaw_psd(f, -14.0, 5.0, df)
+    assert p3[1] / p3[0] < p1[1] / p1[0]
+
+
+def test_injection_deterministic_and_postfit(j1713):
+    p2 = load_pulsar(
+        f"{REFDATA}/J1713+0747.par", f"{REFDATA}/J1713+0747.tim",
+        inject=dict(log10_A=np.log10(2e-15), gamma=13.0 / 3.0, nmodes=30),
+    )
+    np.testing.assert_array_equal(j1713.residuals, p2.residuals)
+    # post-fit: residuals orthogonal to the design matrix columns
+    proj = j1713.Mmat.T @ j1713.residuals
+    scale = np.linalg.norm(j1713.Mmat, axis=0) * np.linalg.norm(j1713.residuals)
+    assert np.all(np.abs(proj) / scale < 1e-8)
+    # red excess above the ~0.11us white level (post-fit projection absorbs
+    # much of the lowest-frequency injected power, so the margin is modest)
+    assert j1713.residuals.std() > 1.5 * j1713.toaerrs.mean()
+
+
+def test_load_directory_and_tspan():
+    psrs = load_directory(REFDATA, names={"J1713+0747", "B1855+09"})
+    assert len(psrs) == 2
+    ts = get_tspan(psrs)
+    assert ts > 10 * 365.25 * 86400.0
+    for p in psrs:
+        assert p.ntoa == len(p.residuals) == len(p.toaerrs)
+        assert p.backends() == ["test"]
