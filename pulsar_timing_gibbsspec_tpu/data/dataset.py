@@ -34,6 +34,9 @@ class Pulsar:
     Mmat: np.ndarray            # (n, m) timing design matrix
     fitpars: list               # fitted timing parameter names
     flags: dict = dataclasses.field(default_factory=dict)  # extra flag columns
+    #: unit vector to the pulsar (consistent frame; only angular separations
+    #: are consumed, by the overlap-reduction functions in models/orf.py)
+    pos: np.ndarray = dataclasses.field(default_factory=lambda: np.zeros(3))
 
     @property
     def ntoa(self) -> int:
@@ -70,6 +73,25 @@ def load_pulsar(par_path, tim_path, inject: dict | None = None) -> Pulsar:
     tim = parse_tim(tim_path)
     M = design_matrix(par, tim)
 
+    # sky position -> equatorial unit vector (ecliptic coords rotated by the
+    # obliquity so mixed ELONG/ELAT and RAJ/DECJ catalogs share one frame)
+    OBLIQUITY = np.deg2rad(23.439281)
+    if "ELONG" in par.values or "LAMBDA" in par.values:
+        lon = par.get("ELONG", par.get("LAMBDA"))
+        lat = par.get("ELAT", par.get("BETA", 0.0))
+        x = np.array([np.cos(lat) * np.cos(lon),
+                      np.cos(lat) * np.sin(lon),
+                      np.sin(lat)])
+        ce, se = np.cos(OBLIQUITY), np.sin(OBLIQUITY)
+        pos = np.array([x[0], ce * x[1] - se * x[2], se * x[1] + ce * x[2]])
+    elif "RAJ" in par.values or "DECJ" in par.values:
+        lon, lat = par.get("RAJ", 0.0), par.get("DECJ", 0.0)
+        pos = np.array([np.cos(lat) * np.cos(lon),
+                        np.cos(lat) * np.sin(lon),
+                        np.sin(lat)])
+    else:
+        pos = np.zeros(3)   # unknown; orf_matrix refuses zero-norm positions
+
     residuals = np.zeros_like(tim.mjds)
     if inject is not None:
         from .fourier import fourier_basis
@@ -96,6 +118,7 @@ def load_pulsar(par_path, tim_path, inject: dict | None = None) -> Pulsar:
         Mmat=M,
         fitpars=list(par.fitted),
         flags={"pta": tim.flags[0].get("pta", "") if tim.flags else ""},
+        pos=pos,
     )
 
 

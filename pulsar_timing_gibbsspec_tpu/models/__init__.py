@@ -1,0 +1,8 @@
+from .priors import Uniform, Normal, LinearExp, Constant
+from .pta import PTA, SignalModel
+from .factory import model_general
+
+__all__ = [
+    "Uniform", "Normal", "LinearExp", "Constant",
+    "PTA", "SignalModel", "model_general",
+]

@@ -1,0 +1,55 @@
+"""Overlap reduction functions (inter-pulsar correlation of common signals).
+
+The reference's ``model_general`` can build common processes with any of
+these ORFs (``model_definition.py:198-216``), though its experimental PTA
+sampler only ever exploits the block-diagonal CRN case (SURVEY §3.6).  Here
+the ORFs are first-class so the PTA phi matrix can be dense when a correlated
+common process is requested.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def crn(pos_a, pos_b):
+    """Common-spectrum uncorrelated process: identity correlation."""
+    return 1.0 if pos_a is pos_b or np.allclose(pos_a, pos_b) else 0.0
+
+
+def hd(pos_a, pos_b):
+    """Hellings-Downs quadrupolar correlation."""
+    if pos_a is pos_b or np.allclose(pos_a, pos_b):
+        return 1.0
+    x = (1.0 - np.dot(pos_a, pos_b)) / 2.0
+    x = np.clip(x, 1e-15, None)
+    return 1.5 * x * np.log(x) - 0.25 * x + 0.5
+
+
+def dipole(pos_a, pos_b):
+    if pos_a is pos_b or np.allclose(pos_a, pos_b):
+        return 1.0
+    return float(np.dot(pos_a, pos_b))
+
+
+def monopole(pos_a, pos_b):
+    return 1.0
+
+
+ORFS = {"crn": crn, "hd": hd, "dipole": dipole, "monopole": monopole}
+
+
+def orf_matrix(name: str, positions) -> np.ndarray:
+    """(P, P) correlation matrix over pulsars for the named ORF."""
+    fn = ORFS[name]
+    P = len(positions)
+    for ii, p in enumerate(positions):
+        if not np.isfinite(p).all() or np.linalg.norm(p) < 0.5:
+            raise ValueError(
+                f"pulsar {ii} has no usable sky position (par file lacked "
+                f"ELONG/ELAT and RAJ/DECJ); cannot evaluate a correlated ORF")
+    G = np.eye(P)
+    for a in range(P):
+        for b in range(a + 1, P):
+            G[a, b] = G[b, a] = fn(positions[a], positions[b])
+    return G

@@ -1,0 +1,125 @@
+"""Prior / parameter objects.
+
+A deliberately small, explicit replacement for the slice of enterprise's
+parameter system the reference exercises: sampling initial points
+(``[p.sample() for p in pta.params]``, reference ``pulsar_gibbs.py:74``),
+prior log-pdfs inside MH blocks (``p.get_logpdf``, reference ``:617``), and
+bound extraction for the conditional rho draws.  The reference recovers
+bounds by parsing ``repr(param)`` strings (``pulsar_gibbs.py:82-87`` — noted
+fragile in SURVEY §3.1); here bounds are first-class attributes
+(``param.pmin``/``param.pmax``) while the repr still prints them for
+familiarity.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class Parameter:
+    """Base class: a named scalar or vector random variable."""
+
+    def __init__(self, name: str, size: int | None = None):
+        self.name = name
+        self.size = size
+
+    # subclasses: _sample1(rng, shape), _logpdf(value)
+
+    def sample(self, rng=None):
+        rng = np.random.default_rng() if rng is None else rng
+        shape = () if self.size is None else (self.size,)
+        return self._sample1(rng, shape)
+
+    def get_logpdf(self, value=None, params: dict | None = None):
+        if value is None and params is not None:
+            value = params.get(self.name)
+        return float(np.sum(self._logpdf(np.asarray(value, dtype=np.float64))))
+
+    @property
+    def params(self):
+        """Scalar sub-parameters of a vector parameter (enterprise exposes
+        the same; the reference reads bounds off element 0 at
+        ``pulsar_gibbs.py:84``)."""
+        if self.size is None:
+            return [self]
+        return [self._scalar(f"{self.name}_{ii}") for ii in range(self.size)]
+
+
+class Uniform(Parameter):
+    def __init__(self, pmin: float, pmax: float, name: str = "", size: int | None = None):
+        super().__init__(name, size)
+        self.pmin, self.pmax = float(pmin), float(pmax)
+
+    def _sample1(self, rng, shape):
+        return rng.uniform(self.pmin, self.pmax, size=shape)
+
+    def _logpdf(self, x):
+        inside = (x >= self.pmin) & (x <= self.pmax)
+        return np.where(inside, -np.log(self.pmax - self.pmin), -np.inf)
+
+    def _scalar(self, name):
+        return Uniform(self.pmin, self.pmax, name=name)
+
+    def __repr__(self):
+        return f"{self.name}:Uniform(pmin={self.pmin}, pmax={self.pmax})"
+
+
+class Normal(Parameter):
+    def __init__(self, mu: float = 0.0, sigma: float = 1.0, name: str = "", size: int | None = None):
+        super().__init__(name, size)
+        self.mu, self.sigma = float(mu), float(sigma)
+
+    def _sample1(self, rng, shape):
+        return rng.normal(self.mu, self.sigma, size=shape)
+
+    def _logpdf(self, x):
+        return -0.5 * ((x - self.mu) / self.sigma) ** 2 - np.log(self.sigma * np.sqrt(2 * np.pi))
+
+    def _scalar(self, name):
+        return Normal(self.mu, self.sigma, name=name)
+
+    def __repr__(self):
+        return f"{self.name}:Normal(mu={self.mu}, sigma={self.sigma})"
+
+
+class LinearExp(Parameter):
+    """Uniform in the linear quantity for a log10-parameterized variable
+    (enterprise's ``LinearExp`` — the 'uniform' amplitude prior used for
+    upper-limit runs, reference ``model_definition.py:172``)."""
+
+    def __init__(self, pmin: float, pmax: float, name: str = "", size: int | None = None):
+        super().__init__(name, size)
+        self.pmin, self.pmax = float(pmin), float(pmax)
+
+    def _sample1(self, rng, shape):
+        u = rng.uniform(size=shape)
+        return np.log10(10**self.pmin + u * (10**self.pmax - 10**self.pmin))
+
+    def _logpdf(self, x):
+        inside = (x >= self.pmin) & (x <= self.pmax)
+        dens = np.log(10.0) * 10**x / (10**self.pmax - 10**self.pmin)
+        with np.errstate(divide="ignore"):
+            return np.where(inside, np.log(dens), -np.inf)
+
+    def _scalar(self, name):
+        return LinearExp(self.pmin, self.pmax, name=name)
+
+    def __repr__(self):
+        return f"{self.name}:LinearExp(pmin={self.pmin}, pmax={self.pmax})"
+
+
+class Constant(Parameter):
+    """Fixed value; excluded from ``PTA.params`` (and hence the chain)."""
+
+    def __init__(self, value: float, name: str = ""):
+        super().__init__(name, None)
+        self.value = float(value)
+
+    def _sample1(self, rng, shape):
+        return self.value
+
+    def _logpdf(self, x):
+        return 0.0
+
+    def __repr__(self):
+        return f"{self.name}:Constant({self.value})"

@@ -1,0 +1,66 @@
+"""Power spectral density models for rank-reduced Fourier GPs.
+
+Each function maps per-column frequencies ``f`` (each frequency repeated for
+its sin/cos pair, see ``data/fourier.py``) plus hyperparameters to the
+per-coefficient prior variance ``phi`` [s^2].  These cover the PSD menu of
+the reference's ``model_general`` (``model_definition.py:63-65``:
+'powerlaw', 'spectrum', 'turnover', 'turnover_knee', 'broken_powerlaw', and
+'infinitepower' for marginalization).  Conventions follow the standard PTA
+definitions (as in enterprise ``utils``): amplitudes at ``f_yr = 1/yr``,
+``phi(f) = hc(f)^2 / (12 pi^2 f^3) * df``.
+
+All functions are plain ``numpy``-style expressions valid under ``jax.numpy``
+tracing — the device backend calls them with ``jnp`` arrays inside jit.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+DAY = 86400.0
+YEAR = 365.25 * DAY
+FYR = 1.0 / YEAR
+
+
+def powerlaw(f, df, log10_A, gamma):
+    A = 10.0 ** log10_A
+    return (A**2 / (12.0 * np.pi**2)) * FYR ** (gamma - 3.0) * f ** (-gamma) * df
+
+
+def free_spectrum(f, df, log10_rho):
+    """phi_j = rho_j^2 directly per frequency; ``log10_rho`` has one entry
+    per frequency and is repeated over the sin/cos pair (enterprise
+    ``free_spectrum``; the Gibbs rho draw writes ``0.5*log10(rho_var)`` back
+    into these parameters, reference ``pulsar_gibbs.py:236``)."""
+    xp = np
+    if not isinstance(log10_rho, np.ndarray):
+        import jax.numpy as xp  # noqa: F811 — traced path
+    return xp.repeat(10.0 ** (2.0 * xp.asarray(log10_rho)), 2)
+
+
+def turnover(f, df, log10_A, gamma, lf0=-8.5, kappa=10.0 / 3.0, beta=0.5):
+    A = 10.0 ** log10_A
+    hcf = A * (f / FYR) ** ((3.0 - gamma) / 2.0) / (1.0 + (10.0**lf0 / f) ** kappa) ** beta
+    return hcf**2 / (12.0 * np.pi**2) / f**3 * df
+
+
+def broken_powerlaw(f, df, log10_A, gamma, delta=0.0, log10_fb=-8.5, kappa=0.1):
+    A = 10.0 ** log10_A
+    fb = 10.0 ** log10_fb
+    hcf = (A * (f / FYR) ** ((3.0 - gamma) / 2.0)
+           * (1.0 + (f / fb) ** (1.0 / kappa)) ** (kappa * (gamma - delta) / 2.0))
+    return hcf**2 / (12.0 * np.pi**2) / f**3 * df
+
+
+def turnover_knee(f, df, log10_A, gamma, lfb=-8.5, lfk=-8.0, kappa=10.0 / 3.0, delta=0.1):
+    A = 10.0 ** log10_A
+    hcf = (A * (f / FYR) ** ((3.0 - gamma) / 2.0)
+           * (1.0 + (f / 10.0**lfk) ** delta)
+           / np.sqrt(1.0 + (10.0**lfb / f) ** kappa))
+    return hcf**2 / (12.0 * np.pi**2) / f**3 * df
+
+
+def infinitepower(f, df):
+    """Effectively-unconstrained prior variance for marginalized bases
+    (timing model); kept in log space device-side to stay f32-safe."""
+    return np.full_like(np.asarray(f, dtype=np.float64), 1e40)

@@ -1,0 +1,176 @@
+"""Per-pulsar signal model and PTA container.
+
+Provides the exact accessor surface the reference samplers consume from an
+enterprise PTA: ``pulsars``, ``params``, ``param_names``, ``map_params``,
+``get_residuals``, ``get_basis``, ``get_ndiag``, ``get_phi``,
+``get_phiinv(logdet=...)`` and a ``signals`` mapping (reference
+``pulsar_gibbs.py:59-136,489-520``; ``pta_gibbs.py:512-548``).  Everything is
+a plain NumPy array on the host; the JAX backend compiles this model into a
+static device pytree (``sampler/jax_backend.py``).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .priors import Constant
+from .signals import BasisSignal, WhiteNoiseSignal
+
+
+class SignalModel:
+    """One pulsar: ordered basis signals + white noise over its TOAs.
+
+    Basis layout: ``[timing-model block | shared Fourier block | ECORR
+    block]``.  All Fourier signals (red, common GW) share leading columns of
+    the Fourier block — the reference's "red + GW share a basis" convention
+    (``pulsar_gibbs.py:101-102``); the block is as wide as the largest
+    requested mode count.
+    """
+
+    def __init__(self, pulsar, basis_signals: list, white: WhiteNoiseSignal | None):
+        self.pulsar = pulsar
+        self.white = white
+
+        self._timing = [s for s in basis_signals if not s.shares_fourier and s.name != "basis_ecorr"]
+        self._fourier = [s for s in basis_signals if s.shares_fourier]
+        self._ecorr = [s for s in basis_signals if s.name == "basis_ecorr"]
+        self.signals = self._timing + self._fourier + self._ecorr
+
+        blocks, self._slices = [], {}
+        off = 0
+        for s in self._timing:
+            B = s.get_basis()
+            blocks.append(B)
+            self._slices[s.name] = slice(off, off + B.shape[1])
+            off += B.shape[1]
+        if self._fourier:
+            widths = [s.get_basis().shape[1] for s in self._fourier]
+            wmax = max(widths)
+            donor = self._fourier[int(np.argmax(widths))]
+            blocks.append(donor.get_basis())
+            for s in self._fourier:
+                self._slices[s.name] = slice(off, off + s.get_basis().shape[1])
+            off += wmax
+        for s in self._ecorr:
+            B = s.get_basis()
+            blocks.append(B)
+            self._slices[s.name] = slice(off, off + B.shape[1])
+            off += B.shape[1]
+
+        self._T = np.hstack(blocks) if blocks else np.zeros((pulsar.ntoa, 0))
+        self._nbasis = off
+
+    @property
+    def params(self):
+        seen, out = set(), []
+        for s in self.signals + ([self.white] if self.white else []):
+            for p in s.params:
+                if not isinstance(p, Constant) and id(p) not in seen:
+                    seen.add(id(p))
+                    out.append(p)
+        return out
+
+    def basis_slice(self, name_frag: str):
+        """Column slice of the first signal whose name contains the
+        fragment (e.g. 'gw' -> GW coefficients; used for the tau fold)."""
+        for s in self.signals:
+            if name_frag in s.name:
+                return self._slices[s.name]
+        return None
+
+    def get_basis(self):
+        return self._T
+
+    def get_phi(self, params: dict):
+        phi = np.zeros(self._nbasis)
+        for s in self.signals:
+            phi[self._slices[s.name]] += s.get_phi(params)
+        return phi
+
+    def get_ndiag(self, params: dict):
+        if self.white is None:
+            return np.array(self.pulsar.toaerrs**2)
+        return self.white.get_ndiag(params)
+
+
+class PTA:
+    """Container over per-pulsar SignalModels with enterprise-like accessors."""
+
+    def __init__(self, models: list):
+        self._models = list(models)
+        self.pulsars = [m.pulsar.name for m in self._models]
+
+        # signals mapping keyed '<pulsar>_<signalname>' in model order —
+        # the reference iterates this to locate GW/red/ecorr bases
+        # (pulsar_gibbs.py:94-105, pta_gibbs.py:100-109)
+        self.signals = {}
+        for m in self._models:
+            for s in m.signals:
+                self.signals[f"{m.pulsar.name}_{s.name}"] = s
+
+    @property
+    def params(self):
+        """Deduped (by name) free parameters, sorted by name — enterprise
+        PTA ordering, which fixes the chain-column layout."""
+        seen, out = {}, []
+        for m in self._models:
+            for p in m.params:
+                if p.name not in seen:
+                    seen[p.name] = p
+        return sorted(seen.values(), key=lambda p: p.name)
+
+    @property
+    def param_names(self):
+        out = []
+        for p in self.params:
+            if p.size:
+                out += [f"{p.name}_{ii}" for ii in range(p.size)]
+            else:
+                out.append(p.name)
+        return out
+
+    def map_params(self, xs):
+        ret, ct = {}, 0
+        for p in self.params:
+            n = p.size if p.size else 1
+            ret[p.name] = np.asarray(xs[ct:ct + n]) if n > 1 else float(xs[ct])
+            ct += n
+        return ret
+
+    def initial_sample(self, rng=None):
+        rng = np.random.default_rng() if rng is None else rng
+        return np.concatenate([np.atleast_1d(p.sample(rng)) for p in self.params])
+
+    # -- per-pulsar accessors (lists, one entry per pulsar) ------------------
+
+    def get_residuals(self):
+        return [m.pulsar.residuals for m in self._models]
+
+    def get_basis(self, params=None):
+        return [m.get_basis() for m in self._models]
+
+    def get_ndiag(self, params):
+        params = params if isinstance(params, dict) else self.map_params(params)
+        return [m.get_ndiag(params) for m in self._models]
+
+    def get_phi(self, params):
+        params = params if isinstance(params, dict) else self.map_params(params)
+        return [m.get_phi(params) for m in self._models]
+
+    def get_phiinv(self, params, logdet: bool = False):
+        out = []
+        for phi in self.get_phi(params):
+            if logdet:
+                out.append((1.0 / phi, float(np.sum(np.log(phi)))))
+            else:
+                out.append(1.0 / phi)
+        return out
+
+    def get_lnprior(self, xs):
+        params = xs if isinstance(xs, dict) else self.map_params(xs)
+        return float(sum(p.get_logpdf(params=params) for p in self.params))
+
+    def model(self, ii_or_name):
+        if isinstance(ii_or_name, str):
+            return self._models[self.pulsars.index(ii_or_name)]
+        return self._models[ii_or_name]

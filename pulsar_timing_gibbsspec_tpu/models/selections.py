@@ -1,0 +1,24 @@
+"""TOA-subset selections for white-noise parameters.
+
+Equivalent of enterprise ``selections`` as used by the reference: one
+EFAC/EQUAD (/ECORR) per backend via the per-TOA backend flag
+(``selections.by_backend``, reference ``pulsar_gibbs.py:123`` and
+``model_definition.py:219-228`` with ``select='backend'``).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def by_backend(backend_flags: np.ndarray) -> dict:
+    """Label -> boolean TOA mask, one entry per distinct backend."""
+    return {lab: backend_flags == lab
+            for lab in sorted(set(backend_flags.tolist()))}
+
+
+def no_selection(backend_flags: np.ndarray) -> dict:
+    return {"": np.ones(len(backend_flags), dtype=bool)}
+
+
+SELECTIONS = {"backend": by_backend, None: no_selection, "none": no_selection}

@@ -1,0 +1,215 @@
+"""Signal components composing a per-pulsar noise model.
+
+Slim, array-first equivalents of the enterprise signal classes the reference
+consumes through ``pta.get_basis/get_ndiag/get_phi`` (reference
+``pulsar_gibbs.py:495-499``).  A signal either contributes basis columns with
+a per-column prior variance ``phi`` (timing model, Fourier GPs, basis-ECORR)
+or a diagonal measurement covariance (EFAC/EQUAD).  Basis signals built on
+the same Fourier grid share columns — the "red + GW share a basis"
+convention the reference hard-codes (``pulsar_gibbs.py:101-102``).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..data.fourier import fourier_basis
+from . import psd as psdmod
+from .priors import Constant, Parameter, Uniform
+
+DAY = 86400.0
+
+
+class BasisSignal:
+    """Interface: named basis block + per-column prior variance."""
+
+    name: str
+    params: list
+    shares_fourier = False
+
+    def get_basis(self):
+        raise NotImplementedError
+
+    def get_phi(self, params: dict):
+        raise NotImplementedError
+
+    def _mapped(self, params: dict):
+        """Pull this signal's hyperparameter values out of a name->value dict
+        (Constants supply their fixed value)."""
+        out = {}
+        for p in self.params:
+            out[p.name] = p.value if isinstance(p, Constant) else params[p.name]
+        return out
+
+
+class TimingModelSignal(BasisSignal):
+    """Analytically marginalized linear timing model.
+
+    ``tm_svd`` orthonormalizes the design matrix columns, ``tm_norm`` scales
+    them to unit norm (reference ``model_definition.py:42-46`` /
+    ``gp_signals.TimingModel(use_svd, normed)``); prior variance is the
+    'infinite' 1e40 of enterprise's marginalization.
+    """
+
+    def __init__(self, Mmat: np.ndarray, use_svd: bool = False, normed: bool = True,
+                 name: str = "linear_timing_model"):
+        self.name = name
+        self.params = []
+        if use_svd:
+            U, _, _ = np.linalg.svd(Mmat / np.linalg.norm(Mmat, axis=0),
+                                    full_matrices=False)
+            self._T = U
+        elif normed:
+            self._T = Mmat / np.linalg.norm(Mmat, axis=0)
+        else:
+            self._T = Mmat.copy()
+
+    def get_basis(self):
+        return self._T
+
+    def get_phi(self, params):
+        return np.full(self._T.shape[1], 1e40)
+
+
+class FourierGPSignal(BasisSignal):
+    """Rank-reduced Fourier-basis GP (red noise / common GW process).
+
+    ``psd_name`` selects from ``models/psd.py``; ``psd_params`` is the
+    ordered list of hyperparameter objects matching the psd function
+    signature after ``(f, df)``.  ``orf_name`` tags common processes with
+    their inter-pulsar correlation (consumed by the PTA container; the
+    per-pulsar phi is ORF-independent).
+    """
+
+    shares_fourier = True
+
+    def __init__(self, toas_mjd, nmodes: int, Tspan: float, psd_name: str,
+                 psd_params: list, name: str, modes=None, orf_name: str = "crn"):
+        self.name = name
+        self.params = list(psd_params)
+        self.psd_name = psd_name
+        self.orf_name = orf_name
+        self.nmodes = nmodes
+        self.Tspan = Tspan
+        self._F, self._f = fourier_basis(toas_mjd, nmodes, Tspan, modes=modes)
+        # per-column bin width: spacing between consecutive unique
+        # frequencies, first bin measured from 0 (uniform 1/Tspan on the
+        # default grid; essential for logfreq/custom grids)
+        funique = np.unique(self._f)
+        self._df = np.repeat(np.diff(np.concatenate([[0.0], funique])), 2)
+        if psd_name == "spectrum":            # model_general's name for it
+            psd_name = "free_spectrum"
+            self.psd_name = psd_name
+        self._psd_fn = getattr(psdmod, psd_name)
+
+    def get_basis(self):
+        return self._F
+
+    @property
+    def freqs(self):
+        """Per-column frequencies (each repeated for sin/cos)."""
+        return self._f
+
+    def get_phi(self, params: dict):
+        vals = self._mapped(params)
+        args = [vals[p.name] for p in self.params]
+        if self.psd_name == "free_spectrum":
+            return psdmod.free_spectrum(self._f, self._df, *args)
+        return self._psd_fn(self._f, self._df, *args)
+
+
+class EcorrBasisSignal(BasisSignal):
+    """Epoch-correlated white noise as a basis GP ('basis_ecorr').
+
+    One basis column per observing epoch per backend (TOAs quantized into
+    ``dt``-wide epochs), with variance 10^(2 log10_ecorr_backend).  The
+    reference requires basis (not kernel) ECORR (``pulsar_gibbs.py:65-68``)
+    but its ECORR Gibbs update is disabled; here the basis machinery is
+    complete so the ECORR block can be sampled like any other.
+    """
+
+    def __init__(self, toas: np.ndarray, masks: dict,
+                 params_by_backend: dict, dt_days: float = 10.0,
+                 name: str = "basis_ecorr"):
+        self.name = name
+        cols, owners = [], []
+        labels = sorted(params_by_backend)
+        for lab in labels:
+            mask = masks[lab]
+            epochs = _quantize(toas[mask], dt_days * DAY)
+            for ep in epochs:
+                col = np.zeros(len(toas))
+                idx = np.where(mask)[0][ep]
+                col[idx] = 1.0
+                cols.append(col)
+                owners.append(lab)
+        self._U = np.column_stack(cols) if cols else np.zeros((len(toas), 0))
+        self._owners = owners
+        self._by_backend = dict(params_by_backend)
+        self.params = [params_by_backend[lab] for lab in labels]
+
+    def get_basis(self):
+        return self._U
+
+    def get_phi(self, params: dict):
+        vals = self._mapped(params)
+        out = np.empty(len(self._owners))
+        for jj, lab in enumerate(self._owners):
+            out[jj] = 10.0 ** (2.0 * vals[self._by_backend[lab].name])
+        return out
+
+
+def _quantize(toas: np.ndarray, dt_sec: float):
+    """Group sorted TOAs into epochs no wider than ``dt_sec`` [s]... input in
+    seconds; returns list of index arrays relative to the input."""
+    if len(toas) == 0:
+        return []
+    order = np.argsort(toas)
+    groups, cur = [], [order[0]]
+    for idx in order[1:]:
+        if toas[idx] - toas[cur[0]] <= dt_sec:
+            cur.append(idx)
+        else:
+            groups.append(np.array(cur))
+            cur = [idx]
+    groups.append(np.array(cur))
+    return groups
+
+
+class WhiteNoiseSignal:
+    """Diagonal measurement covariance: per-backend EFAC and EQUAD.
+
+    ``N_i = efac_b(i)^2 sigma_i^2 + 10^(2 log10_tnequad_b(i))`` (the tnequad
+    convention).  With ``vary=False`` the parameters are Constants (efac 1,
+    equad off) or come from a noise dictionary — mirroring
+    ``white_noise_block(vary, select)`` usage at reference
+    ``model_definition.py:219-228``.
+    """
+
+    name = "measurement_noise"
+
+    def __init__(self, toaerrs: np.ndarray, masks: dict,
+                 efac_by_backend: dict, equad_by_backend: dict | None):
+        self._sigma2 = toaerrs**2
+        labels = sorted(efac_by_backend)
+        self._masks = {lab: np.asarray(masks[lab], dtype=bool) for lab in labels}
+        self._efac = dict(efac_by_backend)
+        self._equad = dict(equad_by_backend) if equad_by_backend else None
+        self.params = [efac_by_backend[lab] for lab in labels]
+        if self._equad:
+            self.params += [self._equad[lab] for lab in labels]
+
+    def get_basis(self):
+        return None
+
+    def get_ndiag(self, params: dict):
+        vals = {}
+        for p in self.params:
+            vals[p.name] = p.value if isinstance(p, Constant) else params[p.name]
+        N = np.array(self._sigma2)
+        for lab, mask in self._masks.items():
+            efac = vals[self._efac[lab].name]
+            N[mask] = efac**2 * self._sigma2[mask]
+            if self._equad:
+                N[mask] += 10.0 ** (2.0 * vals[self._equad[lab].name])
+        return N

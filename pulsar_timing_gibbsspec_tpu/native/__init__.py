@@ -1,0 +1,3 @@
+from . import acor_native
+
+__all__ = ["acor_native"]

@@ -1,0 +1,37 @@
+"""ctypes binding for the C++ ACT estimator (graceful fallback when unbuilt)."""
+
+from __future__ import annotations
+
+import ctypes
+from pathlib import Path
+
+import numpy as np
+
+_LIB = None
+_TRIED = False
+
+
+def _load():
+    global _LIB, _TRIED
+    if _TRIED:
+        return _LIB
+    _TRIED = True
+    so = Path(__file__).parent / "libptgibbs_native.so"
+    if so.exists():
+        lib = ctypes.CDLL(str(so))
+        lib.ptg_integrated_act.restype = ctypes.c_double
+        lib.ptg_integrated_act.argtypes = [
+            ctypes.POINTER(ctypes.c_double), ctypes.c_long, ctypes.c_double]
+        _LIB = lib
+    return _LIB
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def act(x: np.ndarray, c: float = 5.0) -> float:
+    lib = _load()
+    x = np.ascontiguousarray(x, dtype=np.float64)
+    ptr = x.ctypes.data_as(ctypes.POINTER(ctypes.c_double))
+    return float(lib.ptg_integrated_act(ptr, len(x), c))

@@ -1,0 +1,3 @@
+from .acf import integrated_act
+
+__all__ = ["integrated_act"]

@@ -1,0 +1,47 @@
+"""Integrated autocorrelation time (ACT).
+
+The reference depends on the C++ ``acor`` extension to size its per-sweep MH
+sub-chains (``aclength_white = max_j ceil(acor(chain_j))``, reference
+``pulsar_gibbs.py:370-371``) — the ACT is load-bearing, not just a
+diagnostic (SURVEY §2.2).  This module provides a NumPy FFT implementation
+of the standard Sokal self-consistent-window estimator, and prefers the
+in-repo C++ implementation (``native/acor.cpp``) when its shared library has
+been built (``python -m pulsar_timing_gibbsspec_tpu.native.build``).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..native import acor_native
+
+
+def _autocorr_fft(x: np.ndarray) -> np.ndarray:
+    n = len(x)
+    x = x - x.mean()
+    nfft = 1 << (2 * n - 1).bit_length()
+    f = np.fft.rfft(x, nfft)
+    acf = np.fft.irfft(f * np.conj(f), nfft)[:n].real
+    if acf[0] <= 0:
+        return np.ones(1)
+    return acf / acf[0]
+
+
+def integrated_act(x: np.ndarray, c: float = 5.0) -> float:
+    """Sokal windowed integrated ACT: ``tau = 1 + 2 sum_t rho_t`` summed up
+    to the first window ``W >= c * tau(W)``.  Returns >= 1.0."""
+    x = np.asarray(x, dtype=np.float64)
+    if x.ndim != 1:
+        raise ValueError("integrated_act expects a 1-d chain")
+    if len(x) < 4 or np.ptp(x) == 0:
+        return 1.0
+    if acor_native.available():
+        return acor_native.act(x)
+    rho = _autocorr_fft(x)
+    tau = 2.0 * np.cumsum(rho) - 1.0
+    windows = np.arange(len(tau))
+    ok = windows >= c * tau
+    if not np.any(ok):
+        return float(max(tau[-1], 1.0))
+    w = np.argmax(ok)
+    return float(max(tau[w], 1.0))

@@ -1,0 +1,10 @@
+def __getattr__(name):
+    if name in ("PulsarBlockGibbs", "PTABlockGibbs"):
+        from . import gibbs
+
+        return getattr(gibbs, name)
+    if name == "NumpyGibbs":
+        from .numpy_backend import NumpyGibbs
+
+        return NumpyGibbs
+    raise AttributeError(name)

@@ -1,0 +1,89 @@
+"""Shared Gibbs-block bookkeeping: parameter index groups, prior bounds.
+
+Index groups are located by name fragment, matching the reference's
+conventions (``pulsar_gibbs.py:167-196``): rho <- 'rho', red <- 'log10_A' or
+'gamma', white <- 'efac' or 'equad', ecorr <- 'ecorr'.  Bounds come off the
+parameter objects directly instead of the reference's repr-string parsing
+(``pulsar_gibbs.py:82-87``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class BlockIndex:
+    """Positions of each Gibbs block inside the flat chain vector."""
+
+    names: list
+    rho: np.ndarray          # common free-spectrum log10_rho entries
+    red: np.ndarray          # per-pulsar power-law hypers (log10_A, gamma)
+    red_rho: np.ndarray      # per-pulsar free-spectrum entries ('red' + 'rho')
+    white: np.ndarray        # efac / equad entries
+    ecorr: np.ndarray        # ecorr entries
+
+    @classmethod
+    def build(cls, param_names: list) -> "BlockIndex":
+        rho, red, red_rho, white, ecorr = [], [], [], [], []
+        for ii, nm in enumerate(param_names):
+            if "rho" in nm and "gw" in nm:
+                rho.append(ii)
+            if ("log10_A" in nm or "gamma" in nm) and "gw" not in nm:
+                red.append(ii)
+            if "rho" in nm and "red" in nm:
+                red_rho.append(ii)
+            if "efac" in nm or "equad" in nm:
+                white.append(ii)
+            if "ecorr" in nm:
+                ecorr.append(ii)
+        arr = lambda v: np.asarray(v, dtype=np.int64)
+        return cls(list(param_names), arr(rho), arr(red), arr(red_rho),
+                   arr(white), arr(ecorr))
+
+
+def rho_bounds(pta, frag: str = "gw") -> tuple:
+    """(rho_min, rho_max) variance bounds: 10^(2 * log10_rho prior bounds)
+    for the free-spectrum parameter whose name contains ``frag`` — the
+    quantity the reference extracts at ``pulsar_gibbs.py:86-87``."""
+    for p in pta.params:
+        if "rho" in p.name and frag in p.name:
+            return 10.0 ** (2.0 * p.pmin), 10.0 ** (2.0 * p.pmax)
+    raise ValueError(f"no free-spectrum parameter matching '{frag}'")
+
+
+_U64 = (1 << 64) - 1
+
+
+def rng_state_pack(rng: np.random.Generator) -> np.ndarray:
+    """Serialize a PCG64 Generator state into uint64s (the 128-bit state and
+    increment split into halves) for the adapt.npz resume checkpoint."""
+    st = rng.bit_generator.state
+    s, inc = st["state"]["state"], st["state"]["inc"]
+    return np.array([s & _U64, s >> 64, inc & _U64, inc >> 64,
+                     int(st["has_uint32"]), st["uinteger"]], dtype=np.uint64)
+
+
+def rng_state_unpack(rng: np.random.Generator, packed: np.ndarray):
+    st = rng.bit_generator.state
+    p = [int(v) for v in packed]
+    st["state"]["state"] = p[0] | (p[1] << 64)
+    st["state"]["inc"] = p[2] | (p[3] << 64)
+    st["has_uint32"] = p[4]
+    st["uinteger"] = p[5]
+    rng.bit_generator.state = st
+
+
+def proposal_step(rng, x, idx, sigma):
+    """The reference's single-site scale-mixture proposal
+    (``pulsar_gibbs.py:344-351``): pick one coordinate of ``idx``, jump by
+    N(0,1) * sigma * scale with scale drawn from {0.1,0.5,1,3,10} at probs
+    {.1,.15,.5,.15,.1}."""
+    q = x.copy()
+    scale = rng.choice([0.1, 0.5, 1.0, 3.0, 10.0],
+                       p=[0.1, 0.15, 0.5, 0.15, 0.1])
+    par = rng.choice(idx)
+    q[par] += rng.standard_normal() * sigma * scale
+    return q

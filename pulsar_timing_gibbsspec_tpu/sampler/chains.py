@@ -1,0 +1,60 @@
+"""Chain persistence: periodic dumps, resume, adaptation-state checkpoints.
+
+The reference saves ``chain.npy``/``bchain.npy`` every 100 iterations
+(``pulsar_gibbs.py:701-710``) but its resume path reads ``chain.txt``
+(``:638``) — a mismatch SURVEY §5 flags — and never persists MH-adaptation
+state, so a resumed run would hit undefined ``aclength_white`` (latent bug,
+SURVEY §5).  Here both are fixed: resume reads what was written, and an
+``adapt.npz`` sidecar carries adaptation state (covariances, ACT lengths,
+RNG/PRNG state) so a resumed chain continues the same stochastic process.
+"""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+
+import numpy as np
+
+
+class ChainStore:
+    """Directory of: chain.npy, bchain.npy, pars_chain.txt, pars_bchain.txt,
+    adapt.npz."""
+
+    def __init__(self, outdir, param_names, b_param_names):
+        self.outdir = Path(outdir)
+        self.outdir.mkdir(parents=True, exist_ok=True)
+        self.param_names = list(param_names)
+        self.b_param_names = list(b_param_names)
+        np.savetxt(self.outdir / "pars_chain.txt", self.param_names, fmt="%s")
+        np.savetxt(self.outdir / "pars_bchain.txt", self.b_param_names, fmt="%s")
+
+    def save(self, chain, bchain, upto, adapt_state=None):
+        """Persist rows [0, upto) plus adaptation state, atomically enough
+        for a crash between files not to corrupt resume (write tmp, rename)."""
+        for nm, arr in (("chain.npy", chain), ("bchain.npy", bchain)):
+            tmp = self.outdir / (nm + ".tmp.npy")
+            np.save(tmp, arr[:upto])
+            os.replace(tmp, self.outdir / nm)
+        if adapt_state is not None:
+            tmp = self.outdir / "adapt.npz.tmp.npz"
+            np.savez(tmp, iter=np.int64(upto), **adapt_state)
+            os.replace(tmp, self.outdir / "adapt.npz")
+
+    def load_resume(self):
+        """Return (chain, bchain, start_iter, adapt_state) or None if there
+        is nothing to resume from."""
+        cpath = self.outdir / "chain.npy"
+        bpath = self.outdir / "bchain.npy"
+        if not (cpath.exists() and bpath.exists()):
+            return None
+        chain = np.load(cpath)
+        bchain = np.load(bpath)
+        upto = min(len(chain), len(bchain))
+        adapt = None
+        apath = self.outdir / "adapt.npz"
+        if apath.exists():
+            with np.load(apath) as z:
+                adapt = {k: z[k] for k in z.files}
+            upto = min(upto, int(adapt.pop("iter")))
+        return chain[:upto], bchain[:upto], upto, adapt

@@ -1,0 +1,215 @@
+"""User-facing Gibbs sampler facade with selectable execution backend.
+
+``PulsarBlockGibbs(pta, backend='jax'|'numpy')`` is the BASELINE.json
+north-star API: same constructor role and ``.sample(x0, outdir, niter,
+resume)`` surface as the reference class (``pulsar_gibbs.py:42,620``), with
+the execution path chosen by flag.  ``backend='numpy'`` runs the float64
+oracle on host; ``backend='jax'`` runs the jit-compiled device path.
+``PTABlockGibbs`` is the multi-pulsar variant (reference ``pta_gibbs.py``)
+sharing the same machinery with a common free-spectrum block.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from .chains import ChainStore
+from .numpy_backend import NumpyGibbs
+
+
+class _GibbsBase:
+    def __init__(self, pta, hypersample="conditional", ecorrsample="mh",
+                 redsample="mh", psr=None, backend="jax", seed=None,
+                 progress=True, **backend_opts):
+        self.pta = pta
+        self.backend_name = backend
+        self.progress = progress
+        if backend == "numpy":
+            self._backend = self._make_numpy(hypersample, redsample, seed,
+                                             backend_opts)
+        elif backend == "jax":
+            self._backend = self._make_jax(hypersample, redsample, seed,
+                                           backend_opts)
+        else:
+            raise ValueError(f"unknown backend '{backend}'")
+
+    # -- reference-compatible accessors -------------------------------------
+
+    @property
+    def params(self):
+        return self.pta.params
+
+    @property
+    def param_names(self):
+        return self.pta.param_names
+
+    def map_params(self, xs):
+        return self.pta.map_params(xs)
+
+    def initial_sample(self, rng=None):
+        return self.pta.initial_sample(rng)
+
+    @property
+    def b_param_names(self):
+        out = []
+        for pname in self.pta.pulsars:
+            m = self.pta.model(pname)
+            named = {}
+            for s in m.signals:
+                sl = m._slices[s.name]
+                for jj in range(sl.start, sl.stop):
+                    # shared Fourier columns: first (widest) signal wins,
+                    # matching the reference's one-name-per-column files
+                    named.setdefault(jj, f"{pname}_{s.name}_{jj - sl.start}")
+            out += [named[jj] for jj in sorted(named)]
+        return out
+
+    # -- main loop -----------------------------------------------------------
+
+    def sample(self, xs, outdir="./chains", niter=10000, resume=False,
+               save_every=100):
+        """Run ``niter`` Gibbs sweeps, persisting chains to ``outdir``
+        (reference ``sample`` at ``pulsar_gibbs.py:620-710``, with resume
+        reading what was saved and adaptation state checkpointed)."""
+        xs = np.atleast_1d(np.asarray(xs, dtype=np.float64))
+        npar = len(self.param_names)
+        if xs.shape != (npar,):
+            raise ValueError(
+                f"x0 has shape {xs.shape}; this model has {npar} parameters "
+                f"(see .param_names)")
+        store = ChainStore(outdir, self.param_names, self.b_param_names)
+
+        chain = np.zeros((niter, len(xs)))
+        bchain = np.zeros((niter, self._backend.nb_total))
+        start = 0
+        x = xs
+        if resume:
+            got = store.load_resume()
+            if got is not None:
+                prev_c, prev_b, upto, adapt = got
+                upto = min(upto, niter)
+                chain[:upto] = prev_c[:upto]
+                bchain[:upto] = prev_b[:upto]
+                start = upto
+                if upto > 0:
+                    x = chain[upto - 1].copy()
+                if adapt is not None:
+                    self._backend.load_adapt_state(adapt)
+                    # the post-sweep state (never a chain row yet): resuming
+                    # from it reproduces the uninterrupted process exactly
+                    x = getattr(self._backend, "x_resume", x)
+                elif upto > 0:
+                    raise RuntimeError(
+                        f"{outdir}: chain files exist but adapt.npz is "
+                        "missing; cannot resume the adapted sampler state "
+                        "(delete the directory to start fresh)")
+
+        t0 = time.time()
+        iterator = self._backend.run(x, chain, bchain, start, niter)
+        last_saved = start
+        for upto in iterator:
+            if upto - last_saved >= save_every or upto >= niter:
+                store.save(chain, bchain, upto,
+                           adapt_state=self._backend.adapt_state())
+                last_saved = upto
+                if self.progress:
+                    el = time.time() - t0
+                    done = upto - start
+                    rate = done / el if el > 0 else float("nan")
+                    print(f"\r[{self.backend_name}] {upto}/{niter} sweeps "
+                          f"({rate:.1f}/s)", end="", flush=True)
+        if self.progress:
+            print()
+        self.chain = chain
+        self.bchain = bchain
+        return chain
+
+
+class PulsarBlockGibbs(_GibbsBase):
+    """Single-pulsar blocked Gibbs (reference ``pulsar_gibbs.py``)."""
+
+    def _make_numpy(self, hypersample, redsample, seed, opts):
+        return _NumpySingleDriver(self.pta, hypersample, redsample, seed, opts)
+
+    def _make_jax(self, hypersample, redsample, seed, opts):
+        from .jax_backend import JaxGibbsDriver
+
+        return JaxGibbsDriver(self.pta, hypersample=hypersample,
+                              redsample=redsample, seed=seed, **opts)
+
+
+class PTABlockGibbs(_GibbsBase):
+    """Multi-pulsar blocked Gibbs with a common free spectrum (reference
+    ``pta_gibbs.py``)."""
+
+    def _make_numpy(self, hypersample, redsample, seed, opts):
+        from .numpy_pta import NumpyPTAGibbs
+
+        return _NumpyPTADriver(self.pta, hypersample, redsample, seed, opts)
+
+    def _make_jax(self, hypersample, redsample, seed, opts):
+        from .jax_backend import JaxGibbsDriver
+
+        return JaxGibbsDriver(self.pta, hypersample=hypersample,
+                              redsample=redsample, seed=seed, common_rho=True,
+                              **opts)
+
+
+class _NumpySingleDriver:
+    """Adapter: NumpyGibbs sweeps -> the facade's run/adapt-state protocol."""
+
+    def __init__(self, pta, hypersample, redsample, seed, opts):
+        self.g = NumpyGibbs(pta, hypersample=hypersample, redsample=redsample,
+                            seed=seed, **opts)
+        self.nb_total = pta.get_basis()[0].shape[1]
+
+    def run(self, x, chain, bchain, start, niter):
+        first = start == 0
+        self.x_cur = x
+        for ii in range(start, niter):
+            chain[ii] = self.x_cur
+            bchain[ii] = self.g.b
+            self.x_cur = self.g.sweep(self.x_cur, first=first and ii == 0)
+            yield ii + 1
+
+    def adapt_state(self):
+        out = self.g.adapt_state()
+        out["x_cur"] = np.asarray(self.x_cur)
+        return out
+
+    def load_adapt_state(self, state):
+        state = dict(state)
+        if "x_cur" in state:
+            self.x_resume = np.asarray(state.pop("x_cur"))
+        self.g.load_adapt_state(state)
+
+
+class _NumpyPTADriver:
+    def __init__(self, pta, hypersample, redsample, seed, opts):
+        from .numpy_pta import NumpyPTAGibbs
+
+        self.g = NumpyPTAGibbs(pta, hypersample=hypersample,
+                               redsample=redsample, seed=seed, **opts)
+        self.nb_total = sum(T.shape[1] for T in pta.get_basis())
+
+    def run(self, x, chain, bchain, start, niter):
+        first = start == 0
+        self.x_cur = x
+        for ii in range(start, niter):
+            chain[ii] = self.x_cur
+            bchain[ii] = np.concatenate(self.g.b)
+            self.x_cur = self.g.sweep(self.x_cur, first=first and ii == 0)
+            yield ii + 1
+
+    def adapt_state(self):
+        out = self.g.adapt_state()
+        out["x_cur"] = np.asarray(self.x_cur)
+        return out
+
+    def load_adapt_state(self, state):
+        state = dict(state)
+        if "x_cur" in state:
+            self.x_resume = np.asarray(state.pop("x_cur"))
+        self.g.load_adapt_state(state)

@@ -1,0 +1,364 @@
+"""NumPy oracle backend: multi-pulsar blocked Gibbs with a common spectrum.
+
+Reference semantics: ``pta_gibbs.py`` (experimental per the reference
+README).  The single cross-pulsar coupling is the common free-spectrum
+conditional — per-pulsar grid PDFs multiplied across pulsars before the
+inverse-CDF draw (``pta_gibbs.py:181-214``, product at ``:205``); everything
+else (white noise, intrinsic red, b-draws) is per-pulsar block-diagonal
+(CRN-only: reference ``:533`` assumes phi block-diagonal, SURVEY §3.6).
+
+Note on conventions: the reference's two files disagree cosmetically —
+``pta_gibbs.py:195`` uses ``tau = b_sin^2 + b_cos^2`` with
+``pdf ~ r exp(-r/2)`` while ``pulsar_gibbs.py:208-209`` uses
+``tau = (b_sin^2+b_cos^2)/2`` with ``r exp(-r)``; the two parameterizations
+define the same density, and this implementation uses the latter throughout.
+
+The sum-of-log-PDFs formulation here (product of per-pulsar PDFs == sum of
+logs) is exactly what the distributed backend turns into a ``psum`` over the
+pulsar-sharded mesh axis (SURVEY §2.3).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import scipy.linalg as sl
+
+from ..ops.acf import integrated_act
+from .blocks import BlockIndex, proposal_step, rho_bounds
+
+
+class NumpyPTAGibbs:
+    """Multi-pulsar oracle sampler: common GW free spectrum + per-pulsar
+    noise blocks."""
+
+    def __init__(self, pta, hypersample="conditional", redsample="conditional",
+                 white_adapt_iters=1000, red_adapt_iters=2000, red_steps=20,
+                 seed=None):
+        self.pta = pta
+        self.P = len(pta.pulsars)
+        self.hypersample = hypersample
+        self.redsample = redsample
+        self.white_adapt_iters = white_adapt_iters
+        self.red_adapt_iters = red_adapt_iters
+        self.red_steps = red_steps
+        self.rng = np.random.default_rng(seed)
+
+        self.idx = BlockIndex.build(pta.param_names)
+        self._y = pta.get_residuals()
+        self._T = pta.get_basis()
+        self.rhomin, self.rhomax = rho_bounds(pta, "gw")
+
+        self.gwid, self.red_sigs, self.gw_sigs, self.ecorr_sigs = [], [], [], []
+        self.ecid = []
+        #: per-pulsar positions (chain columns) of that pulsar's red
+        #: free-spectrum parameters — located by NAME, not model order, since
+        #: pta.param_names is name-sorted while pulsars keep insertion order
+        self.red_rho_idx = []
+        names = pta.param_names
+        for pname in pta.pulsars:
+            m = pta.model(pname)
+            sl_gw = m.basis_slice("gw")
+            self.gwid.append(np.arange(sl_gw.start, sl_gw.stop))
+            self.red_sigs.append(next((s for s in m.signals
+                                       if "red" in s.name), None))
+            self.gw_sigs.append(next(s for s in m.signals if "gw" in s.name))
+            ec = next((s for s in m.signals if "ecorr" in s.name), None)
+            self.ecorr_sigs.append(ec)
+            if ec is not None:
+                sl_ec = m.basis_slice("ecorr")
+                self.ecid.append(np.arange(sl_ec.start, sl_ec.stop))
+            else:
+                self.ecid.append(None)
+            self.red_rho_idx.append(np.array(
+                [ii for ii, nm in enumerate(names)
+                 if nm.startswith(f"{pname}_red_noise_log10_rho")], dtype=np.int64))
+        if len(self.idx.rho) and len(self.idx.rho) != len(self.gwid[0]) // 2:
+            raise ValueError(
+                "the common conditional rho draw requires exactly one "
+                "'spectrum' common process matching the GW mode count")
+
+        self.b = [np.zeros(T.shape[1]) for T in self._T]
+        self._TNT = None
+        self._d = None
+
+        self.aclength_white = None
+        self.cov_white = None
+        self.cov_red = None
+        self.aclength_ecorr = None
+
+    # ---- helpers -----------------------------------------------------------
+
+    def map_params(self, xs):
+        return self.pta.map_params(xs)
+
+    def get_lnprior(self, xs):
+        return self.pta.get_lnprior(xs)
+
+    def invalidate_cache(self):
+        self._TNT = None
+        self._d = None
+
+    def _ensure_cache(self, Nvecs):
+        if self._TNT is None:
+            self._TNT = [T.T @ (T / N[:, None]) for T, N in zip(self._T, Nvecs)]
+            self._d = [T.T @ (y / N) for T, y, N in zip(self._T, self._y, Nvecs)]
+
+    def _gw_tau(self, ii):
+        bb = self.b[ii][self.gwid[ii]] ** 2
+        return 0.5 * (bb[::2] + bb[1::2])
+
+    # ---- likelihoods -------------------------------------------------------
+
+    def lnlike_white(self, xs):
+        params = self.map_params(xs)
+        Nvecs = self.pta.get_ndiag(params)
+        out = 0.0
+        for ii in range(self.P):
+            r = self._y[ii] - self._T[ii] @ self.b[ii]
+            out += -0.5 * (np.sum(np.log(Nvecs[ii]))
+                           + np.sum(r * r / Nvecs[ii]))
+        return out
+
+    def lnlike_red(self, xs):
+        """b-conditional likelihood of all per-pulsar red hypers (sum of the
+        single-pulsar expressions)."""
+        params = self.map_params(xs)
+        out = 0.0
+        for ii in range(self.P):
+            if self.red_sigs[ii] is None:
+                continue
+            tau = self._gw_tau(ii)
+            kgw = len(tau)
+            raw = np.asarray(self.red_sigs[ii].get_phi(params))[::2]
+            irn = np.full(kgw, 1e-40)
+            n = min(kgw, len(raw))
+            irn[:n] = raw[:n]
+            gw = np.asarray(self.gw_sigs[ii].get_phi(params))[::2]
+            logratio = np.log(tau) - np.logaddexp(np.log(irn), np.log(gw))
+            out += float(np.sum(logratio - np.exp(logratio)))
+        return out
+
+    def lnlike_ecorr(self, xs):
+        """b-conditional likelihood of all per-pulsar ECORR variances."""
+        params = self.map_params(xs)
+        out = 0.0
+        for ii in range(self.P):
+            if self.ecorr_sigs[ii] is None:
+                continue
+            phi = np.asarray(self.ecorr_sigs[ii].get_phi(params))
+            bj = self.b[ii][self.ecid[ii]]
+            out += float(np.sum(-0.5 * np.log(phi) - 0.5 * bj * bj / phi))
+        return out
+
+    def lnlike_fullmarg(self, xs):
+        """Marginalized likelihood summed over pulsars (reference
+        ``pta_gibbs.py:577-621``)."""
+        params = self.map_params(xs)
+        Nvecs = self.pta.get_ndiag(params)
+        phinv = self.pta.get_phiinv(params, logdet=True)
+        self._ensure_cache(Nvecs)
+        out = 0.0
+        for ii in range(self.P):
+            out += -0.5 * (np.sum(np.log(Nvecs[ii]))
+                           + np.sum(self._y[ii] ** 2 / Nvecs[ii]))
+            phiinv_ii, logdet_phi = phinv[ii]
+            Sigma = self._TNT[ii] + np.diag(phiinv_ii)
+            try:
+                cf = sl.cho_factor(Sigma)
+            except np.linalg.LinAlgError:
+                return -np.inf
+            expval = sl.cho_solve(cf, self._d[ii])
+            logdet_sigma = 2.0 * np.sum(np.log(np.diag(cf[0])))
+            out += 0.5 * (self._d[ii] @ expval - logdet_sigma - logdet_phi)
+        return float(out)
+
+    # ---- conditional draws -------------------------------------------------
+
+    def draw_b(self, xs):
+        params = self.map_params(xs)
+        Nvecs = self.pta.get_ndiag(params)
+        phinv = self.pta.get_phiinv(params, logdet=False)
+        self._ensure_cache(Nvecs)
+        for ii in range(self.P):
+            Sigma = self._TNT[ii] + np.diag(phinv[ii])
+            u, s, _ = sl.svd(Sigma)
+            mn = u @ ((u.T @ self._d[ii]) / s)
+            Li = u * np.sqrt(1.0 / s)
+            self.b[ii] = mn + Li @ self.rng.standard_normal(len(mn))
+        return self.b
+
+    def _rho_log_pdf_grid(self, tau, other, grid):
+        """log conditional density of one pulsar's contribution on the rho
+        grid: r - e^r parameterization with r = log tau - log(other + rho)."""
+        logratio = (np.log(tau)[:, None]
+                    - np.logaddexp(np.log(other)[:, None], np.log(grid)[None, :]))
+        return logratio - np.exp(logratio)
+
+    def update_rho(self, xs):
+        """Common free-spectrum draw: per-pulsar log-PDF grids summed across
+        pulsars (== reference's PDF product, ``pta_gibbs.py:205``), then
+        inverse-CDF sampled."""
+        xnew = xs.copy()
+        params = self.map_params(xnew)
+        K = len(self.idx.rho)
+        grid = 10.0 ** np.linspace(np.log10(self.rhomin),
+                                   np.log10(self.rhomax), 1000)
+        logpdf = np.zeros((K, len(grid)))
+        for ii in range(self.P):
+            tau = self._gw_tau(ii)[:K]
+            if self.red_sigs[ii] is not None:
+                other = np.asarray(self.red_sigs[ii].get_phi(params))[::2][:K]
+            else:
+                other = np.full(K, 1e-40)
+            logpdf += self._rho_log_pdf_grid(tau, other, grid)
+        # Gumbel-max across the grid == inverse-CDF on the discrete pdf
+        gum = self.rng.gumbel(size=logpdf.shape)
+        rhonew = grid[np.argmax(logpdf + gum, axis=1)]
+        xnew[self.idx.rho] = 0.5 * np.log10(rhonew)
+        return xnew
+
+    def update_red(self, xs, adapt=False):
+        """Per-pulsar intrinsic red block.  'conditional' (free-spectrum red,
+        reference ``pta_gibbs.py:252-276``): grid draw per pulsar with the
+        common GW as the 'other' phi.  'mh' (power-law red): adaptive MH as
+        in the single-pulsar sampler."""
+        if self.redsample == "conditional" and len(self.idx.red_rho):
+            xnew = xs.copy()
+            params = self.map_params(xnew)
+            grid = 10.0 ** np.linspace(np.log10(self.rhomin_red),
+                                       np.log10(self.rhomax_red), 1000)
+            for ii in range(self.P):
+                if self.red_sigs[ii] is None or not len(self.red_rho_idx[ii]):
+                    continue
+                K = len(self.red_rho_idx[ii])
+                tau = self._gw_tau(ii)[:K]
+                gw = np.asarray(self.gw_sigs[ii].get_phi(params))[::2][:K]
+                logpdf = self._rho_log_pdf_grid(tau, gw, grid)
+                gum = self.rng.gumbel(size=logpdf.shape)
+                # assignment keyed by this pulsar's own chain columns
+                xnew[self.red_rho_idx[ii]] = 0.5 * np.log10(
+                    grid[np.argmax(logpdf + gum, axis=1)])
+            return xnew
+
+        rind = self.idx.red
+        if not len(rind):
+            return xs.copy()
+        if adapt:
+            rec = np.zeros((self.red_adapt_iters, len(rind)))
+            xnew = self._mh_loop(xs, rind, self.lnlike_fullmarg,
+                                 self.red_adapt_iters, 0.05 * len(rind), rec)
+            burn = rec[min(100, len(rec) // 2):]
+            self.cov_red = np.atleast_2d(np.cov(burn, rowvar=False))
+            self.cov_red += 1e-12 * np.eye(len(rind))
+            self._red_eigs = np.linalg.svd(self.cov_red)
+            return xnew
+        x = xs.copy()
+        ll0, lp0 = self.lnlike_red(x), self.get_lnprior(x)
+        U, S, _ = self._red_eigs
+        for _ in range(self.red_steps):
+            q = x.copy()
+            if self.rng.uniform() < 0.5:
+                j = self.rng.integers(len(rind))
+                q[rind] += 2.38 * np.sqrt(S[j]) * self.rng.standard_normal() * U[:, j]
+            else:
+                q = proposal_step(self.rng, x, rind, 0.05 * len(rind))
+            lp1 = self.get_lnprior(q)
+            ll1 = self.lnlike_red(q) if np.isfinite(lp1) else -np.inf
+            if (ll1 + lp1) - (ll0 + lp0) > np.log(self.rng.uniform()):
+                x, ll0, lp0 = q, ll1, lp1
+        return x
+
+    @property
+    def rhomin_red(self):
+        return rho_bounds(self.pta, "red")[0]
+
+    @property
+    def rhomax_red(self):
+        return rho_bounds(self.pta, "red")[1]
+
+    def _mh_loop(self, xs, idx, lnlike, nsteps, sigma, record=None):
+        x = xs.copy()
+        ll0, lp0 = lnlike(x), self.get_lnprior(x)
+        for ii in range(nsteps):
+            q = proposal_step(self.rng, x, idx, sigma)
+            lp1 = self.get_lnprior(q)
+            ll1 = lnlike(q) if np.isfinite(lp1) else -np.inf
+            if (ll1 + lp1) - (ll0 + lp0) > np.log(self.rng.uniform()):
+                x, ll0, lp0 = q, ll1, lp1
+            if record is not None:
+                record[ii] = x[idx]
+        return x
+
+    def update_white(self, xs, adapt=False):
+        wind = self.idx.white
+        sigma = 0.05 * len(wind)
+        if adapt:
+            rec = np.zeros((self.white_adapt_iters, len(wind)))
+            xnew = self._mh_loop(xs, wind, self.lnlike_white,
+                                 self.white_adapt_iters, sigma, rec)
+            burn = rec[min(100, len(rec) // 2):]
+            self.cov_white = np.atleast_2d(np.cov(burn, rowvar=False))
+            self.aclength_white = int(max(
+                1, max(int(integrated_act(burn[:, j])) for j in range(len(wind)))))
+            return xnew
+        return self._mh_loop(xs, wind, self.lnlike_white,
+                             self.aclength_white, sigma)
+
+    def update_ecorr(self, xs, adapt=False):
+        eind = self.idx.ecorr
+        sigma = 0.05 * len(eind)
+        if adapt:
+            rec = np.zeros((self.white_adapt_iters, len(eind)))
+            xnew = self._mh_loop(xs, eind, self.lnlike_ecorr,
+                                 self.white_adapt_iters, sigma, rec)
+            burn = rec[min(100, len(rec) // 2):]
+            self.aclength_ecorr = int(max(
+                1, max(int(integrated_act(burn[:, j])) for j in range(len(eind)))))
+            return xnew
+        return self._mh_loop(xs, eind, self.lnlike_ecorr,
+                             self.aclength_ecorr, sigma)
+
+    # ---- sweep -------------------------------------------------------------
+
+    def sweep(self, xs, first=False):
+        """Reference sweep order (``pta_gibbs.py:664-704``)."""
+        x = np.asarray(xs, dtype=np.float64).copy()
+        if first:
+            self.draw_b(x)
+        self.invalidate_cache()
+        if len(self.idx.white):
+            x = self.update_white(x, adapt=first)
+        if len(self.idx.ecorr) and any(s is not None for s in self.ecorr_sigs):
+            x = self.update_ecorr(x, adapt=first)
+        if len(self.idx.red) or len(self.idx.red_rho):
+            x = self.update_red(x, adapt=first)
+        if len(self.idx.rho):
+            x = self.update_rho(x)
+        self.draw_b(x)
+        return x
+
+    # ---- resume state ------------------------------------------------------
+
+    def adapt_state(self):
+        from .blocks import rng_state_pack
+
+        out = {"rng_state": rng_state_pack(self.rng)}
+        for ii, b in enumerate(self.b):
+            out[f"b{ii}"] = b
+        for key in ("aclength_white", "cov_white", "cov_red", "aclength_ecorr"):
+            val = getattr(self, key)
+            if val is not None:
+                out[key] = np.asarray(val)
+        return out
+
+    def load_adapt_state(self, state):
+        from .blocks import rng_state_unpack
+
+        rng_state_unpack(self.rng, state["rng_state"])
+        self.b = [np.asarray(state[f"b{ii}"]) for ii in range(self.P)]
+        for key in ("aclength_white", "cov_white", "cov_red", "aclength_ecorr"):
+            if key in state:
+                val = state[key]
+                setattr(self, key, int(val) if val.ndim == 0 else np.asarray(val))
+        if self.cov_red is not None:
+            self._red_eigs = np.linalg.svd(self.cov_red)

@@ -1,0 +1,146 @@
+import numpy as np
+import pytest
+
+from pulsar_timing_gibbsspec_tpu.models import model_general, Uniform, Constant
+from pulsar_timing_gibbsspec_tpu.models.psd import powerlaw, free_spectrum, turnover, broken_powerlaw
+from pulsar_timing_gibbsspec_tpu.models.orf import hd, orf_matrix
+from pulsar_timing_gibbsspec_tpu.models.priors import LinearExp
+
+
+def test_priors_basic():
+    u = Uniform(-9, -4, name="rho", size=3)
+    rng = np.random.default_rng(0)
+    x = u.sample(rng)
+    assert x.shape == (3,) and np.all((x >= -9) & (x <= -4))
+    assert u.get_logpdf(params={"rho": np.array([-5.0, -5.0, -5.0])}) == pytest.approx(3 * -np.log(5))
+    assert u.get_logpdf(params={"rho": np.array([-3.0, -5.0, -5.0])}) == -np.inf
+    # reference-style repr bound parsing still possible (pulsar_gibbs.py:84-87)
+    rep = str(u.params[0])
+    lohi = rep.split("(")[1].split(")")[0].split(", ")
+    assert float(lohi[0].split("=")[1]) == -9.0
+
+    le = LinearExp(-18, -11, name="A")
+    xs = le.sample(np.random.default_rng(1))
+    assert -18 <= xs <= -11
+
+
+def test_free_spectrum_and_powerlaw():
+    f = np.repeat([1e-9, 2e-9, 3e-9], 2)
+    df = 1e-9
+    phi = free_spectrum(f, df, np.array([-6.0, -7.0, -8.0]))
+    assert phi.shape == (6,)
+    np.testing.assert_allclose(phi[0], 1e-12)
+    np.testing.assert_allclose(phi[1], 1e-12)
+    np.testing.assert_allclose(phi[4], 1e-16)
+
+    pl = powerlaw(f, df, -14.0, 13.0 / 3.0)
+    assert pl.shape == (6,)
+    assert np.all(np.diff(pl[::2]) < 0)  # red spectrum decreasing
+    # turnover reduces low-frequency power relative to pure powerlaw
+    to = turnover(f, df, -14.0, 13.0 / 3.0, lf0=np.log10(2.5e-9))
+    assert to[0] < pl[0]
+    bp = broken_powerlaw(f, df, -14.0, 13.0 / 3.0)
+    assert np.all(bp > 0)
+
+
+def test_hd_orf():
+    a = np.array([1.0, 0, 0])
+    assert hd(a, a) == 1.0
+    b = np.array([-1.0, 0, 0])   # antipodal: HD -> ~0.25... actually
+    # standard HD at 180 deg: x=1, 1.5*1*log(1) - 0.25 + 0.5 = 0.25
+    assert hd(a, b) == pytest.approx(0.25)
+    c = np.array([0.0, 1.0, 0])  # 90 deg: x=0.5
+    assert hd(a, c) == pytest.approx(1.5 * 0.5 * np.log(0.5) - 0.125 + 0.5)
+    G = orf_matrix("hd", [a, b, c])
+    assert G.shape == (3, 3) and np.allclose(np.diag(G), 1.0)
+
+
+def test_model_general_freespec(j1713):
+    pta = model_general([j1713], red_var=False, white_vary=True,
+                        common_psd="spectrum", common_components=30)
+    names = pta.param_names
+    # 2 white + 30 rho
+    assert len(names) == 32
+    assert "J1713+0747_test_efac" in names
+    assert "gw_crn_log10_rho_0" in names
+    # white params come first (alphabetical: 'J' < 'g')
+    assert names[0].startswith("J1713")
+
+    x0 = pta.initial_sample(np.random.default_rng(42))
+    assert x0.shape == (32,)
+
+    T = pta.get_basis()[0]
+    m = j1713.Mmat.shape[1]
+    assert T.shape == (720, m + 60)
+
+    params = pta.map_params(x0)
+    phi = pta.get_phi(params)[0]
+    assert phi.shape == (m + 60,)
+    assert np.all(phi[:m] == 1e40)
+    rho = params["gw_crn_log10_rho"]
+    np.testing.assert_allclose(phi[m:m + 60], np.repeat(10 ** (2 * rho), 2))
+
+    N = pta.get_ndiag(params)[0]
+    efac = params["J1713+0747_test_efac"]
+    equad = params["J1713+0747_test_log10_tnequad"]
+    np.testing.assert_allclose(N, efac**2 * j1713.toaerrs**2 + 10 ** (2 * equad))
+
+    phiinv, ld = pta.get_phiinv(params, logdet=True)[0]
+    np.testing.assert_allclose(phiinv, 1 / phi)
+    assert ld == pytest.approx(np.sum(np.log(phi)))
+
+    # signals mapping exposes gw basis for index bookkeeping
+    sl = pta.model(0).basis_slice("gw")
+    assert sl == slice(m, m + 60)
+
+
+def test_model_general_with_red(j1713):
+    pta = model_general([j1713], red_var=True, red_components=30,
+                        white_vary=False, common_psd="spectrum",
+                        common_components=30)
+    names = pta.param_names
+    # no white (constants), 2 red hypers + 30 rho
+    assert len(names) == 32
+    assert "J1713+0747_red_noise_gamma" in names
+    assert "J1713+0747_red_noise_log10_A" in names
+
+    x0 = pta.initial_sample(np.random.default_rng(0))
+    params = pta.map_params(x0)
+    m = j1713.Mmat.shape[1]
+    T = pta.get_basis()[0]
+    # red and gw share the Fourier block
+    assert T.shape == (720, m + 60)
+    phi = pta.get_phi(params)[0]
+    gw = np.repeat(10 ** (2 * params["gw_crn_log10_rho"]), 2)
+    red_sig = pta.signals["J1713+0747_J1713+0747_red_noise"]
+    expected = gw + red_sig.get_phi(params)
+    np.testing.assert_allclose(phi[m:m + 60], expected, rtol=1e-12)
+
+    # constant white noise: N = sigma^2
+    N = pta.get_ndiag(params)[0]
+    np.testing.assert_allclose(N, j1713.toaerrs**2, rtol=1e-12)
+
+
+def test_model_general_powerlaw_common_fixed_gamma(psrs8):
+    pta = model_general(psrs8, red_var=True, white_vary=False,
+                        common_psd="powerlaw", gamma_common=13.0 / 3.0)
+    names = pta.param_names
+    assert "gw_crn_log10_A" in names
+    assert "gw_crn_gamma" not in names          # fixed -> Constant, not sampled
+    assert len(pta.pulsars) == 8
+    # common params deduped across pulsars
+    assert sum(1 for n in names if n == "gw_crn_log10_A") == 1
+
+
+def test_model_general_rejects_unsupported(j1713):
+    with pytest.raises(NotImplementedError):
+        model_general([j1713], bayesephem=True)
+    with pytest.raises(TypeError):
+        model_general([j1713], not_a_kwarg=1)
+
+
+def test_multi_orf(psrs8):
+    pta = model_general(psrs8, red_var=False, white_vary=False,
+                        common_psd="powerlaw", orf="crn,hd", orf_names="crn,hd")
+    names = pta.param_names
+    assert "gw_crn_log10_A" in names and "gw_hd_log10_A" in names
