@@ -56,7 +56,7 @@ def turnover_knee(f, df, log10_A, gamma, lfb=-8.5, lfk=-8.0, kappa=10.0 / 3.0, d
     A = 10.0 ** log10_A
     hcf = (A * (f / FYR) ** ((3.0 - gamma) / 2.0)
            * (1.0 + (f / 10.0**lfk) ** delta)
-           / np.sqrt(1.0 + (10.0**lfb / f) ** kappa))
+           / (1.0 + (10.0**lfb / f) ** kappa) ** 0.5)
     return hcf**2 / (12.0 * np.pi**2) / f**3 * df
 
 

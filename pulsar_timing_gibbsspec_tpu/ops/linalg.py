@@ -1,0 +1,75 @@
+"""Device linear algebra for the Gibbs b-draw and marginalized likelihood.
+
+The hot kernel is the per-pulsar factorization of ``Sigma = T^T N^-1 T +
+diag(phi^-1)`` (reference ``pulsar_gibbs.py:489-520`` uses LAPACK SVD with a
+QR fallback; ``:598-608`` uses Cholesky for the marginalized likelihood).
+On TPU the idiomatic form is a *batched* Cholesky over the pulsar axis on
+the MXU, in float32 made safe by Jacobi (diagonal) preconditioning:
+
+    A = D Sigma D,   D = diag(1/sqrt(diag(Sigma)))
+
+has unit diagonal and a condition number smaller by the ratio of the extreme
+diagonal entries of Sigma (here ~1e20 across timing-model vs red-noise
+columns), after which a float32 Cholesky is well-posed.  All functions
+broadcast over arbitrary leading batch dimensions and are jit/vmap-safe.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def precond_cholesky(Sigma):
+    """Jacobi-preconditioned Cholesky.
+
+    Returns ``(L, dj)`` where ``L`` is the lower Cholesky factor of
+    ``D Sigma D`` and ``dj`` the diagonal of ``D = diag(1/sqrt(diag Sigma))``.
+    """
+    diag = jnp.diagonal(Sigma, axis1=-2, axis2=-1)
+    dj = 1.0 / jnp.sqrt(diag)
+    A = Sigma * dj[..., :, None] * dj[..., None, :]
+    L = jnp.linalg.cholesky(A)
+    return L, dj
+
+
+def precond_solve(L, dj, v):
+    """``Sigma^-1 v`` given the preconditioned factor from
+    :func:`precond_cholesky`."""
+    u = jax.scipy.linalg.solve_triangular(L, dj * v, lower=True)
+    w = jax.scipy.linalg.solve_triangular(L, u, lower=True, trans=1)
+    return dj * w
+
+
+def precond_logdet(L, dj):
+    """``log det Sigma`` from the preconditioned factor:
+    ``logdet(D Sigma D) - 2 sum log dj``."""
+    ldiag = jnp.diagonal(L, axis1=-2, axis2=-1)
+    return 2.0 * jnp.sum(jnp.log(ldiag), axis=-1) - 2.0 * jnp.sum(
+        jnp.log(dj), axis=-1)
+
+
+def precond_sample(L, dj, mean, z):
+    """Draw ``N(mean, Sigma^-1)`` given the factor of Sigma: with
+    ``A = D Sigma D = L L^T``, ``x = mean + D L^-T z`` has covariance
+    ``D A^-1 D = Sigma^-1`` (the reference samples the same law through an
+    SVD square root, ``pulsar_gibbs.py:507-518``)."""
+    w = jax.scipy.linalg.solve_triangular(L, z, lower=True, trans=1)
+    return mean + dj * w
+
+
+def mvn_conditional_draw(TNT, phiinv, d, z):
+    """The complete b-draw kernel: mean ``Sigma^-1 d`` and a sample
+    ``mean + Sigma^-1/2 z`` for ``Sigma = TNT + diag(phiinv)``.
+
+    Batched over leading dims; returns ``(b, mean)``.
+    """
+    Sigma = TNT + _batched_diag(phiinv)
+    L, dj = precond_cholesky(Sigma)
+    mean = precond_solve(L, dj, d)
+    return precond_sample(L, dj, mean, z), mean
+
+
+def _batched_diag(v):
+    """diag embedding that broadcasts over leading batch dimensions."""
+    return v[..., :, None] * jnp.eye(v.shape[-1], dtype=v.dtype)

@@ -1,0 +1,556 @@
+"""Host PTA model -> static device representation.
+
+The reference pulls residuals, bases, ``Nvec`` and ``phi`` lazily out of
+enterprise Python objects on every parameter draw (``pulsar_gibbs.py:
+495-499``).  For a jit-compiled sweep everything the conditionals touch must
+instead be *compiled once* into padded, stacked arrays plus pure functions
+of the flat parameter vector ``x``:
+
+- ragged per-pulsar shapes (71-720 TOAs, differing basis widths and backend
+  counts across the 45 ``simulated_data/`` pulsars) are padded to common
+  ``(P, Nmax)`` / ``(P, Bmax)`` shapes with masks, so the whole PTA is one
+  SPMD batch a TPU mesh can shard over the pulsar axis (SURVEY §2.3)
+- every hyperparameter reference becomes an integer gather into the
+  "extended" vector ``xe = [x, constants, 0-sentinel]``, so varied vs fixed
+  parameters (enterprise ``Constant``) need no control flow on device
+- ``phi(x)`` is a scatter-add of per-GP-component contributions into the
+  basis columns, mirroring ``SignalModel.get_phi`` with shared Fourier
+  columns summing red + GW contributions
+
+Padding conventions (chosen so pads are exact no-ops, not approximations):
+TOA pads have ``y=0, T=0, sigma2=1, efac=1, equad=-40`` giving ``Nvec=1``
+(zero log-likelihood contribution); basis-column pads have ``phi=1`` so
+``Sigma`` gains a detached unit diagonal block whose Cholesky is trivial and
+whose sampled ``b`` entries multiply zero basis columns; dropped scatter /
+sentinel gather indices make missing components vanish.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from ..config import settings
+from ..models import psd as psdmod
+from ..models.priors import Constant, LinearExp, Normal, Uniform
+from .blocks import BlockIndex, rho_bounds
+
+#: prior-variance stand-in for "infinite" (marginalized timing-model
+#: columns).  1e40 matches enterprise in f64; f32 caps at 1e30 to stay below
+#: float32 max while remaining >=1e15 times any physical phi.
+BIG_PHI = {"f32": 1e30, "f64": 1e40}
+#: floor used where a red process has fewer modes than the GW grid
+#: (reference pads with a negligible value, see numpy_backend
+#: ``_red_phi_at_gw_freqs``)
+PHI_FLOOR = 1e-40
+
+_LN10 = np.log(10.0)
+_LN12PI2 = np.log(12.0 * np.pi ** 2)
+_LNFYR = np.log(psdmod.FYR)
+
+
+def _softplus(z):
+    import jax.numpy as jnp
+
+    return jnp.logaddexp(0.0, z)
+
+
+# Log-space PSD evaluation: the host functions in models/psd.py are exact in
+# float64, but their intermediates (A**2 ~ 1e-40, f**-gamma ~ 1e50) underflow
+# and overflow float32, producing 0 * inf = NaN.  On device every member of
+# the powerlaw family is therefore evaluated as exp(log phi), whose log-space
+# intermediates span only ~[-100, 100].
+
+def _lnphi_powerlaw(f, df, log10_A, gamma):
+    import jax.numpy as jnp
+
+    return (2.0 * _LN10 * log10_A - _LN12PI2 + (gamma - 3.0) * _LNFYR
+            - gamma * jnp.log(f) + jnp.log(df))
+
+
+def _lnphi_turnover(f, df, log10_A, gamma, lf0, kappa, beta):
+    import jax.numpy as jnp
+
+    lnf = jnp.log(f)
+    lnhc = (_LN10 * log10_A + 0.5 * (3.0 - gamma) * (lnf - _LNFYR)
+            - beta * _softplus(kappa * (_LN10 * lf0 - lnf)))
+    return 2.0 * lnhc - _LN12PI2 - 3.0 * lnf + jnp.log(df)
+
+
+def _lnphi_broken_powerlaw(f, df, log10_A, gamma, delta, log10_fb, kappa):
+    import jax.numpy as jnp
+
+    lnf = jnp.log(f)
+    lnhc = (_LN10 * log10_A + 0.5 * (3.0 - gamma) * (lnf - _LNFYR)
+            + 0.5 * kappa * (gamma - delta)
+            * _softplus((lnf - _LN10 * log10_fb) / kappa))
+    return 2.0 * lnhc - _LN12PI2 - 3.0 * lnf + jnp.log(df)
+
+
+def _lnphi_turnover_knee(f, df, log10_A, gamma, lfb, lfk, kappa, delta):
+    import jax.numpy as jnp
+
+    lnf = jnp.log(f)
+    lnhc = (_LN10 * log10_A + 0.5 * (3.0 - gamma) * (lnf - _LNFYR)
+            + _softplus(delta * (lnf - _LN10 * lfk))
+            - 0.5 * _softplus(kappa * (_LN10 * lfb - lnf)))
+    return 2.0 * lnhc - _LN12PI2 - 3.0 * lnf + jnp.log(df)
+
+
+_LNPSD_FNS = {
+    "powerlaw": _lnphi_powerlaw,
+    "turnover": _lnphi_turnover,
+    "turnover_knee": _lnphi_turnover_knee,
+    "broken_powerlaw": _lnphi_broken_powerlaw,
+}
+
+
+@dataclasses.dataclass
+class GPComponent:
+    """One Fourier-GP / ECORR component, stacked over pulsars.
+
+    ``cols`` are indices into the padded basis axis (pad = Bmax, dropped on
+    scatter); ``rho_ix``/``hyp_ix`` are gathers into ``xe`` (pad = sentinel).
+    """
+
+    kind: str                  # psd name, or 'ecorr'
+    cols: object               # (P, W) int32
+    f: object                  # (P, W) per-column frequency (powerlaw family)
+    df: object                 # (P, W) per-column bin width
+    hyp_ix: object             # (P, H) int32, powerlaw-family hyper refs
+    rho_ix: object             # (P, W) int32, free-spectrum/ecorr refs
+
+
+@dataclasses.dataclass
+class CompiledPTA:
+    """Static device model.  Arrays are jax on first use; built as NumPy."""
+
+    # -- static shape info ---------------------------------------------------
+    P: int                     # padded pulsar count
+    P_real: int                # true pulsar count
+    Nmax: int
+    Bmax: int
+    nx: int                    # number of free parameters
+    K: int                     # GW frequency count (0 if no gw signal)
+    Kr: int                    # red frequency count (0 if none)
+    widths: tuple              # true basis width per real pulsar
+    param_names: tuple
+    dtype: object
+    # -- data ----------------------------------------------------------------
+    y: object                  # (P, Nmax)
+    T: object                  # (P, Nmax, Bmax)
+    toa_mask: object           # (P, Nmax)
+    basis_mask: object         # (P, Bmax)
+    psr_mask: object           # (P,)
+    sigma2: object             # (P, Nmax)
+    efac_ix: object            # (P, Nmax) -> xe
+    equad_ix: object           # (P, Nmax) -> xe
+    const_pool: object         # (npool,)
+    phi_base: object           # (P, Bmax)
+    components: list
+    # -- priors --------------------------------------------------------------
+    pkind: object              # (nx,) 0 uniform / 1 normal / 2 linexp
+    pa: object                 # (nx,) pmin or mu
+    pb: object                 # (nx,) pmax or sigma
+    # -- Gibbs blocks --------------------------------------------------------
+    idx: BlockIndex
+    # -- GW / red conditional metadata ---------------------------------------
+    gw_sin_ix: object          # (P, K) -> b columns
+    gw_cos_ix: object          # (P, K)
+    gw_f: object               # (P, K) per-frequency
+    gw_df: object              # (P, K)
+    gw_kind: str               # 'free_spectrum' | powerlaw family | ''
+    gw_hyp_ix: object          # (P, H)
+    gw_rho_ix: object          # (P, K) -> xe (spectrum only)
+    rho_ix_x: object           # (K,) -> x, common rho write-back
+    red_valid: object          # (P,) 1.0 where the pulsar has intrinsic red
+    red_kind: str
+    red_hyp_ix: object         # (P, H)
+    red_rho_ix: object         # (P, Kr) -> xe
+    red_rho_ix_x: object       # (P, Kr) -> x, per-pulsar rho write-back
+    ec_cols: object            # (P, We) -> b columns (pad Bmax)
+    ec_ix: object              # (P, We) -> xe
+    rhomin: float
+    rhomax: float
+    red_rhomin: float
+    red_rhomax: float
+
+    # =======================================================================
+    # device-side pure functions (jit/vmap-safe; arrays close over as consts)
+    # =======================================================================
+
+    @property
+    def sentinel(self):
+        """Index of the fixed 0.0 slot in ``xe`` (pad gathers land here)."""
+        return self.nx
+
+    def xe(self, x):
+        import jax.numpy as jnp
+
+        return jnp.concatenate([
+            jnp.asarray(x, dtype=self.dtype),
+            jnp.zeros(1, dtype=self.dtype),
+            jnp.asarray(self.const_pool, dtype=self.dtype)])
+
+    def ndiag(self, x):
+        """(P, Nmax) diagonal measurement covariance
+        (``WhiteNoiseSignal.get_ndiag`` compiled to two gathers)."""
+        xev = self.xe(x)
+        efac = xev[self.efac_ix]
+        equad = xev[self.equad_ix]
+        return efac * efac * self.sigma2 + 10.0 ** (2.0 * equad)
+
+    def phi(self, x):
+        """(P, Bmax) per-column prior variance (pads = 1)."""
+        import jax.numpy as jnp
+
+        xev = self.xe(x)
+        phi = jnp.asarray(self.phi_base, dtype=self.dtype)
+        rows = jnp.arange(self.P)[:, None]
+        for c in self.components:
+            if c.kind in ("free_spectrum", "ecorr"):
+                vals = 10.0 ** (2.0 * xev[c.rho_ix])
+            else:
+                fn = _LNPSD_FNS[c.kind]
+                args = [xev[c.hyp_ix[:, h]][:, None]
+                        for h in range(c.hyp_ix.shape[1])]
+                vals = jnp.exp(fn(c.f, c.df, *args))
+            phi = phi.at[rows, c.cols].add(vals, mode="drop")
+        return phi
+
+    def lnprior(self, x):
+        import jax.numpy as jnp
+
+        x = jnp.asarray(x, dtype=self.dtype)
+        inside = (x >= self.pa) & (x <= self.pb)
+        ninf = jnp.array(-jnp.inf, dtype=self.dtype)
+        lp_u = jnp.where(inside, -jnp.log(self.pb - self.pa), ninf)
+        lp_n = (-0.5 * ((x - self.pa) / self.pb) ** 2
+                - jnp.log(self.pb * np.sqrt(2.0 * np.pi)))
+        dens = (np.log(10.0) * 10.0 ** x
+                / (10.0 ** self.pb - 10.0 ** self.pa))
+        lp_l = jnp.where(inside, jnp.log(dens), ninf)
+        per = jnp.where(self.pkind == 0, lp_u,
+                        jnp.where(self.pkind == 1, lp_n, lp_l))
+        return jnp.sum(per)
+
+    def gw_tau(self, b):
+        """(P, K) per-frequency ``(b_sin^2 + b_cos^2)/2``
+        (reference ``pulsar_gibbs.py:208-209``)."""
+        import jax.numpy as jnp
+
+        bs = jnp.take_along_axis(b, self.gw_sin_ix, axis=1)
+        bc = jnp.take_along_axis(b, self.gw_cos_ix, axis=1)
+        return 0.5 * (bs * bs + bc * bc)
+
+    def gw_phi(self, x):
+        """(P, K) GW prior variance per frequency (phi at the sin columns)."""
+        import jax.numpy as jnp
+
+        xev = self.xe(x)
+        if self.gw_kind == "free_spectrum":
+            return 10.0 ** (2.0 * xev[self.gw_rho_ix])
+        fn = _LNPSD_FNS[self.gw_kind]
+        args = [xev[self.gw_hyp_ix[:, h]][:, None]
+                for h in range(self.gw_hyp_ix.shape[1])]
+        return jnp.exp(fn(self.gw_f, self.gw_df, *args))
+
+    def red_phi(self, x):
+        """(P, K) intrinsic-red prior variance aligned to the GW grid,
+        floored at PHI_FLOOR beyond each pulsar's red mode count / where the
+        pulsar has no red process (oracle ``_red_phi_at_gw_freqs``)."""
+        import jax.numpy as jnp
+
+        xev = self.xe(x)
+        k = jnp.arange(self.K)
+        if self.red_kind == "":
+            return jnp.full((self.P, self.K), PHI_FLOOR, dtype=self.dtype)
+        if self.red_kind == "free_spectrum":
+            Kr = self.red_rho_ix.shape[1]
+            vals = 10.0 ** (2.0 * xev[self.red_rho_ix])  # (P, Kr)
+            out = jnp.full((self.P, self.K), PHI_FLOOR, dtype=self.dtype)
+            n = min(self.K, Kr)
+            out = out.at[:, :n].set(vals[:, :n])
+        else:
+            fn = _LNPSD_FNS[self.red_kind]
+            args = [xev[self.red_hyp_ix[:, h]][:, None]
+                    for h in range(self.red_hyp_ix.shape[1])]
+            vals = jnp.exp(fn(self.gw_f, self.gw_df, *args))
+            out = jnp.where(k[None, :] < self.Kr, vals, PHI_FLOOR)
+        return jnp.where(self.red_valid[:, None] > 0, out, PHI_FLOOR)
+
+
+def _as_i32(a):
+    return np.asarray(a, dtype=np.int32)
+
+
+def compile_pta(pta, pad_pulsars: int | None = None) -> CompiledPTA:
+    """Compile a host :class:`~..models.pta.PTA` into a CompiledPTA.
+
+    ``pad_pulsars``: total pulsar-axis length (>= len(pta.pulsars)); extra
+    slots are inert dummy pulsars so the axis divides a device-mesh size.
+    """
+    settings.apply()
+    np_dtype = np.float64 if settings.precision == "f64" else np.float32
+    big_phi = BIG_PHI[settings.precision if settings.precision in BIG_PHI
+                      else "f32"]
+
+    names = list(pta.param_names)
+    nx = len(names)
+    pos = {nm: ii for ii, nm in enumerate(names)}
+    pool: list = []
+
+    sentinel = nx  # fixed 0.0 slot in xe = [x, 0, const_pool]
+
+    def const_ref(value):
+        pool.append(float(value))
+        return nx + 1 + len(pool) - 1
+
+    def ref(p, elem=None):
+        """xe index of a scalar parameter (or element of a vector one)."""
+        if isinstance(p, Constant):
+            return const_ref(p.value)
+        nm = p.name if elem is None else f"{p.name}_{elem}"
+        return pos[nm]
+
+    models = [pta.model(ii) for ii in range(len(pta.pulsars))]
+    P_real = len(models)
+    P = pad_pulsars or P_real
+    if P < P_real:
+        raise ValueError("pad_pulsars smaller than the pulsar count")
+    Nmax = max(m.pulsar.ntoa for m in models)
+    widths = tuple(m.get_basis().shape[1] for m in models)
+    Bmax = max(widths)
+
+    efac1 = const_ref(1.0)
+    equad_off = const_ref(-40.0)
+
+    y = np.zeros((P, Nmax), np_dtype)
+    T = np.zeros((P, Nmax, Bmax), np_dtype)
+    toa_mask = np.zeros((P, Nmax), np_dtype)
+    basis_mask = np.zeros((P, Bmax), np_dtype)
+    psr_mask = np.zeros(P, np_dtype)
+    sigma2 = np.ones((P, Nmax), np_dtype)
+    efac_ix = np.full((P, Nmax), efac1, np.int32)
+    equad_ix = np.full((P, Nmax), equad_off, np.int32)
+    phi_base = np.ones((P, Bmax), np_dtype)
+
+    for ii, m in enumerate(models):
+        n, w = m.pulsar.ntoa, widths[ii]
+        y[ii, :n] = m.pulsar.residuals
+        T[ii, :n, :w] = m.get_basis()
+        toa_mask[ii, :n] = 1.0
+        basis_mask[ii, :w] = 1.0
+        psr_mask[ii] = 1.0
+        sigma2[ii, :n] = m.pulsar.toaerrs ** 2
+        if m.white is not None:
+            for lab, mask in m.white._masks.items():
+                where = np.where(mask)[0]
+                efac_ix[ii, where] = ref(m.white._efac[lab])
+                if m.white._equad:
+                    equad_ix[ii, where] = ref(m.white._equad[lab])
+        # timing-model columns: effectively-infinite prior variance
+        for s in m._timing:
+            sl_ = m._slices[s.name]
+            phi_base[ii, sl_] = big_phi
+        # GP columns start at 0 and accumulate component contributions
+        for s in m._fourier + m._ecorr:
+            sl_ = m._slices[s.name]
+            phi_base[ii, sl_.start:sl_.stop] = 0.0
+
+    # ---- GP components, grouped by position in the per-model signal lists --
+    components: list = []
+    n_fourier = {len(m._fourier) for m in models}
+    if len(n_fourier) > 1:
+        raise ValueError("pulsars disagree on Fourier signal count; the "
+                         "compiled batch requires a homogeneous model "
+                         "(build with model_general)")
+
+    comp_specs = []  # (kind, per-pulsar (cols, f, df, hyp_refs, rho_refs))
+    for c in range(n_fourier.pop() if n_fourier else 0):
+        kinds = {m._fourier[c].psd_name for m in models}
+        if len(kinds) > 1:
+            raise ValueError(f"Fourier signal #{c} has mixed PSDs {kinds}")
+        kind = kinds.pop()
+        rows = []
+        for m in models:
+            s = m._fourier[c]
+            sl_ = m._slices[s.name]
+            cols = np.arange(sl_.start, sl_.stop)
+            f, df = s.freqs, s._df
+            hyp, rho = [], []
+            if kind == "free_spectrum":
+                p = s.params[0]
+                rho = [ref(p, elem=j // 2) for j in range(len(cols))]
+            else:
+                hyp = [ref(p) for p in s.params]
+            rows.append((cols, f, df, hyp, rho))
+        comp_specs.append((kind, rows))
+    ec_rows = []
+    for m in models:
+        if m._ecorr:
+            s = m._ecorr[0]
+            sl_ = m._slices[s.name]
+            cols = np.arange(sl_.start, sl_.stop)
+            refs = [ref(s._by_backend[lab]) for lab in s._owners]
+            ec_rows.append((cols, refs))
+        else:
+            ec_rows.append((np.zeros(0, np.int64), []))
+    if any(len(r[0]) for r in ec_rows):
+        comp_specs.append(("ecorr", [
+            (cols, np.zeros(len(cols)), np.zeros(len(cols)), [], refs)
+            for cols, refs in ec_rows]))
+
+    def pad2(rows, fill, w=None):
+        w = w if w is not None else max((len(r) for r in rows), default=0)
+        out = np.full((P, w), fill)
+        for ii, r in enumerate(rows):
+            out[ii, :len(r)] = r
+        return out
+
+    for kind, rows in comp_specs:
+        W = max(len(r[0]) for r in rows)
+        H = max((len(r[3]) for r in rows), default=0)
+        components.append(GPComponent(
+            kind=kind,
+            cols=_as_i32(pad2([r[0] for r in rows], Bmax, W)),
+            f=pad2([r[1] for r in rows], 1.0, W).astype(np_dtype),
+            df=pad2([r[2] for r in rows], 0.0, W).astype(np_dtype),
+            hyp_ix=_as_i32(pad2([r[3] for r in rows], sentinel, H)),
+            rho_ix=_as_i32(pad2([r[4] for r in rows], sentinel, W)),
+        ))
+
+    # ---- GW / red conditional metadata -------------------------------------
+    gw_kind = red_kind = ""
+    K = Kr = 0
+    gw_sin = gw_cos = gw_f = gw_df = gw_hyp = gw_rho = None
+    red_hyp = red_rho = red_rho_x = None
+    red_valid = np.zeros(P, np_dtype)
+    rho_ix_x = np.zeros(0, np.int32)
+
+    def fsig(m, frag):
+        return next((s for s in m._fourier if frag in s.name), None)
+
+    if any(fsig(m, "gw") for m in models):
+        sigs = [fsig(m, "gw") for m in models]
+        K = max(len(s.freqs) // 2 for s in sigs if s is not None)
+        gw_sin = np.zeros((P, K), np.int32)
+        gw_cos = np.zeros((P, K), np.int32)
+        gw_f = np.ones((P, K), np_dtype)
+        gw_df = np.zeros((P, K), np_dtype)
+        gw_kind = next(s.psd_name for s in sigs if s is not None)
+        Hg = max((len(s.params) for s in sigs
+                  if s is not None and s.psd_name != "free_spectrum"),
+                 default=0)
+        gw_hyp = np.full((P, max(Hg, 1)), sentinel, np.int32)
+        gw_rho = np.full((P, K), sentinel, np.int32)
+        for ii, (m, s) in enumerate(zip(models, sigs)):
+            if s is None:
+                continue
+            sl_ = m._slices[s.name]
+            cols = np.arange(sl_.start, sl_.stop)
+            gw_sin[ii, :len(cols) // 2] = cols[::2]
+            gw_cos[ii, :len(cols) // 2] = cols[1::2]
+            gw_f[ii, :len(cols) // 2] = s.freqs[::2]
+            gw_df[ii, :len(cols) // 2] = s._df[::2]
+            if gw_kind == "free_spectrum":
+                p = s.params[0]
+                gw_rho[ii] = [ref(p, elem=k) for k in range(K)]
+            else:
+                gw_hyp[ii, :len(s.params)] = [ref(p) for p in s.params]
+        if gw_kind == "free_spectrum":
+            p = next(s.params[0] for s in sigs if s is not None)
+            if not isinstance(p, Constant):
+                rho_ix_x = _as_i32([pos[f"{p.name}_{k}"] for k in range(K)])
+
+    if any(fsig(m, "red") for m in models):
+        sigs = [fsig(m, "red") for m in models]
+        red_kind = next(s.psd_name for s in sigs if s is not None)
+        Kr = max(len(s.freqs) // 2 for s in sigs if s is not None)
+        Hr = max((len(s.params) for s in sigs
+                  if s is not None and s.psd_name != "free_spectrum"),
+                 default=0)
+        red_hyp = np.full((P, max(Hr, 1)), sentinel, np.int32)
+        red_rho = np.full((P, Kr), sentinel, np.int32)
+        red_rho_x = np.full((P, Kr), nx, np.int32)  # pad -> dropped scatter
+        for ii, (m, s) in enumerate(zip(models, sigs)):
+            if s is None:
+                continue
+            red_valid[ii] = 1.0
+            if red_kind == "free_spectrum":
+                p = s.params[0]
+                red_rho[ii] = [ref(p, elem=k) for k in range(Kr)]
+                if not isinstance(p, Constant):
+                    red_rho_x[ii] = [pos[f"{p.name}_{k}"] for k in range(Kr)]
+            else:
+                red_hyp[ii, :len(s.params)] = [ref(p) for p in s.params]
+
+    # ---- ECORR b-columns (for the ECORR conditional likelihood) ------------
+    We = max((len(r[0]) for r in ec_rows), default=0)
+    ec_cols = _as_i32(pad2([r[0] for r in ec_rows], Bmax, We)
+                      if We else np.zeros((P, 0)))
+    ec_ix = _as_i32(pad2([r[1] for r in ec_rows], sentinel, We)
+                    if We else np.zeros((P, 0)))
+
+    # ---- priors ------------------------------------------------------------
+    pkind = np.zeros(nx, np.int32)
+    pa = np.zeros(nx, np_dtype)
+    pb = np.ones(nx, np_dtype)
+    ct = 0
+    for p in pta.params:
+        nsc = p.size if p.size else 1
+        if isinstance(p, Uniform):
+            kind, a, b_ = 0, p.pmin, p.pmax
+        elif isinstance(p, Normal):
+            kind, a, b_ = 1, p.mu, p.sigma
+        elif isinstance(p, LinearExp):
+            kind, a, b_ = 2, p.pmin, p.pmax
+        else:
+            raise NotImplementedError(
+                f"prior {type(p).__name__} not supported on device")
+        pkind[ct:ct + nsc] = kind
+        pa[ct:ct + nsc] = a
+        pb[ct:ct + nsc] = b_
+        ct += nsc
+
+    try:
+        rhomin, rhomax = rho_bounds(pta, "gw")
+    except ValueError:
+        rhomin, rhomax = 1e-20, 1e-8
+    try:
+        red_rhomin, red_rhomax = rho_bounds(pta, "red")
+    except ValueError:
+        red_rhomin, red_rhomax = rhomin, rhomax
+
+    zeros_pk = np.zeros((P, max(K, 1)), np_dtype)
+    return CompiledPTA(
+        P=P, P_real=P_real, Nmax=Nmax, Bmax=Bmax, nx=nx, K=K, Kr=Kr,
+        widths=widths, param_names=tuple(names), dtype=np_dtype,
+        y=y, T=T, toa_mask=toa_mask, basis_mask=basis_mask, psr_mask=psr_mask,
+        sigma2=sigma2, efac_ix=efac_ix, equad_ix=equad_ix,
+        const_pool=np.asarray(pool, np_dtype), phi_base=phi_base,
+        components=components,
+        pkind=pkind, pa=pa, pb=pb,
+        idx=BlockIndex.build(names),
+        gw_sin_ix=_as_i32(gw_sin if gw_sin is not None else zeros_pk),
+        gw_cos_ix=_as_i32(gw_cos if gw_cos is not None else zeros_pk),
+        gw_f=(gw_f if gw_f is not None else np.ones((P, max(K, 1)), np_dtype)),
+        gw_df=(gw_df if gw_df is not None else zeros_pk),
+        gw_kind=gw_kind,
+        gw_hyp_ix=(gw_hyp if gw_hyp is not None
+                   else np.full((P, 1), sentinel, np.int32)),
+        gw_rho_ix=(gw_rho if gw_rho is not None
+                   else np.full((P, max(K, 1)), sentinel, np.int32)),
+        rho_ix_x=rho_ix_x,
+        red_valid=red_valid, red_kind=red_kind,
+        red_hyp_ix=(red_hyp if red_hyp is not None
+                    else np.full((P, 1), sentinel, np.int32)),
+        red_rho_ix=(red_rho if red_rho is not None
+                    else np.full((P, max(Kr, 1)), sentinel, np.int32)),
+        red_rho_ix_x=(red_rho_x if red_rho_x is not None
+                      else np.full((P, max(Kr, 1)), nx, np.int32)),
+        ec_cols=ec_cols, ec_ix=ec_ix,
+        rhomin=float(rhomin), rhomax=float(rhomax),
+        red_rhomin=float(red_rhomin), red_rhomax=float(red_rhomax),
+    )
