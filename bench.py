@@ -1,0 +1,105 @@
+"""Benchmark: Gibbs sweeps/sec on the full 45-pulsar simulated PTA.
+
+Prints ONE JSON line ``{"metric", "value", "unit", "vs_baseline"}``.
+
+The metric is steady-state (post-adaptation, post-compile) Gibbs iterations
+per second of the JAX device backend on the 45-pulsar ``simulated_data``
+array with varying white noise, per-pulsar free-spectrum red noise and a
+common free-spectrum GW process — the BASELINE.json north-star config.
+``vs_baseline`` is the speedup over the in-repo float64 NumPy oracle
+(reference semantics, single CPU) measured on the same model in the same
+process; the north-star target is >= 20x.
+
+Usage: python bench.py [--quick] [--niter N] [--numpy-iters N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+REFDATA = "/root/reference/simulated_data"
+
+
+def build_pta(n_psr=45, nbins=10):
+    from pulsar_timing_gibbsspec_tpu.data import load_directory
+    from pulsar_timing_gibbsspec_tpu.models.factory import model_general
+
+    psrs = load_directory(
+        REFDATA, inject=dict(log10_A=np.log10(2e-15), gamma=13.0 / 3.0))
+    psrs = psrs[:n_psr]
+    return model_general(
+        psrs, tm_svd=True, white_vary=True,
+        common_psd="spectrum", common_components=nbins,
+        red_var=True, red_psd="spectrum", red_components=nbins)
+
+
+def bench_jax(pta, x0, niter, adapt_iters):
+    from pulsar_timing_gibbsspec_tpu.sampler.jax_backend import JaxGibbsDriver
+
+    drv = JaxGibbsDriver(pta, seed=1, common_rho=True,
+                         white_adapt_iters=adapt_iters, chunk_size=100)
+    n = len(pta.param_names)
+    chain = np.zeros((niter, n))
+    bchain = np.zeros((niter, drv.nb_total))
+    it = drv.run(x0, chain, bchain, 0, niter)
+    next(it)                   # first sweep: adaptation + compilation
+    t0 = time.time()
+    warm = next(it)            # first chunk: includes sweep-kernel compile
+    t1 = time.time()
+    done = warm
+    for done in it:
+        pass
+    t2 = time.time()
+    steady = (niter - warm) / (t2 - t1) if niter > warm else (
+        (warm - 1) / (t1 - t0))
+    assert np.all(np.isfinite(chain)), "non-finite chain values"
+    return steady
+
+
+def bench_numpy(pta, x0, niter, adapt_iters):
+    from pulsar_timing_gibbsspec_tpu.sampler.numpy_pta import NumpyPTAGibbs
+
+    g = NumpyPTAGibbs(pta, seed=2, white_adapt_iters=adapt_iters)
+    x = g.sweep(x0, first=True)      # adaptation, untimed
+    t0 = time.time()
+    for _ in range(niter):
+        x = g.sweep(x)
+    return niter / (time.time() - t0)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true",
+                    help="8 pulsars, fewer iterations (smoke test)")
+    ap.add_argument("--niter", type=int, default=None)
+    ap.add_argument("--numpy-iters", type=int, default=None)
+    args = ap.parse_args(argv)
+
+    n_psr = 8 if args.quick else 45
+    niter = args.niter or (300 if args.quick else 1000)
+    np_iters = args.numpy_iters or (10 if args.quick else 20)
+    adapt = 300 if args.quick else 1000
+
+    pta = build_pta(n_psr=n_psr)
+    x0 = pta.initial_sample(np.random.default_rng(0))
+
+    jax_rate = bench_jax(pta, x0, niter, adapt)
+    np_rate = bench_numpy(pta, np.asarray(x0, np.float64), np_iters, adapt)
+
+    print(json.dumps({
+        "metric": f"gibbs_sweeps_per_sec_{n_psr}psr_pta",
+        "value": round(float(jax_rate), 2),
+        "unit": "it/s",
+        "vs_baseline": round(float(jax_rate / np_rate), 2),
+    }))
+    print(f"# numpy oracle: {np_rate:.2f} it/s (single CPU, f64); "
+          f"target >= 20x", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -20,8 +20,18 @@ import os
 class Settings:
     """Process-wide knobs (read at model-compile time, not per-op)."""
 
-    #: device compute precision: "f32" (default, preconditioned) or "f64"
+    #: storage precision of the large device arrays (basis matrices,
+    #: residuals): "f32" (default) or "f64"
     precision: str = os.environ.get("PTGIBBS_PRECISION", "f32")
+
+    #: compute precision for sampler state, reductions and factorizations:
+    #: "f64" (default) or "f32".  Mixed f32-storage/f64-compute is the
+    #: validated scheme: the conditional means Sigma^-1 d lose ~kappa*eps
+    #: relative accuracy, and kappa ~ 1e4 makes f32 means wrong at the
+    #: several-percent level on the smallest Fourier coefficients (which
+    #: biases the rho_k conditional); f64 compute on f32 data is exact to
+    #: ~1e-7 data precision while the flop-heavy einsums keep f32 inputs.
+    compute_precision: str = os.environ.get("PTGIBBS_COMPUTE", "f64")
 
     #: sweeps per device dispatch in the jitted sampler (chain is written
     #: back to host every chunk; also the checkpoint cadence)
@@ -35,7 +45,7 @@ class Settings:
         """Push precision into the JAX config.  Called once at model-compile
         entry (not from dtype accessors — enabling x64 is a process-wide,
         effectively one-way switch that must precede any traced op)."""
-        if self.precision == "f64":
+        if self.precision == "f64" or self.compute_precision == "f64":
             import jax
 
             jax.config.update("jax_enable_x64", True)
@@ -44,6 +54,12 @@ class Settings:
         import jax.numpy as jnp
 
         return jnp.float64 if self.precision == "f64" else jnp.float32
+
+    def compute_dtype(self):
+        import jax.numpy as jnp
+
+        return (jnp.float64 if self.compute_precision == "f64"
+                else self.real_dtype())
 
 
 settings = Settings()

@@ -1,0 +1,64 @@
+// Native host components for the TPU Gibbs framework.
+//
+// The reference depends on the third-party C++ `acor` extension for the
+// integrated autocorrelation time that sizes its per-sweep MH sub-chains
+// (reference pulsar_gibbs.py:7,370-371).  This file provides the in-repo
+// equivalent: a Sokal self-consistent-window ACT estimator (the same
+// definition as the NumPy fallback in ops/acf.py), exposed through a plain C
+// ABI consumed via ctypes (native/acor_native.py) — no pybind11 required.
+//
+// ptg_integrated_act: tau = 1 + 2 * sum_{t<=W} rho_t with the window W the
+// first lag satisfying W >= c * tau(W).  Runs in O(n * W) with incremental
+// autocovariances, which beats the FFT path for the ~1000-sample adaptation
+// chains this gates (W is typically < 100).
+//
+// ptg_integrated_act_many: column-wise ACT over a row-major (n, m) chain
+// block, returning the max over columns — exactly the quantity
+// `aclength_white = max_j ceil(act(chain_j))` the sampler needs, in one
+// native call.
+
+#include <cmath>
+#include <cstdint>
+#include <vector>
+
+extern "C" {
+
+double ptg_integrated_act(const double* x, long n, double c) {
+    if (n < 4) return 1.0;
+    double mean = 0.0;
+    for (long i = 0; i < n; ++i) mean += x[i];
+    mean /= (double)n;
+
+    std::vector<double> d((size_t)n);
+    double var = 0.0;
+    for (long i = 0; i < n; ++i) {
+        d[(size_t)i] = x[i] - mean;
+        var += d[(size_t)i] * d[(size_t)i];
+    }
+    if (var <= 0.0) return 1.0;
+
+    double tau = 1.0;
+    for (long t = 1; t < n; ++t) {
+        double acf = 0.0;
+        for (long i = 0; i + t < n; ++i) acf += d[(size_t)i] * d[(size_t)(i + t)];
+        tau += 2.0 * acf / var;
+        if ((double)t >= c * tau) {
+            return tau > 1.0 ? tau : 1.0;
+        }
+    }
+    return tau > 1.0 ? tau : 1.0;
+}
+
+double ptg_integrated_act_many(const double* x, long n, long m, double c) {
+    // x is row-major (n, m): x[i*m + j]
+    double worst = 1.0;
+    std::vector<double> col((size_t)n);
+    for (long j = 0; j < m; ++j) {
+        for (long i = 0; i < n; ++i) col[(size_t)i] = x[i * m + j];
+        double tau = ptg_integrated_act(col.data(), n, c);
+        if (tau > worst) worst = tau;
+    }
+    return worst;
+}
+
+}  // extern "C"

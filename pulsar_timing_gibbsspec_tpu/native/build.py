@@ -1,0 +1,37 @@
+"""Build the native shared library: ``python -m
+pulsar_timing_gibbsspec_tpu.native.build``.
+
+Compiles ``acor.cpp`` (and any future host-side C++ translation units) into
+``libptgibbs_native.so`` next to this file with the system ``g++``.  The
+pure-NumPy fallbacks in ``ops/acf.py`` keep everything working when the
+library has not been built; building it removes the ACT estimation from the
+Python hot path of the first (adaptation) sweep.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+from pathlib import Path
+
+HERE = Path(__file__).parent
+SOURCES = ["acor.cpp"]
+OUT = HERE / "libptgibbs_native.so"
+
+
+def build(verbose: bool = True) -> Path:
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+           *[str(HERE / s) for s in SOURCES], "-o", str(OUT)]
+    if verbose:
+        print(" ".join(cmd))
+    subprocess.run(cmd, check=True)
+    return OUT
+
+
+if __name__ == "__main__":
+    try:
+        path = build()
+    except (subprocess.CalledProcessError, FileNotFoundError) as err:
+        print(f"native build failed: {err}", file=sys.stderr)
+        sys.exit(1)
+    print(f"built {path}")

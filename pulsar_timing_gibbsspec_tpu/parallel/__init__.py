@@ -1,0 +1,16 @@
+"""Device-mesh parallelism for the multi-pulsar sweep.
+
+The scaling axis of this problem is **pulsars** (SURVEY §2.3): the 45-pulsar
+array is embarrassingly parallel except for one collective — the common
+free-spectrum conditional, where per-pulsar log-PDF grids are summed across
+the array (the reference's serial PDF product at ``pta_gibbs.py:205``).
+Sharding the pulsar axis of the compiled model over a ``jax.sharding.Mesh``
+turns every cross-pulsar ``jnp.sum`` in the sweep into an XLA all-reduce
+over ICI; no other communication exists in the algorithm.
+"""
+
+from .sharding import (make_mesh, pulsar_sharding, replicated_sharding,
+                       shard_compiled)
+
+__all__ = ["make_mesh", "pulsar_sharding", "replicated_sharding",
+           "shard_compiled"]

@@ -31,7 +31,10 @@ class BlockIndex:
         for ii, nm in enumerate(param_names):
             if "rho" in nm and "gw" in nm:
                 rho.append(ii)
-            if ("log10_A" in nm or "gamma" in nm) and "gw" not in nm:
+            # all powerlaw-family hypers, including a varied powerlaw
+            # *common* process — the reference sweeps those into the same
+            # MH block (get_red_param_indices, pulsar_gibbs.py:175-180)
+            if "log10_A" in nm or "gamma" in nm:
                 red.append(ii)
             if "rho" in nm and "red" in nm:
                 red_rho.append(ii)
@@ -74,6 +77,39 @@ def rng_state_unpack(rng: np.random.Generator, packed: np.ndarray):
     st["has_uint32"] = p[4]
     st["uinteger"] = p[5]
     rng.bit_generator.state = st
+
+
+def rho_grid(lo, hi, npts=None):
+    """Log-uniform variance grid for the numerical rho conditionals
+    (reference uses 1000 points, ``pulsar_gibbs.py:228``)."""
+    from ..config import settings
+
+    return 10.0 ** np.linspace(np.log10(lo), np.log10(hi),
+                               npts or settings.rho_grid_size)
+
+
+def rho_log_pdf_grid(tau, other, grid):
+    """log conditional density of one pulsar's free-spectrum contribution on
+    the rho grid: ``r - e^r`` with ``r = log tau - log(other + rho)``
+    (reference ``pulsar_gibbs.py:229-230``)."""
+    logratio = (np.log(tau)[:, None]
+                - np.logaddexp(np.log(other)[:, None], np.log(grid)[None, :]))
+    return logratio - np.exp(logratio)
+
+
+def gumbel_grid_draw(rng, logpdf, grid):
+    """Sample one grid point per row via the Gumbel-max trick (== inverse
+    CDF on the discrete pdf, reference ``pulsar_gibbs.py:233-234``)."""
+    gum = rng.gumbel(size=logpdf.shape)
+    return grid[np.argmax(logpdf + gum, axis=-1)]
+
+
+def align_phi(raw, k):
+    """Truncate/floor-pad a per-frequency phi array to ``k`` entries."""
+    out = np.full(k, 1e-40)
+    n = min(k, len(raw))
+    out[:n] = raw[:n]
+    return out
 
 
 def proposal_step(rng, x, idx, sigma):

@@ -69,7 +69,8 @@ def _lnphi_powerlaw(f, df, log10_A, gamma):
             - gamma * jnp.log(f) + jnp.log(df))
 
 
-def _lnphi_turnover(f, df, log10_A, gamma, lf0, kappa, beta):
+def _lnphi_turnover(f, df, log10_A, gamma, lf0=-8.5,
+                    kappa=10.0 / 3.0, beta=0.5):
     import jax.numpy as jnp
 
     lnf = jnp.log(f)
@@ -78,7 +79,8 @@ def _lnphi_turnover(f, df, log10_A, gamma, lf0, kappa, beta):
     return 2.0 * lnhc - _LN12PI2 - 3.0 * lnf + jnp.log(df)
 
 
-def _lnphi_broken_powerlaw(f, df, log10_A, gamma, delta, log10_fb, kappa):
+def _lnphi_broken_powerlaw(f, df, log10_A, gamma, delta=0.0,
+                           log10_fb=-8.5, kappa=0.1):
     import jax.numpy as jnp
 
     lnf = jnp.log(f)
@@ -88,7 +90,8 @@ def _lnphi_broken_powerlaw(f, df, log10_A, gamma, delta, log10_fb, kappa):
     return 2.0 * lnhc - _LN12PI2 - 3.0 * lnf + jnp.log(df)
 
 
-def _lnphi_turnover_knee(f, df, log10_A, gamma, lfb, lfk, kappa, delta):
+def _lnphi_turnover_knee(f, df, log10_A, gamma, lfb=-8.5, lfk=-8.0,
+                         kappa=10.0 / 3.0, delta=0.1):
     import jax.numpy as jnp
 
     lnf = jnp.log(f)
@@ -136,7 +139,8 @@ class CompiledPTA:
     Kr: int                    # red frequency count (0 if none)
     widths: tuple              # true basis width per real pulsar
     param_names: tuple
-    dtype: object
+    dtype: object              # storage dtype of the large arrays
+    cdtype: object             # compute dtype (state, reductions, solves)
     # -- data ----------------------------------------------------------------
     y: object                  # (P, Nmax)
     T: object                  # (P, Nmax, Bmax)
@@ -169,8 +173,18 @@ class CompiledPTA:
     red_hyp_ix: object         # (P, H)
     red_rho_ix: object         # (P, Kr) -> xe
     red_rho_ix_x: object       # (P, Kr) -> x, per-pulsar rho write-back
+    red_sin_ix: object         # (P, Kr) -> b columns (red signal's own grid)
+    red_cos_ix: object         # (P, Kr)
     ec_cols: object            # (P, We) -> b columns (pad Bmax)
     ec_ix: object              # (P, We) -> xe
+    #: per-pulsar positions (in x) of that pulsar's white-noise parameters
+    #: (pad nx) and their counts — the white conditional factorizes over
+    #: pulsars given b, so the device backend runs P independent
+    #: single-site MH chains in parallel (one per pulsar)
+    white_par_ix: object       # (P, Wp)
+    white_nper: object         # (P,)
+    ecorr_par_ix: object       # (P, Ep)
+    ecorr_nper: object         # (P,)
     rhomin: float
     rhomax: float
     red_rhomin: float
@@ -189,9 +203,9 @@ class CompiledPTA:
         import jax.numpy as jnp
 
         return jnp.concatenate([
-            jnp.asarray(x, dtype=self.dtype),
-            jnp.zeros(1, dtype=self.dtype),
-            jnp.asarray(self.const_pool, dtype=self.dtype)])
+            jnp.asarray(x, dtype=self.cdtype),
+            jnp.zeros(1, dtype=self.cdtype),
+            jnp.asarray(self.const_pool, dtype=self.cdtype)])
 
     def ndiag(self, x):
         """(P, Nmax) diagonal measurement covariance
@@ -206,7 +220,7 @@ class CompiledPTA:
         import jax.numpy as jnp
 
         xev = self.xe(x)
-        phi = jnp.asarray(self.phi_base, dtype=self.dtype)
+        phi = jnp.asarray(self.phi_base, dtype=self.cdtype)
         rows = jnp.arange(self.P)[:, None]
         for c in self.components:
             if c.kind in ("free_spectrum", "ecorr"):
@@ -222,9 +236,9 @@ class CompiledPTA:
     def lnprior(self, x):
         import jax.numpy as jnp
 
-        x = jnp.asarray(x, dtype=self.dtype)
+        x = jnp.asarray(x, dtype=self.cdtype)
         inside = (x >= self.pa) & (x <= self.pb)
-        ninf = jnp.array(-jnp.inf, dtype=self.dtype)
+        ninf = jnp.array(-jnp.inf, dtype=self.cdtype)
         lp_u = jnp.where(inside, -jnp.log(self.pb - self.pa), ninf)
         lp_n = (-0.5 * ((x - self.pa) / self.pb) ** 2
                 - jnp.log(self.pb * np.sqrt(2.0 * np.pi)))
@@ -234,6 +248,25 @@ class CompiledPTA:
         per = jnp.where(self.pkind == 0, lp_u,
                         jnp.where(self.pkind == 1, lp_n, lp_l))
         return jnp.sum(per)
+
+    def coord_logpdf(self, j, v):
+        """Prior log-density of value ``v`` for coordinate ``j`` (both
+        arbitrary-shaped arrays) — single-site MH needs only the changed
+        coordinate's prior delta, not the full ``lnprior``."""
+        import jax.numpy as jnp
+
+        j = jnp.minimum(j, self.nx - 1)
+        kind = jnp.asarray(self.pkind)[j]
+        a = jnp.asarray(self.pa, dtype=self.cdtype)[j]
+        b_ = jnp.asarray(self.pb, dtype=self.cdtype)[j]
+        inside = (v >= a) & (v <= b_)
+        ninf = jnp.array(-jnp.inf, dtype=self.cdtype)
+        lp_u = jnp.where(inside, -jnp.log(b_ - a), ninf)
+        lp_n = (-0.5 * ((v - a) / b_) ** 2
+                - jnp.log(b_ * np.sqrt(2.0 * np.pi)))
+        dens = np.log(10.0) * 10.0 ** v / (10.0 ** b_ - 10.0 ** a)
+        lp_l = jnp.where(inside, jnp.log(dens), ninf)
+        return jnp.where(kind == 0, lp_u, jnp.where(kind == 1, lp_n, lp_l))
 
     def gw_tau(self, b):
         """(P, K) per-frequency ``(b_sin^2 + b_cos^2)/2``
@@ -256,6 +289,29 @@ class CompiledPTA:
                 for h in range(self.gw_hyp_ix.shape[1])]
         return jnp.exp(fn(self.gw_f, self.gw_df, *args))
 
+    def red_tau(self, b):
+        """(P, Kr) per-frequency coefficient power on the *red* signal's own
+        columns — distinct from :meth:`gw_tau` when the red process has more
+        modes than the common one."""
+        import jax.numpy as jnp
+
+        bs = jnp.take_along_axis(b, self.red_sin_ix, axis=1)
+        bc = jnp.take_along_axis(b, self.red_cos_ix, axis=1)
+        return 0.5 * (bs * bs + bc * bc)
+
+    def gw_phi_at_red(self, x):
+        """(P, Kr) common-process phi aligned to the red frequency grid,
+        floored at PHI_FLOOR beyond the common mode count (the mirror image
+        of :meth:`red_phi`)."""
+        import jax.numpy as jnp
+
+        Kr = self.red_rho_ix_x.shape[1]
+        out = jnp.full((self.P, Kr), PHI_FLOOR, dtype=self.cdtype)
+        if self.K:
+            n = min(self.K, Kr)
+            out = out.at[:, :n].set(self.gw_phi(x)[:, :n])
+        return out
+
     def red_phi(self, x):
         """(P, K) intrinsic-red prior variance aligned to the GW grid,
         floored at PHI_FLOOR beyond each pulsar's red mode count / where the
@@ -265,11 +321,11 @@ class CompiledPTA:
         xev = self.xe(x)
         k = jnp.arange(self.K)
         if self.red_kind == "":
-            return jnp.full((self.P, self.K), PHI_FLOOR, dtype=self.dtype)
+            return jnp.full((self.P, self.K), PHI_FLOOR, dtype=self.cdtype)
         if self.red_kind == "free_spectrum":
             Kr = self.red_rho_ix.shape[1]
             vals = 10.0 ** (2.0 * xev[self.red_rho_ix])  # (P, Kr)
-            out = jnp.full((self.P, self.K), PHI_FLOOR, dtype=self.dtype)
+            out = jnp.full((self.P, self.K), PHI_FLOOR, dtype=self.cdtype)
             n = min(self.K, Kr)
             out = out.at[:, :n].set(vals[:, :n])
         else:
@@ -293,6 +349,8 @@ def compile_pta(pta, pad_pulsars: int | None = None) -> CompiledPTA:
     """
     settings.apply()
     np_dtype = np.float64 if settings.precision == "f64" else np.float32
+    np_cdtype = (np.float64 if settings.compute_precision == "f64"
+                 else np_dtype)
     big_phi = BIG_PHI[settings.precision if settings.precision in BIG_PHI
                       else "f32"]
 
@@ -425,7 +483,7 @@ def compile_pta(pta, pad_pulsars: int | None = None) -> CompiledPTA:
     gw_kind = red_kind = ""
     K = Kr = 0
     gw_sin = gw_cos = gw_f = gw_df = gw_hyp = gw_rho = None
-    red_hyp = red_rho = red_rho_x = None
+    red_hyp = red_rho = red_rho_x = red_sin = red_cos = None
     red_valid = np.zeros(P, np_dtype)
     rho_ix_x = np.zeros(0, np.int32)
 
@@ -456,7 +514,8 @@ def compile_pta(pta, pad_pulsars: int | None = None) -> CompiledPTA:
             gw_df[ii, :len(cols) // 2] = s._df[::2]
             if gw_kind == "free_spectrum":
                 p = s.params[0]
-                gw_rho[ii] = [ref(p, elem=k) for k in range(K)]
+                kp = min(K, p.size or 1)
+                gw_rho[ii, :kp] = [ref(p, elem=k) for k in range(kp)]
             else:
                 gw_hyp[ii, :len(s.params)] = [ref(p) for p in s.params]
         if gw_kind == "free_spectrum":
@@ -474,15 +533,23 @@ def compile_pta(pta, pad_pulsars: int | None = None) -> CompiledPTA:
         red_hyp = np.full((P, max(Hr, 1)), sentinel, np.int32)
         red_rho = np.full((P, Kr), sentinel, np.int32)
         red_rho_x = np.full((P, Kr), nx, np.int32)  # pad -> dropped scatter
+        red_sin = np.zeros((P, Kr), np.int32)
+        red_cos = np.zeros((P, Kr), np.int32)
         for ii, (m, s) in enumerate(zip(models, sigs)):
             if s is None:
                 continue
             red_valid[ii] = 1.0
+            sl_ = m._slices[s.name]
+            cols = np.arange(sl_.start, sl_.stop)
+            red_sin[ii, :len(cols) // 2] = cols[::2]
+            red_cos[ii, :len(cols) // 2] = cols[1::2]
             if red_kind == "free_spectrum":
                 p = s.params[0]
-                red_rho[ii] = [ref(p, elem=k) for k in range(Kr)]
+                kp = min(Kr, p.size or 1)
+                red_rho[ii, :kp] = [ref(p, elem=k) for k in range(kp)]
                 if not isinstance(p, Constant):
-                    red_rho_x[ii] = [pos[f"{p.name}_{k}"] for k in range(Kr)]
+                    red_rho_x[ii, :kp] = [pos[f"{p.name}_{k}"]
+                                          for k in range(kp)]
             else:
                 red_hyp[ii, :len(s.params)] = [ref(p) for p in s.params]
 
@@ -492,6 +559,28 @@ def compile_pta(pta, pad_pulsars: int | None = None) -> CompiledPTA:
                       if We else np.zeros((P, 0)))
     ec_ix = _as_i32(pad2([r[1] for r in ec_rows], sentinel, We)
                     if We else np.zeros((P, 0)))
+
+    # ---- per-pulsar white/ecorr parameter tables ---------------------------
+    wrows, erows = [], []
+    for m in models:
+        wp = []
+        if m.white is not None:
+            for pp in m.white.params:
+                if not isinstance(pp, Constant):
+                    wp.append(pos[pp.name])
+        wrows.append(sorted(set(wp)))
+        ep = []
+        for sig in m._ecorr:
+            for pp in sig.params:
+                if not isinstance(pp, Constant):
+                    ep.append(pos[pp.name])
+        erows.append(sorted(set(ep)))
+    Wp = max((len(r) for r in wrows), default=0)
+    Ep = max((len(r) for r in erows), default=0)
+    white_par_ix = _as_i32(pad2(wrows, nx, max(Wp, 1)))
+    white_nper = _as_i32([len(r) for r in wrows] + [0] * (P - P_real))
+    ecorr_par_ix = _as_i32(pad2(erows, nx, max(Ep, 1)))
+    ecorr_nper = _as_i32([len(r) for r in erows] + [0] * (P - P_real))
 
     # ---- priors ------------------------------------------------------------
     pkind = np.zeros(nx, np.int32)
@@ -527,6 +616,7 @@ def compile_pta(pta, pad_pulsars: int | None = None) -> CompiledPTA:
     return CompiledPTA(
         P=P, P_real=P_real, Nmax=Nmax, Bmax=Bmax, nx=nx, K=K, Kr=Kr,
         widths=widths, param_names=tuple(names), dtype=np_dtype,
+        cdtype=np_cdtype,
         y=y, T=T, toa_mask=toa_mask, basis_mask=basis_mask, psr_mask=psr_mask,
         sigma2=sigma2, efac_ix=efac_ix, equad_ix=equad_ix,
         const_pool=np.asarray(pool, np_dtype), phi_base=phi_base,
@@ -550,7 +640,13 @@ def compile_pta(pta, pad_pulsars: int | None = None) -> CompiledPTA:
                     else np.full((P, max(Kr, 1)), sentinel, np.int32)),
         red_rho_ix_x=(red_rho_x if red_rho_x is not None
                       else np.full((P, max(Kr, 1)), nx, np.int32)),
+        red_sin_ix=_as_i32(red_sin if red_sin is not None
+                           else np.zeros((P, max(Kr, 1)))),
+        red_cos_ix=_as_i32(red_cos if red_cos is not None
+                           else np.zeros((P, max(Kr, 1)))),
         ec_cols=ec_cols, ec_ix=ec_ix,
+        white_par_ix=white_par_ix, white_nper=white_nper,
+        ecorr_par_ix=ecorr_par_ix, ecorr_nper=ecorr_nper,
         rhomin=float(rhomin), rhomax=float(rhomax),
         red_rhomin=float(red_rhomin), red_rhomax=float(red_rhomax),
     )

@@ -54,9 +54,13 @@ def tnt_d(cm: CompiledPTA, Nvec):
     einsums are the MXU work of the sweep."""
     import jax.numpy as jnp
 
-    TN = cm.T / Nvec[:, :, None]
-    TNT = jnp.einsum("pnb,pnc->pbc", TN, cm.T)
-    d = jnp.einsum("pnb,pn->pb", TN, cm.y)
+    # storage-dtype (f32) inputs with compute-dtype (f64) accumulation: the
+    # multiplies ride the MXU, the sums are exact, and the only error left
+    # is the benign f32 rounding of the stored basis (backward error)
+    TN = cm.T / Nvec.astype(cm.dtype)[:, :, None]
+    TNT = jnp.einsum("pnb,pnc->pbc", TN, cm.T,
+                     preferred_element_type=cm.cdtype)
+    d = jnp.einsum("pnb,pn->pb", TN, cm.y, preferred_element_type=cm.cdtype)
     return TNT, d
 
 
@@ -66,8 +70,32 @@ def lnlike_white_fn(cm: CompiledPTA, x, r2):
     ``get_lnlikelihood_white``, ``pulsar_gibbs.py:523-546``)."""
     import jax.numpy as jnp
 
+    return jnp.sum(lnlike_white_per(cm, x, r2))
+
+
+def lnlike_white_per(cm: CompiledPTA, x, r2):
+    """Per-pulsar white-noise likelihood (P,) — the conditional factorizes
+    over pulsars given b, which is what lets the device backend run the
+    white MH as P independent parallel chains."""
+    import jax.numpy as jnp
+
     N = cm.ndiag(x)
-    return -0.5 * jnp.sum(cm.toa_mask * (jnp.log(N) + r2 / N))
+    return -0.5 * jnp.sum(cm.toa_mask * (jnp.log(N) + r2 / N), axis=1)
+
+
+def lnlike_ecorr_per(cm: CompiledPTA, x, b):
+    """Per-pulsar ECORR likelihood (P,)."""
+    import jax.numpy as jnp
+
+    if cm.ec_cols.shape[1] == 0:
+        return jnp.zeros(cm.P, dtype=cm.cdtype)
+    xev = cm.xe(x)
+    mask = (cm.ec_cols < cm.Bmax).astype(cm.cdtype)
+    bj = jnp.take_along_axis(b, jnp.minimum(cm.ec_cols, cm.Bmax - 1), axis=1)
+    l10e = xev[cm.ec_ix]
+    ln_phi = 2.0 * np.log(10.0) * l10e
+    return jnp.sum(mask * (-0.5 * ln_phi
+                           - 0.5 * bj * bj * 10.0 ** (-2.0 * l10e)), axis=1)
 
 
 def lnlike_red_fn(cm: CompiledPTA, x, tau):
@@ -85,7 +113,7 @@ def lnlike_ecorr_fn(cm: CompiledPTA, x, b):
     import jax.numpy as jnp
 
     if cm.ec_cols.shape[1] == 0:
-        return jnp.zeros((), dtype=cm.dtype)
+        return jnp.zeros((), dtype=cm.cdtype)
     xev = cm.xe(x)
     mask = (cm.ec_cols < cm.Bmax).astype(cm.dtype)
     bj = jnp.take_along_axis(b, jnp.minimum(cm.ec_cols, cm.Bmax - 1), axis=1)
@@ -125,7 +153,7 @@ def draw_b_fn(cm: CompiledPTA, x, key):
     N = cm.ndiag(x)
     TNT, d = tnt_d(cm, N)
     phi = cm.phi(x)
-    z = jr.normal(key, (cm.P, cm.Bmax), dtype=cm.dtype)
+    z = jr.normal(key, (cm.P, cm.Bmax), dtype=cm.cdtype)
     b, _ = mvn_conditional_draw(TNT, 1.0 / phi, d, z)
     return b
 
@@ -136,8 +164,8 @@ def _mh_step(cm: CompiledPTA, lnlike, ind, sigma):
     import jax.numpy as jnp
     import jax.random as jr
 
-    scales = jnp.asarray(_SCALES, dtype=cm.dtype)
-    probs = jnp.asarray(_SCALE_P, dtype=cm.dtype)
+    scales = jnp.asarray(_SCALES, dtype=cm.cdtype)
+    probs = jnp.asarray(_SCALE_P, dtype=cm.cdtype)
     ind = jnp.asarray(ind)
 
     def step(carry, key):
@@ -145,12 +173,12 @@ def _mh_step(cm: CompiledPTA, lnlike, ind, sigma):
         k1, k2, k3, k4 = jr.split(key, 4)
         scale = jr.choice(k1, scales, p=probs)
         j = ind[jr.randint(k2, (), 0, len(ind))]
-        q = x.at[j].add(jr.normal(k3, dtype=cm.dtype) * sigma * scale)
+        q = x.at[j].add(jr.normal(k3, dtype=cm.cdtype) * sigma * scale)
         lp1 = cm.lnprior(q)
         ll1 = lnlike(q)
         ok = jnp.isfinite(lp1) & jnp.isfinite(ll1)
         logr = jnp.where(ok, (ll1 + lp1) - (ll0 + lp0), -jnp.inf)
-        acc = logr > jnp.log(jr.uniform(k4, dtype=cm.dtype))
+        acc = logr > jnp.log(jr.uniform(k4, dtype=cm.cdtype))
         x = jnp.where(acc, q, x)
         ll0 = jnp.where(acc, ll1, ll0)
         lp0 = jnp.where(acc, lp1, lp0)
@@ -171,6 +199,57 @@ def mh_scan(cm: CompiledPTA, x, key, lnlike, ind, sigma, nsteps):
     return x, rec
 
 
+def parallel_mh_scan(cm: CompiledPTA, x, key, ll_per_fn, par_ix, nper,
+                     nsteps):
+    """P independent per-pulsar single-site MH chains, advanced in lockstep.
+
+    The white-noise (and ECORR) conditionals factorize over pulsars given b,
+    so one device step advances *every* pulsar's sub-chain at once: proposals
+    touch disjoint coordinate sets, ``ll_per_fn(x) -> (P,)`` gives per-pulsar
+    likelihoods, and acceptance is per pulsar.  This replaces the
+    reference's joint single-site walk over the whole white block
+    (``pulsar_gibbs.py:332-406``) with an exactly-equivalent product-measure
+    Gibbs block that does P times the mixing work per step — and needs no
+    cross-device collective when the pulsar axis is sharded.
+
+    Returns ``(x', recorded (nsteps, P, W) block coordinates)``.
+    """
+    import jax
+    import jax.numpy as jnp
+    import jax.random as jr
+
+    scales = jnp.asarray(_SCALES, dtype=cm.cdtype)
+    probs = jnp.asarray(_SCALE_P, dtype=cm.cdtype)
+    nper = jnp.asarray(nper)
+    par_ix = jnp.asarray(par_ix)
+    sigma = 0.05 * nper.astype(cm.cdtype)
+    live = nper > 0
+
+    def step(carry, key):
+        x, ll0 = carry
+        k1, k2, k3, k4 = jr.split(key, 4)
+        scale = jr.choice(k1, scales, (cm.P,), p=probs)
+        jloc = jnp.floor(jr.uniform(k2, (cm.P,), dtype=cm.cdtype)
+                         * jnp.maximum(nper, 1)).astype(jnp.int32)
+        j = jnp.take_along_axis(par_ix, jloc[:, None], axis=1)[:, 0]
+        noise = jr.normal(k3, (cm.P,), dtype=cm.cdtype) * sigma * scale
+        xj = x[jnp.minimum(j, cm.nx - 1)]
+        qj = xj + noise
+        dlp = cm.coord_logpdf(j, qj) - cm.coord_logpdf(j, xj)
+        q = x.at[j].add(noise, mode="drop")
+        ll1 = ll_per_fn(q)
+        ok = jnp.isfinite(dlp) & jnp.isfinite(ll1)
+        logr = jnp.where(ok, (ll1 - ll0) + dlp, -jnp.inf)
+        acc = (logr > jnp.log(jr.uniform(k4, (cm.P,), dtype=cm.cdtype))) & live
+        x = x.at[j].add(jnp.where(acc, noise, 0.0), mode="drop")
+        ll0 = jnp.where(acc, ll1, ll0)
+        return (x, ll0), x[jnp.minimum(par_ix, cm.nx - 1)]
+
+    (x, _), rec = jax.lax.scan(step, (x, ll_per_fn(x)),
+                               jr.split(key, nsteps))
+    return x, rec
+
+
 def red_mh_block(cm: CompiledPTA, x, tau, key, U, S, nsteps):
     """Per-sweep power-law red block: `nsteps` MH steps mixing adapted-
     eigendirection (SCAM, reference PTMCMC's workhorse jump) and the
@@ -183,26 +262,26 @@ def red_mh_block(cm: CompiledPTA, x, tau, key, U, S, nsteps):
     rind = jnp.asarray(cm.idx.red)
     sigma = 0.05 * len(cm.idx.red)
     lnlike = lambda q: lnlike_red_fn(cm, q, tau)
-    scales = jnp.asarray(_SCALES, dtype=cm.dtype)
-    probs = jnp.asarray(_SCALE_P, dtype=cm.dtype)
+    scales = jnp.asarray(_SCALES, dtype=cm.cdtype)
+    probs = jnp.asarray(_SCALE_P, dtype=cm.cdtype)
 
     def step(carry, key):
         x, ll0, lp0 = carry
         k0, k1, k2, k3, k4 = jr.split(key, 5)
         # SCAM branch: jump along one adapted covariance eigendirection
         j = jr.randint(k1, (), 0, len(cm.idx.red))
-        stepsz = 2.38 * jnp.sqrt(S[j]) * jr.normal(k2, dtype=cm.dtype)
+        stepsz = 2.38 * jnp.sqrt(S[j]) * jr.normal(k2, dtype=cm.cdtype)
         q_scam = x.at[rind].add(stepsz * U[:, j])
         # single-site branch
         scale = jr.choice(k1, scales, p=probs)
         jj = rind[jr.randint(k2, (), 0, len(cm.idx.red))]
-        q_ss = x.at[jj].add(jr.normal(k3, dtype=cm.dtype) * sigma * scale)
+        q_ss = x.at[jj].add(jr.normal(k3, dtype=cm.cdtype) * sigma * scale)
         q = jnp.where(jr.uniform(k0) < 0.5, q_scam, q_ss)
         lp1 = cm.lnprior(q)
         ll1 = lnlike(q)
         ok = jnp.isfinite(lp1) & jnp.isfinite(ll1)
         logr = jnp.where(ok, (ll1 + lp1) - (ll0 + lp0), -jnp.inf)
-        acc = logr > jnp.log(jr.uniform(k4, dtype=cm.dtype))
+        acc = logr > jnp.log(jr.uniform(k4, dtype=cm.cdtype))
         return (jnp.where(acc, q, x), jnp.where(acc, ll1, ll0),
                 jnp.where(acc, lp1, lp0)), None
 
@@ -215,7 +294,7 @@ def _rho_grid(cm: CompiledPTA, lo, hi):
     import jax.numpy as jnp
 
     return 10.0 ** jnp.linspace(np.log10(lo), np.log10(hi),
-                                settings.rho_grid_size, dtype=cm.dtype)
+                                settings.rho_grid_size, dtype=cm.cdtype)
 
 
 def rho_update(cm: CompiledPTA, x, b, key):
@@ -236,7 +315,7 @@ def rho_update(cm: CompiledPTA, x, b, key):
         t = tau[0]
         k1, = jr.split(key, 1)
         hi = 1.0 - jnp.exp(t / cm.rhomax - t / cm.rhomin)
-        eta = hi * jr.uniform(k1, t.shape, dtype=cm.dtype)
+        eta = hi * jr.uniform(k1, t.shape, dtype=cm.cdtype)
         rhonew = t / (t / cm.rhomax - jnp.log1p(-eta))
     else:
         grid = _rho_grid(cm, cm.rhomin, cm.rhomax)
@@ -246,7 +325,7 @@ def rho_update(cm: CompiledPTA, x, b, key):
                                     jnp.log(grid)[None, None, :]))
         logpdf = logratio - jnp.exp(logratio)
         logpdf = jnp.sum(cm.psr_mask[:, None, None] * logpdf, axis=0)
-        gum = jr.gumbel(key, logpdf.shape, dtype=cm.dtype)
+        gum = jr.gumbel(key, logpdf.shape, dtype=cm.cdtype)
         rhonew = grid[jnp.argmax(logpdf + gum, axis=-1)]
     return x.at[cm.rho_ix_x].set(
         (0.5 * jnp.log10(rhonew)).astype(x.dtype))
@@ -259,15 +338,14 @@ def red_conditional_update(cm: CompiledPTA, x, b, key):
     import jax.numpy as jnp
     import jax.random as jr
 
-    Kr = cm.red_rho_ix_x.shape[1]
-    tau = cm.gw_tau(b)[:, :Kr]
+    tau = cm.red_tau(b)
     grid = _rho_grid(cm, cm.red_rhomin, cm.red_rhomax)
-    other = cm.gw_phi(x)[:, :Kr]
+    other = cm.gw_phi_at_red(x)
     logratio = (jnp.log(tau)[:, :, None]
                 - jnp.logaddexp(jnp.log(other)[:, :, None],
                                 jnp.log(grid)[None, None, :]))
     logpdf = logratio - jnp.exp(logratio)
-    gum = jr.gumbel(key, logpdf.shape, dtype=cm.dtype)
+    gum = jr.gumbel(key, logpdf.shape, dtype=cm.cdtype)
     rhonew = grid[jnp.argmax(logpdf + gum, axis=-1)]  # (P, Kr)
     return x.at[cm.red_rho_ix_x].set(
         (0.5 * jnp.log10(rhonew)).astype(x.dtype), mode="drop")
@@ -276,7 +354,8 @@ def red_conditional_update(cm: CompiledPTA, x, b, key):
 def residual_sq(cm: CompiledPTA, b):
     import jax.numpy as jnp
 
-    r = cm.y - jnp.einsum("pnb,pb->pn", cm.T, b)
+    r = cm.y - jnp.einsum("pnb,pb->pn", cm.T, b.astype(cm.dtype),
+                          preferred_element_type=cm.cdtype)
     return r * r
 
 
@@ -287,9 +366,11 @@ def residual_sq(cm: CompiledPTA, b):
 class JaxGibbsDriver:
     """Backend implementing the facade's run/adapt-state protocol on device.
 
-    ``redsample`` is auto-selected from the model: 'conditional' for
-    free-spectrum intrinsic red (grid draw), 'mh' for the powerlaw family,
-    none when the model has no intrinsic red noise.
+    ``hypersample``/``redsample`` are accepted for reference-API
+    compatibility (the reference ctor takes them, ``pulsar_gibbs.py:42``)
+    but ignored: block activation is derived from the compiled model —
+    free-spectrum intrinsic red gets the per-pulsar grid draw, any
+    powerlaw-family hypers get the adaptive MH block.
     """
 
     def __init__(self, pta, hypersample="conditional", redsample=None,
@@ -315,10 +396,13 @@ class JaxGibbsDriver:
         self.common_rho = common_rho
 
         cm = self.cm
-        if redsample is None:
-            redsample = ("conditional" if cm.red_kind == "free_spectrum"
-                         else ("mh" if cm.red_kind else "none"))
-        self.redsample = redsample
+        # block activation follows the compiled model structure (mirrors the
+        # oracle sweeps): a red free-spectrum block gets the per-pulsar grid
+        # draw, any powerlaw-family hypers (per-pulsar red and/or a varied
+        # common process) get the adaptive MH block — independently
+        self.do_red_conditional = bool(np.any(np.asarray(cm.red_rho_ix_x)
+                                              < cm.nx))
+        self.do_red_mh = len(cm.idx.red) > 0
 
         # flat (pulsar, col) gather that turns padded (P, Bmax) b arrays
         # into the reference's concatenated per-pulsar layout
@@ -330,12 +414,11 @@ class JaxGibbsDriver:
 
         # adaptation state
         self.aclength_white = None
-        self.cov_white = None
         self.cov_red = None
         self.red_U = None
         self.red_S = None
         self.aclength_ecorr = None
-        self.b = np.zeros((cm.P, cm.Bmax), dtype=cm.dtype)
+        self.b = np.zeros((cm.P, cm.Bmax), dtype=cm.cdtype)
         self._sweep_fns = {}
 
         self._jit_draw_b = jax.jit(lambda x, k: draw_b_fn(cm, x, k))
@@ -350,38 +433,32 @@ class JaxGibbsDriver:
 
         cm = self.cm
         jr = self._jr
-        x = jax.numpy.asarray(x, dtype=cm.dtype)
+        x = jax.numpy.asarray(x, dtype=cm.cdtype)
 
         self.key, k = jr.split(self.key)
         b = self._jit_draw_b(x, k)
 
         if len(cm.idx.white):
             r2 = residual_sq(cm, b)
-            sigma = 0.05 * len(cm.idx.white)
             self.key, k = jr.split(self.key)
-            fn = jax.jit(lambda x, k: mh_scan(
-                cm, x, k, lambda q: lnlike_white_fn(cm, q, r2),
-                cm.idx.white, sigma, self.white_adapt_iters))
+            fn = jax.jit(lambda x, k: parallel_mh_scan(
+                cm, x, k, lambda q: lnlike_white_per(cm, q, r2),
+                cm.white_par_ix, cm.white_nper, self.white_adapt_iters))
             x, rec = fn(x, k)
-            rec = np.asarray(rec, dtype=np.float64)
-            burn = rec[min(100, len(rec) // 2):]
-            self.cov_white = np.atleast_2d(np.cov(burn, rowvar=False))
-            self.aclength_white = int(max(1, max(
-                int(integrated_act(burn[:, j])) for j in range(burn.shape[1]))))
+            self.aclength_white = self._act_from_rec(rec, cm.white_nper)
 
         if len(cm.idx.ecorr) and cm.ec_cols.shape[1]:
-            sigma = 0.05 * len(cm.idx.ecorr)
             self.key, k = jr.split(self.key)
-            fn = jax.jit(lambda x, k: mh_scan(
-                cm, x, k, lambda q: lnlike_ecorr_fn(cm, q, b),
-                cm.idx.ecorr, sigma, self.white_adapt_iters))
+            fn = jax.jit(lambda x, k: parallel_mh_scan(
+                cm, x, k, lambda q: lnlike_ecorr_per(cm, q, b),
+                cm.ecorr_par_ix, cm.ecorr_nper, self.white_adapt_iters))
             x, rec = fn(x, k)
-            rec = np.asarray(rec, dtype=np.float64)
-            burn = rec[min(100, len(rec) // 2):]
-            self.aclength_ecorr = int(max(1, max(
-                int(integrated_act(burn[:, j])) for j in range(burn.shape[1]))))
+            self.aclength_ecorr = self._act_from_rec(rec, cm.ecorr_nper)
 
-        if self.redsample == "mh" and len(cm.idx.red):
+        if self.do_red_conditional:
+            self.key, k = jr.split(self.key)
+            x = jax.jit(lambda x, k: red_conditional_update(cm, x, b, k))(x, k)
+        if self.do_red_mh:
             # covariance adaptation on the marginalized likelihood
             # (replaces the reference's scratch PTMCMCSampler,
             # pulsar_gibbs.py:288-315)
@@ -401,9 +478,6 @@ class JaxGibbsDriver:
             self.cov_red = (np.atleast_2d(np.cov(burn, rowvar=False))
                             + 1e-12 * np.eye(len(cm.idx.red)))
             self._set_red_eigs()
-        elif self.redsample == "conditional" and cm.red_rho_ix_x.shape[1]:
-            self.key, k = jr.split(self.key)
-            x = jax.jit(lambda x, k: red_conditional_update(cm, x, b, k))(x, k)
 
         if cm.K and len(cm.rho_ix_x):
             self.key, k = jr.split(self.key)
@@ -413,12 +487,25 @@ class JaxGibbsDriver:
         self.b = self._jit_draw_b(x, k)
         return x
 
+    def _act_from_rec(self, rec, nper):
+        """Max integrated ACT over every (pulsar, parameter) sub-chain of an
+        adaptation record (steps, P, W) — the static per-sweep scan length
+        (reference ``aclength_white``, ``pulsar_gibbs.py:367-371``)."""
+        rec = np.asarray(rec, dtype=np.float64)
+        burn = rec[min(100, len(rec) // 2):]
+        nper = np.asarray(nper)
+        worst = 1
+        for p in range(self.cm.P_real):
+            for w in range(int(nper[p])):
+                worst = max(worst, int(integrated_act(burn[:, p, w])))
+        return worst
+
     def _set_red_eigs(self):
         import jax.numpy as jnp
 
         U, S, _ = np.linalg.svd(self.cov_red)
-        self.red_U = jnp.asarray(U, dtype=self.cm.dtype)
-        self.red_S = jnp.asarray(S, dtype=self.cm.dtype)
+        self.red_U = jnp.asarray(U, dtype=self.cm.cdtype)
+        self.red_S = jnp.asarray(S, dtype=self.cm.cdtype)
 
     # ---- per-sweep kernel ---------------------------------------------------
 
@@ -435,22 +522,22 @@ class JaxGibbsDriver:
         def body(carry, key):
             x, b = carry
             out = (x, b)
-            k = jr.split(key, 5)
+            k = jr.split(key, 6)
             if len(cm.idx.white) and nw:
                 r2 = residual_sq(cm, b)
-                x, _ = mh_scan(cm, x, k[0],
-                               lambda q: lnlike_white_fn(cm, q, r2),
-                               cm.idx.white, 0.05 * len(cm.idx.white), nw)
+                x, _ = parallel_mh_scan(cm, x, k[0],
+                                        lambda q: lnlike_white_per(cm, q, r2),
+                                        cm.white_par_ix, cm.white_nper, nw)
             if len(cm.idx.ecorr) and ne and cm.ec_cols.shape[1]:
-                x, _ = mh_scan(cm, x, k[1],
-                               lambda q: lnlike_ecorr_fn(cm, q, b),
-                               cm.idx.ecorr, 0.05 * len(cm.idx.ecorr), ne)
-            if self.redsample == "mh" and len(cm.idx.red):
-                tau = cm.gw_tau(b)
-                x = red_mh_block(cm, x, tau, k[2], self.red_U, self.red_S,
-                                 self.red_steps)
-            elif self.redsample == "conditional" and cm.red_rho_ix_x.shape[1]:
+                x, _ = parallel_mh_scan(cm, x, k[1],
+                                        lambda q: lnlike_ecorr_per(cm, q, b),
+                                        cm.ecorr_par_ix, cm.ecorr_nper, ne)
+            if self.do_red_conditional:
                 x = red_conditional_update(cm, x, b, k[2])
+            if self.do_red_mh:
+                tau = cm.gw_tau(b)
+                x = red_mh_block(cm, x, tau, k[5], self.red_U, self.red_S,
+                                 self.red_steps)
             if cm.K and len(cm.rho_ix_x):
                 x = rho_update(cm, x, b, k[3])
             b = draw_b_fn(cm, x, k[4])
@@ -459,18 +546,24 @@ class JaxGibbsDriver:
         return body
 
     def _chunk_fn(self, n):
-        """Jitted scan of ``n`` sweeps (cached per length)."""
+        """Jitted scan of ``n`` sweeps (cached per length).
+
+        Per-sweep keys are ``fold_in(base_key, iteration)`` so the random
+        stream is a pure function of the iteration index — chunk boundaries
+        and checkpoint cadence cannot change the sampled process, which
+        makes resume bitwise-exact (fixing the reference's lost-adaptation
+        resume bug class, SURVEY §5)."""
         if n not in self._sweep_fns:
             import jax
             import jax.random as jr
 
             body = self._sweep_body()
 
-            def run_chunk(x, b, key):
-                key, sub = jr.split(key)
-                (x, b), (xs, bs) = jax.lax.scan(body, (x, b),
-                                                jr.split(sub, n))
-                return x, b, key, xs, bs
+            def run_chunk(x, b, base_key, it0):
+                keys = jax.vmap(lambda t: jr.fold_in(base_key, t))(
+                    it0 + jax.numpy.arange(n))
+                (x, b), (xs, bs) = jax.lax.scan(body, (x, b), keys)
+                return x, b, xs, bs
 
             self._sweep_fns[n] = jax.jit(run_chunk)
         return self._sweep_fns[n]
@@ -485,7 +578,7 @@ class JaxGibbsDriver:
         import jax.numpy as jnp
 
         cm = self.cm
-        x = jnp.asarray(np.asarray(x, dtype=np.float64), dtype=cm.dtype)
+        x = jnp.asarray(np.asarray(x, dtype=np.float64), dtype=cm.cdtype)
         ii = start
         if ii == 0:
             chain[0] = np.asarray(x, dtype=np.float64)
@@ -497,7 +590,8 @@ class JaxGibbsDriver:
         while ii < niter:
             n = min(self.chunk_size, niter - ii)
             fn = self._chunk_fn(n)
-            x, b, self.key, xs, bs = fn(x, jnp.asarray(self.b), self.key)
+            x, b, xs, bs = fn(x, jnp.asarray(self.b), self.key,
+                              jnp.asarray(ii, dtype=jnp.int32))
             self.b = b
             chain[ii:ii + n] = np.asarray(xs, dtype=np.float64)
             bchain[ii:ii + n] = self._b_flat(bs)
@@ -513,8 +607,7 @@ class JaxGibbsDriver:
         out = {"jax_key": np.asarray(jr.key_data(self.key)),
                "b_pad": np.asarray(self.b, dtype=np.float64),
                "x_cur": np.asarray(getattr(self, "x_cur", np.zeros(self.cm.nx)))}
-        for key in ("aclength_white", "cov_white", "cov_red",
-                    "aclength_ecorr"):
+        for key in ("aclength_white", "cov_red", "aclength_ecorr"):
             val = getattr(self, key)
             if val is not None:
                 out[key] = np.asarray(val)
@@ -526,11 +619,10 @@ class JaxGibbsDriver:
         state = dict(state)
         self.key = jr.wrap_key_data(
             np.asarray(state["jax_key"], dtype=np.uint32))
-        self.b = np.asarray(state["b_pad"], dtype=self.cm.dtype)
+        self.b = np.asarray(state["b_pad"], dtype=self.cm.cdtype)
         if "x_cur" in state:
             self.x_resume = np.asarray(state["x_cur"], dtype=np.float64)
-        for key in ("aclength_white", "cov_white", "cov_red",
-                    "aclength_ecorr"):
+        for key in ("aclength_white", "cov_red", "aclength_ecorr"):
             if key in state:
                 val = np.asarray(state[key])
                 setattr(self, key, int(val) if val.ndim == 0 else val)

@@ -27,7 +27,9 @@ import numpy as np
 import scipy.linalg as sl
 
 from ..ops.acf import integrated_act
-from .blocks import BlockIndex, proposal_step, rho_bounds
+from .blocks import (BlockIndex, align_phi, gumbel_grid_draw,
+                     proposal_step, rho_bounds, rho_grid,
+                     rho_log_pdf_grid)
 
 
 class NumpyGibbs:
@@ -53,9 +55,19 @@ class NumpyGibbs:
 
         gw_slice = self._model.basis_slice("gw")
         self.gwid = np.arange(gw_slice.start, gw_slice.stop)
-        self.rhomin, self.rhomax = rho_bounds(pta, "gw")
+        try:
+            self.rhomin, self.rhomax = rho_bounds(pta, "gw")
+        except ValueError:   # powerlaw-family common process: no rho block
+            self.rhomin, self.rhomax = 1e-20, 1e-8
+        try:
+            self.red_rhomin, self.red_rhomax = rho_bounds(pta, "red")
+        except ValueError:
+            self.red_rhomin, self.red_rhomax = self.rhomin, self.rhomax
 
         self.red_sig = next((s for s in self._model.signals if "red" in s.name), None)
+        if self.red_sig is not None:
+            rsl = self._model.basis_slice("red")
+            self.redid = np.arange(rsl.start, rsl.stop)
         self.gw_sig = next((s for s in self._model.signals if "gw" in s.name), None)
         if len(self.idx.rho) and len(self.idx.rho) != len(self.gwid) // 2:
             raise ValueError(
@@ -119,11 +131,9 @@ class NumpyGibbs:
         the red process has more modes, padded with a negligible floor when
         it has fewer (red and GW share leading Fourier columns)."""
         kgw = len(self.gwid) // 2
-        irn = np.asarray(self.red_sig.get_phi(params))[::2]
-        out = np.full(kgw, 1e-40)
-        n = min(kgw, len(irn))
-        out[:n] = irn[:n]
-        return out
+        if self.red_sig is None:
+            return np.full(kgw, 1e-40)
+        return align_phi(np.asarray(self.red_sig.get_phi(params))[::2], kgw)
 
     def lnlike_red(self, xs):
         """b-conditional likelihood of the red hypers (reference :549-566)."""
@@ -192,13 +202,9 @@ class NumpyGibbs:
             rhonew = tau / (tau / self.rhomax - np.log1p(-eta))
         else:
             irn = self._red_phi_at_gw_freqs(self.map_params(xnew))
-            grid = 10.0 ** np.linspace(np.log10(self.rhomin),
-                                       np.log10(self.rhomax), 1000)
-            logratio = (np.log(tau)[:, None]
-                        - np.logaddexp(np.log(irn)[:, None], np.log(grid)[None, :]))
-            logpdf = logratio - np.exp(logratio)
-            gum = self.rng.gumbel(size=logpdf.shape)
-            rhonew = grid[np.argmax(logpdf + gum, axis=1)]
+            grid = rho_grid(self.rhomin, self.rhomax)
+            rhonew = gumbel_grid_draw(self.rng,
+                                      rho_log_pdf_grid(tau, irn, grid), grid)
         xnew[self.idx.rho] = 0.5 * np.log10(rhonew)
         return xnew
 
@@ -271,6 +277,23 @@ class NumpyGibbs:
                 x, ll0, lp0 = q, ll1, lp1
         return x
 
+    def update_red_rho(self, xs):
+        """Per-frequency free-spectrum draw of an intrinsic red 'spectrum'
+        process, with the common GW phi as the 'other' component (the
+        per-pulsar analogue of reference ``pta_gibbs.py:252-276``; the
+        reference's single-pulsar sampler never supported this)."""
+        xnew = xs.copy()
+        params = self.map_params(xnew)
+        bb = self.b[self.redid] ** 2
+        tau = 0.5 * (bb[::2] + bb[1::2])
+        K = len(self.idx.red_rho)
+        tau = tau[:K]
+        gw = align_phi(np.asarray(self.gw_sig.get_phi(params))[::2], K)
+        grid = rho_grid(self.red_rhomin, self.red_rhomax)
+        xnew[self.idx.red_rho] = 0.5 * np.log10(gumbel_grid_draw(
+            self.rng, rho_log_pdf_grid(tau, gw, grid), grid))
+        return xnew
+
     def update_ecorr(self, xs, adapt=False):
         """ECORR block via MH on the b-conditional likelihood — the update
         the reference disables as broken (``pulsar_gibbs.py:409-486,676-683``)
@@ -300,6 +323,8 @@ class NumpyGibbs:
             x = self.update_white(x, adapt=first)
         if len(self.idx.ecorr) and self.ecorr_sig is not None:
             x = self.update_ecorr(x, adapt=first)
+        if len(self.idx.red_rho):
+            x = self.update_red_rho(x)
         if len(self.idx.red):
             x = self.update_red(x, adapt=first)
         if len(self.idx.rho):

@@ -24,7 +24,9 @@ import numpy as np
 import scipy.linalg as sl
 
 from ..ops.acf import integrated_act
-from .blocks import BlockIndex, proposal_step, rho_bounds
+from .blocks import (BlockIndex, align_phi, gumbel_grid_draw,
+                     proposal_step, rho_bounds, rho_grid,
+                     rho_log_pdf_grid)
 
 
 class NumpyPTAGibbs:
@@ -46,9 +48,13 @@ class NumpyPTAGibbs:
         self.idx = BlockIndex.build(pta.param_names)
         self._y = pta.get_residuals()
         self._T = pta.get_basis()
-        self.rhomin, self.rhomax = rho_bounds(pta, "gw")
+        try:
+            self.rhomin, self.rhomax = rho_bounds(pta, "gw")
+        except ValueError:   # powerlaw-family common process: no rho block
+            self.rhomin, self.rhomax = 1e-20, 1e-8
 
         self.gwid, self.red_sigs, self.gw_sigs, self.ecorr_sigs = [], [], [], []
+        self.redid = []
         self.ecid = []
         #: per-pulsar positions (chain columns) of that pulsar's red
         #: free-spectrum parameters — located by NAME, not model order, since
@@ -59,8 +65,13 @@ class NumpyPTAGibbs:
             m = pta.model(pname)
             sl_gw = m.basis_slice("gw")
             self.gwid.append(np.arange(sl_gw.start, sl_gw.stop))
-            self.red_sigs.append(next((s for s in m.signals
-                                       if "red" in s.name), None))
+            red_sig = next((s for s in m.signals if "red" in s.name), None)
+            self.red_sigs.append(red_sig)
+            if red_sig is not None:
+                sl_red = m.basis_slice("red")
+                self.redid.append(np.arange(sl_red.start, sl_red.stop))
+            else:
+                self.redid.append(None)
             self.gw_sigs.append(next(s for s in m.signals if "gw" in s.name))
             ec = next((s for s in m.signals if "ecorr" in s.name), None)
             self.ecorr_sigs.append(ec)
@@ -107,6 +118,12 @@ class NumpyPTAGibbs:
         bb = self.b[ii][self.gwid[ii]] ** 2
         return 0.5 * (bb[::2] + bb[1::2])
 
+    def _red_tau(self, ii):
+        """Coefficient power on the red signal's own columns — distinct
+        from the GW fold when the red process has more modes."""
+        bb = self.b[ii][self.redid[ii]] ** 2
+        return 0.5 * (bb[::2] + bb[1::2])
+
     # ---- likelihoods -------------------------------------------------------
 
     def lnlike_white(self, xs):
@@ -125,14 +142,12 @@ class NumpyPTAGibbs:
         params = self.map_params(xs)
         out = 0.0
         for ii in range(self.P):
-            if self.red_sigs[ii] is None:
-                continue
             tau = self._gw_tau(ii)
             kgw = len(tau)
-            raw = np.asarray(self.red_sigs[ii].get_phi(params))[::2]
             irn = np.full(kgw, 1e-40)
-            n = min(kgw, len(raw))
-            irn[:n] = raw[:n]
+            if self.red_sigs[ii] is not None:
+                irn = align_phi(
+                    np.asarray(self.red_sigs[ii].get_phi(params))[::2], kgw)
             gw = np.asarray(self.gw_sigs[ii].get_phi(params))[::2]
             logratio = np.log(tau) - np.logaddexp(np.log(irn), np.log(gw))
             out += float(np.sum(logratio - np.exp(logratio)))
@@ -188,11 +203,7 @@ class NumpyPTAGibbs:
         return self.b
 
     def _rho_log_pdf_grid(self, tau, other, grid):
-        """log conditional density of one pulsar's contribution on the rho
-        grid: r - e^r parameterization with r = log tau - log(other + rho)."""
-        logratio = (np.log(tau)[:, None]
-                    - np.logaddexp(np.log(other)[:, None], np.log(grid)[None, :]))
-        return logratio - np.exp(logratio)
+        return rho_log_pdf_grid(tau, other, grid)
 
     def update_rho(self, xs):
         """Common free-spectrum draw: per-pulsar log-PDF grids summed across
@@ -201,8 +212,7 @@ class NumpyPTAGibbs:
         xnew = xs.copy()
         params = self.map_params(xnew)
         K = len(self.idx.rho)
-        grid = 10.0 ** np.linspace(np.log10(self.rhomin),
-                                   np.log10(self.rhomax), 1000)
+        grid = rho_grid(self.rhomin, self.rhomax)
         logpdf = np.zeros((K, len(grid)))
         for ii in range(self.P):
             tau = self._gw_tau(ii)[:K]
@@ -212,34 +222,35 @@ class NumpyPTAGibbs:
                 other = np.full(K, 1e-40)
             logpdf += self._rho_log_pdf_grid(tau, other, grid)
         # Gumbel-max across the grid == inverse-CDF on the discrete pdf
-        gum = self.rng.gumbel(size=logpdf.shape)
-        rhonew = grid[np.argmax(logpdf + gum, axis=1)]
-        xnew[self.idx.rho] = 0.5 * np.log10(rhonew)
+        xnew[self.idx.rho] = 0.5 * np.log10(
+            gumbel_grid_draw(self.rng, logpdf, grid))
         return xnew
 
     def update_red(self, xs, adapt=False):
-        """Per-pulsar intrinsic red block.  'conditional' (free-spectrum red,
-        reference ``pta_gibbs.py:252-276``): grid draw per pulsar with the
-        common GW as the 'other' phi.  'mh' (power-law red): adaptive MH as
-        in the single-pulsar sampler."""
-        if self.redsample == "conditional" and len(self.idx.red_rho):
+        """Per-pulsar intrinsic red *free-spectrum* block (reference
+        ``pta_gibbs.py:252-276``): grid draw per pulsar with the common GW as
+        the 'other' phi component.  No-op when there is no red rho block."""
+        if len(self.idx.red_rho):
             xnew = xs.copy()
             params = self.map_params(xnew)
-            grid = 10.0 ** np.linspace(np.log10(self.rhomin_red),
-                                       np.log10(self.rhomax_red), 1000)
+            grid = rho_grid(self.rhomin_red, self.rhomax_red)
             for ii in range(self.P):
                 if self.red_sigs[ii] is None or not len(self.red_rho_idx[ii]):
                     continue
                 K = len(self.red_rho_idx[ii])
-                tau = self._gw_tau(ii)[:K]
-                gw = np.asarray(self.gw_sigs[ii].get_phi(params))[::2][:K]
-                logpdf = self._rho_log_pdf_grid(tau, gw, grid)
-                gum = self.rng.gumbel(size=logpdf.shape)
+                tau = self._red_tau(ii)[:K]
+                gw = align_phi(
+                    np.asarray(self.gw_sigs[ii].get_phi(params))[::2], K)
+                logpdf = rho_log_pdf_grid(tau, gw, grid)
                 # assignment keyed by this pulsar's own chain columns
                 xnew[self.red_rho_idx[ii]] = 0.5 * np.log10(
-                    grid[np.argmax(logpdf + gum, axis=1)])
+                    gumbel_grid_draw(self.rng, logpdf, grid))
             return xnew
+        return xs.copy()
 
+    def update_red_mh(self, xs, adapt=False):
+        """Powerlaw-family hyper block (per-pulsar red and/or a varied
+        common process): adaptive MH as in the single-pulsar sampler."""
         rind = self.idx.red
         if not len(rind):
             return xs.copy()
@@ -330,8 +341,10 @@ class NumpyPTAGibbs:
             x = self.update_white(x, adapt=first)
         if len(self.idx.ecorr) and any(s is not None for s in self.ecorr_sigs):
             x = self.update_ecorr(x, adapt=first)
-        if len(self.idx.red) or len(self.idx.red_rho):
+        if len(self.idx.red_rho):
             x = self.update_red(x, adapt=first)
+        if len(self.idx.red):
+            x = self.update_red_mh(x, adapt=first)
         if len(self.idx.rho):
             x = self.update_rho(x)
         self.draw_b(x)

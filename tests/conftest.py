@@ -13,6 +13,13 @@ os.environ["XLA_FLAGS"] = (
     os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
 )
 
+# The interpreter's sitecustomize imports jax at startup, which latches the
+# JAX_PLATFORMS env var before this file runs; the config API still works
+# because no backend has initialized yet.
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
 import numpy as np
 import pytest
 
