@@ -41,14 +41,24 @@ class Settings:
     #: (reference uses 1000, pulsar_gibbs.py:228)
     rho_grid_size: int = 1000
 
+    #: persistent XLA compilation cache (first 45-pulsar compile costs
+    #: minutes through the remote-compile tunnel; cached reruns are free).
+    #: Empty string disables.
+    compile_cache: str = os.environ.get("PTGIBBS_CACHE",
+                                        os.path.expanduser("~/.cache/ptgibbs_xla"))
+
     def apply(self):
         """Push precision into the JAX config.  Called once at model-compile
         entry (not from dtype accessors — enabling x64 is a process-wide,
         effectively one-way switch that must precede any traced op)."""
-        if self.precision == "f64" or self.compute_precision == "f64":
-            import jax
+        import jax
 
+        if self.precision == "f64" or self.compute_precision == "f64":
             jax.config.update("jax_enable_x64", True)
+        if self.compile_cache:
+            os.makedirs(self.compile_cache, exist_ok=True)
+            jax.config.update("jax_compilation_cache_dir", self.compile_cache)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
     def real_dtype(self):
         import jax.numpy as jnp

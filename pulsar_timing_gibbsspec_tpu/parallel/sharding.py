@@ -36,6 +36,7 @@ _PULSAR_FIELDS = (
     "red_sin_ix", "red_cos_ix",
     "ec_cols", "ec_ix",
     "white_par_ix", "white_nper", "ecorr_par_ix", "ecorr_nper",
+    "Uw", "Vw", "ys",
 )
 #: replicated small arrays
 _REPLICATED_FIELDS = ("const_pool", "pkind", "pa", "pb", "rho_ix_x")

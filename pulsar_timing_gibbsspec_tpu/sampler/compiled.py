@@ -177,6 +177,15 @@ class CompiledPTA:
     red_cos_ix: object         # (P, Kr)
     ec_cols: object            # (P, We) -> b columns (pad Bmax)
     ec_ix: object              # (P, We) -> xe
+    #: whitened-basis factors for the b-draw (see jax_backend.draw_b_fn):
+    #: ``C = chol(T^T diag(1/sigma^2) T + pad)`` per pulsar in f64 on host,
+    #: ``U = (T C^-T) / sigma`` satisfies U^T diag(sigma^2/N_ref) U = I, so
+    #: the per-sweep Gram matrix U^T diag(g) U has O(1) entries and runs on
+    #: the MXU in the storage dtype; ``Vw = C^-T`` maps whitened
+    #: coefficients back (b = Vw b_tilde)
+    Uw: object                 # (P, Nmax, Bmax) storage dtype
+    Vw: object                 # (P, Bmax, Bmax) float64
+    ys: object                 # (P, Nmax) y/sigma, storage dtype
     #: per-pulsar positions (in x) of that pulsar's white-noise parameters
     #: (pad nx) and their counts — the white conditional factorizes over
     #: pulsars given b, so the device backend runs P independent
@@ -211,6 +220,14 @@ class CompiledPTA:
         """(P, Nmax) diagonal measurement covariance
         (``WhiteNoiseSignal.get_ndiag`` compiled to two gathers)."""
         xev = self.xe(x)
+        efac = xev[self.efac_ix]
+        equad = xev[self.equad_ix]
+        return efac * efac * self.sigma2 + 10.0 ** (2.0 * equad)
+
+    def ndiag_fast(self, x):
+        """(P, Nmax) measurement covariance in the *storage* dtype — the
+        whitened b-draw only consumes the O(1) ratio ``sigma^2/N``."""
+        xev = self.xe(x).astype(self.dtype)
         efac = xev[self.efac_ix]
         equad = xev[self.equad_ix]
         return efac * efac * self.sigma2 + 10.0 ** (2.0 * equad)
@@ -256,11 +273,12 @@ class CompiledPTA:
         import jax.numpy as jnp
 
         j = jnp.minimum(j, self.nx - 1)
+        dt = jnp.asarray(v).dtype
         kind = jnp.asarray(self.pkind)[j]
-        a = jnp.asarray(self.pa, dtype=self.cdtype)[j]
-        b_ = jnp.asarray(self.pb, dtype=self.cdtype)[j]
+        a = jnp.asarray(self.pa, dtype=dt)[j]
+        b_ = jnp.asarray(self.pb, dtype=dt)[j]
         inside = (v >= a) & (v <= b_)
-        ninf = jnp.array(-jnp.inf, dtype=self.cdtype)
+        ninf = jnp.array(-jnp.inf, dtype=dt)
         lp_u = jnp.where(inside, -jnp.log(b_ - a), ninf)
         lp_n = (-0.5 * ((v - a) / b_) ** 2
                 - jnp.log(b_ * np.sqrt(2.0 * np.pi)))
@@ -417,6 +435,40 @@ def compile_pta(pta, pad_pulsars: int | None = None) -> CompiledPTA:
             sl_ = m._slices[s.name]
             phi_base[ii, sl_.start:sl_.stop] = 0.0
 
+    # ---- whitened-basis factors for the b-draw -----------------------------
+    Uw = np.zeros((P, Nmax, Bmax), np_dtype)
+    Vw = np.zeros((P, Bmax, Bmax), np.float64)
+    ys = np.zeros((P, Nmax), np_dtype)
+    for ii, m in enumerate(models):
+        n, w = m.pulsar.ntoa, widths[ii]
+        Tp = np.zeros((Nmax, Bmax))
+        Tp[:n, :w] = m.get_basis()
+        sig = np.ones(Nmax)
+        sig[:n] = m.pulsar.toaerrs
+        A = Tp.T @ (Tp / sig[:, None] ** 2)
+        A[np.arange(w, Bmax), np.arange(w, Bmax)] = 1.0  # pad columns
+        # low-frequency Fourier columns are nearly degenerate with the
+        # quadratic timing columns (cond ~ 1e16): jitter until the factor
+        # exists — any invertible V is a valid whitener, conditioning of
+        # the degenerate directions is restored by the Sigma_t ridge in
+        # draw_b_fn
+        jit_ = 1e-13 * np.trace(A) / Bmax
+        for _ in range(20):
+            try:
+                C = np.linalg.cholesky(A + jit_ * np.eye(Bmax))
+                break
+            except np.linalg.LinAlgError:
+                jit_ *= 10.0
+        else:
+            raise np.linalg.LinAlgError(
+                f"whitening factor failed for pulsar {m.pulsar.name}")
+        V = np.linalg.inv(C).T
+        Uw[ii] = (Tp @ V) / sig[:, None]
+        Vw[ii] = V
+        ys[ii, :n] = m.pulsar.residuals / sig[:n]
+    for ii in range(P_real, P):
+        Vw[ii] = np.eye(Bmax)
+
     # ---- GP components, grouped by position in the per-model signal lists --
     components: list = []
     n_fourier = {len(m._fourier) for m in models}
@@ -490,6 +542,8 @@ def compile_pta(pta, pad_pulsars: int | None = None) -> CompiledPTA:
     def fsig(m, frag):
         return next((s for s in m._fourier if frag in s.name), None)
 
+    floor_ref = const_ref(-20.0)  # 10^(2*-20) == PHI_FLOOR
+
     if any(fsig(m, "gw") for m in models):
         sigs = [fsig(m, "gw") for m in models]
         K = max(len(s.freqs) // 2 for s in sigs if s is not None)
@@ -502,7 +556,7 @@ def compile_pta(pta, pad_pulsars: int | None = None) -> CompiledPTA:
                   if s is not None and s.psd_name != "free_spectrum"),
                  default=0)
         gw_hyp = np.full((P, max(Hg, 1)), sentinel, np.int32)
-        gw_rho = np.full((P, K), sentinel, np.int32)
+        gw_rho = np.full((P, K), floor_ref, np.int32)
         for ii, (m, s) in enumerate(zip(models, sigs)):
             if s is None:
                 continue
@@ -531,7 +585,7 @@ def compile_pta(pta, pad_pulsars: int | None = None) -> CompiledPTA:
                   if s is not None and s.psd_name != "free_spectrum"),
                  default=0)
         red_hyp = np.full((P, max(Hr, 1)), sentinel, np.int32)
-        red_rho = np.full((P, Kr), sentinel, np.int32)
+        red_rho = np.full((P, Kr), floor_ref, np.int32)
         red_rho_x = np.full((P, Kr), nx, np.int32)  # pad -> dropped scatter
         red_sin = np.zeros((P, Kr), np.int32)
         red_cos = np.zeros((P, Kr), np.int32)
@@ -645,6 +699,7 @@ def compile_pta(pta, pad_pulsars: int | None = None) -> CompiledPTA:
         red_cos_ix=_as_i32(red_cos if red_cos is not None
                            else np.zeros((P, max(Kr, 1)))),
         ec_cols=ec_cols, ec_ix=ec_ix,
+        Uw=Uw, Vw=Vw, ys=ys,
         white_par_ix=white_par_ix, white_nper=white_nper,
         ecorr_par_ix=ecorr_par_ix, ecorr_nper=ecorr_nper,
         rhomin=float(rhomin), rhomax=float(rhomax),

@@ -83,21 +83,6 @@ def lnlike_white_per(cm: CompiledPTA, x, r2):
     return -0.5 * jnp.sum(cm.toa_mask * (jnp.log(N) + r2 / N), axis=1)
 
 
-def lnlike_ecorr_per(cm: CompiledPTA, x, b):
-    """Per-pulsar ECORR likelihood (P,)."""
-    import jax.numpy as jnp
-
-    if cm.ec_cols.shape[1] == 0:
-        return jnp.zeros(cm.P, dtype=cm.cdtype)
-    xev = cm.xe(x)
-    mask = (cm.ec_cols < cm.Bmax).astype(cm.cdtype)
-    bj = jnp.take_along_axis(b, jnp.minimum(cm.ec_cols, cm.Bmax - 1), axis=1)
-    l10e = xev[cm.ec_ix]
-    ln_phi = 2.0 * np.log(10.0) * l10e
-    return jnp.sum(mask * (-0.5 * ln_phi
-                           - 0.5 * bj * bj * 10.0 ** (-2.0 * l10e)), axis=1)
-
-
 def lnlike_red_fn(cm: CompiledPTA, x, tau):
     """b-conditional red-hyper likelihood (reference ``:549-566``)."""
     import jax.numpy as jnp
@@ -106,21 +91,6 @@ def lnlike_red_fn(cm: CompiledPTA, x, tau):
     gw = cm.gw_phi(x)
     logratio = jnp.log(tau) - jnp.logaddexp(jnp.log(irn), jnp.log(gw))
     return jnp.sum(cm.psr_mask[:, None] * (logratio - jnp.exp(logratio)))
-
-
-def lnlike_ecorr_fn(cm: CompiledPTA, x, b):
-    """b-conditional ECORR likelihood: basis coefficients iid N(0, phi_j)."""
-    import jax.numpy as jnp
-
-    if cm.ec_cols.shape[1] == 0:
-        return jnp.zeros((), dtype=cm.cdtype)
-    xev = cm.xe(x)
-    mask = (cm.ec_cols < cm.Bmax).astype(cm.dtype)
-    bj = jnp.take_along_axis(b, jnp.minimum(cm.ec_cols, cm.Bmax - 1), axis=1)
-    l10e = xev[cm.ec_ix]
-    ln_phi = 2.0 * np.log(10.0) * l10e
-    return jnp.sum(mask * (-0.5 * ln_phi
-                           - 0.5 * bj * bj * 10.0 ** (-2.0 * l10e)))
 
 
 def lnlike_fullmarg_fn(cm: CompiledPTA, x, TNT, d):
@@ -144,18 +114,46 @@ def lnlike_fullmarg_fn(cm: CompiledPTA, x, TNT, d):
 
 
 def draw_b_fn(cm: CompiledPTA, x, key):
-    """b | everything: batched preconditioned-Cholesky Gaussian draw
-    (reference ``update_b``, ``pulsar_gibbs.py:489-520``)."""
+    """b | everything: batched Gaussian draw in the *whitened* basis
+    (reference ``update_b``, ``pulsar_gibbs.py:489-520``).
+
+    The naive ``Sigma = T^T N^-1 T + diag(phi^-1)`` needs f64 accumulation
+    (oscillatory Fourier-column products cancel catastrophically in f32,
+    and kappa ~ 1e4 amplifies the error into the conditional mean), but the
+    f64 einsum is emulated off the MXU — the dominant cost of the whole
+    sweep.  Using the compile-time factors ``U`` (with ``U^T U = I``) and
+    ``Vw = C^-T`` instead:
+
+        Sigma_t = U^T diag(g) U + Vw^T diag(phi^-1) Vw,   g = sigma^2/N
+        b = Vw N(Sigma_t^-1 d_t, Sigma_t^-1),  d_t = U^T (g * y/sigma)
+
+    The (P, Nmax, Bmax^2) Gram einsum now has O(1) entries and runs in the
+    storage dtype on the MXU; since the f32 rounding perturbs exactly the
+    component of Sigma_t that provides its smallest eigenvalue, the solve
+    error stays ~4e-6 of the conditional mean regardless of phi's 1e20
+    dynamic range.  Only the O(P B^3) phi-projection and Cholesky stay f64.
+    """
+    import jax.numpy as jnp
     import jax.random as jr
 
-    from ..ops.linalg import mvn_conditional_draw
+    from ..ops.linalg import mvn_conditional_draw_dense
 
-    N = cm.ndiag(x)
-    TNT, d = tnt_d(cm, N)
-    phi = cm.phi(x)
+    N = cm.ndiag_fast(x)
+    g = jnp.asarray(cm.sigma2) / N
+    Sg = jnp.einsum("pnb,pn,pnc->pbc", cm.Uw, g, cm.Uw,
+                    precision="highest")
+    dt = jnp.einsum("pnb,pn->pb", cm.Uw, g * jnp.asarray(cm.ys),
+                    precision="highest")
+    phiinv = (1.0 / cm.phi(x))
+    Phit = jnp.einsum("pkb,pk,pkc->pbc", cm.Vw, phiinv, cm.Vw)
+    # ridge >> the f32 Gram rounding (~3e-6): keeps Sigma_t SPD in the
+    # data-degenerate directions; biases posterior variances by ~1e-5
+    # relative, orders of magnitude under MC error
+    ridge = 1e-5 * jnp.eye(cm.Bmax, dtype=cm.cdtype)
+    Sigma_t = Sg.astype(cm.cdtype) + Phit + ridge
     z = jr.normal(key, (cm.P, cm.Bmax), dtype=cm.cdtype)
-    b, _ = mvn_conditional_draw(TNT, 1.0 / phi, d, z)
-    return b
+    bt, _ = mvn_conditional_draw_dense(Sigma_t, dt.astype(cm.cdtype), z)
+    return jnp.einsum("pbc,pc->pb", cm.Vw, bt)
 
 
 def _mh_step(cm: CompiledPTA, lnlike, ind, sigma):
@@ -200,54 +198,195 @@ def mh_scan(cm: CompiledPTA, x, key, lnlike, ind, sigma, nsteps):
 
 
 def parallel_mh_scan(cm: CompiledPTA, x, key, ll_per_fn, par_ix, nper,
-                     nsteps):
+                     nsteps, record=True):
     """P independent per-pulsar single-site MH chains, advanced in lockstep.
 
     The white-noise (and ECORR) conditionals factorize over pulsars given b,
     so one device step advances *every* pulsar's sub-chain at once: proposals
     touch disjoint coordinate sets, ``ll_per_fn(x) -> (P,)`` gives per-pulsar
-    likelihoods, and acceptance is per pulsar.  This replaces the
-    reference's joint single-site walk over the whole white block
-    (``pulsar_gibbs.py:332-406``) with an exactly-equivalent product-measure
-    Gibbs block that does P times the mixing work per step — and needs no
-    cross-device collective when the pulsar axis is sharded.
+    likelihoods (absolute or block-relative — MH only consumes differences),
+    and acceptance is per pulsar.  This replaces the reference's joint
+    single-site walk over the whole white block (``pulsar_gibbs.py:332-406``)
+    with an exactly-equivalent product-measure Gibbs block that does P times
+    the mixing work per step — and needs no cross-device collective when the
+    pulsar axis is sharded.
 
-    Returns ``(x', recorded (nsteps, P, W) block coordinates)``.
+    All per-step randomness (scale mixture, coordinate choice, jump, accept
+    threshold) is generated vectorized *outside* the scan in the storage
+    dtype: the scan body is then pure arithmetic, which keeps the compiled
+    step to a handful of fused kernels (profiled ~6x faster than in-body
+    threefry splitting in f64).
+
+    Returns ``(x', recorded (nsteps, P, W) block coordinates or None)``.
     """
     import jax
     import jax.numpy as jnp
     import jax.random as jr
 
-    scales = jnp.asarray(_SCALES, dtype=cm.cdtype)
-    probs = jnp.asarray(_SCALE_P, dtype=cm.cdtype)
+    fdt = cm.dtype
+    scales = jnp.asarray(_SCALES, dtype=fdt)
+    probs = jnp.asarray(_SCALE_P, dtype=fdt)
     nper = jnp.asarray(nper)
     par_ix = jnp.asarray(par_ix)
-    sigma = 0.05 * nper.astype(cm.cdtype)
+    sigma = 0.05 * nper.astype(fdt)
     live = nper > 0
 
-    def step(carry, key):
+    k1, k2, k3, k4 = jr.split(key, 4)
+    scale = jr.choice(k1, scales, (nsteps, cm.P), p=probs)
+    jloc = jnp.floor(jr.uniform(k2, (nsteps, cm.P), dtype=fdt)
+                     * jnp.maximum(nper, 1)).astype(jnp.int32)
+    noise = jr.normal(k3, (nsteps, cm.P), dtype=fdt) * sigma * scale
+    logu = jnp.log(jr.uniform(k4, (nsteps, cm.P), dtype=fdt))
+
+    def step(carry, inp):
         x, ll0 = carry
-        k1, k2, k3, k4 = jr.split(key, 4)
-        scale = jr.choice(k1, scales, (cm.P,), p=probs)
-        jloc = jnp.floor(jr.uniform(k2, (cm.P,), dtype=cm.cdtype)
-                         * jnp.maximum(nper, 1)).astype(jnp.int32)
-        j = jnp.take_along_axis(par_ix, jloc[:, None], axis=1)[:, 0]
-        noise = jr.normal(k3, (cm.P,), dtype=cm.cdtype) * sigma * scale
+        jl, nz, lu = inp
+        j = jnp.take_along_axis(par_ix, jl[:, None], axis=1)[:, 0]
         xj = x[jnp.minimum(j, cm.nx - 1)]
-        qj = xj + noise
-        dlp = cm.coord_logpdf(j, qj) - cm.coord_logpdf(j, xj)
-        q = x.at[j].add(noise, mode="drop")
+        qj = xj + nz
+        dlp = (cm.coord_logpdf(j, qj.astype(fdt))
+               - cm.coord_logpdf(j, xj.astype(fdt)))
+        q = x.at[j].add(nz.astype(x.dtype), mode="drop")
         ll1 = ll_per_fn(q)
         ok = jnp.isfinite(dlp) & jnp.isfinite(ll1)
         logr = jnp.where(ok, (ll1 - ll0) + dlp, -jnp.inf)
-        acc = (logr > jnp.log(jr.uniform(k4, (cm.P,), dtype=cm.cdtype))) & live
-        x = x.at[j].add(jnp.where(acc, noise, 0.0), mode="drop")
+        acc = (logr > lu) & live
+        x = x.at[j].add(jnp.where(acc, nz, 0.0).astype(x.dtype), mode="drop")
         ll0 = jnp.where(acc, ll1, ll0)
-        return (x, ll0), x[jnp.minimum(par_ix, cm.nx - 1)]
+        out = x[jnp.minimum(par_ix, cm.nx - 1)] if record else None
+        return (x, ll0), out
 
-    (x, _), rec = jax.lax.scan(step, (x, ll_per_fn(x)),
-                               jr.split(key, nsteps))
+    (x, _), rec = jax.lax.scan(step, (x, ll_per_fn(x)), (jloc, noise, logu))
     return x, rec
+
+
+def parallel_cov_mh_scan(cm: CompiledPTA, x, key, ll_per_fn, par_ix, nper,
+                         chol, nsteps, record=True):
+    """Per-pulsar *full-block* MH with adapted covariance proposals.
+
+    After the single-site adaptation pass measures each pulsar's block
+    covariance, later sub-chains propose all of a pulsar's block parameters
+    jointly: ``q_p = x_p + scale * (2.38/sqrt(W_p)) L_p z`` (the standard AM
+    scaling; the reference gets the same effect from PTMCMCSampler's AM/SCAM
+    jumps, ``pulsar_gibbs.py:288-296``).  Joint adapted proposals cut the
+    measured autocorrelation time — and hence the static per-sweep scan
+    length — by roughly the block dimension relative to single-site walks.
+
+    ``chol`` is (P, W, W): per-pulsar lower Cholesky factors of the adapted
+    covariances, rows/cols beyond ``nper[p]`` zeroed.
+    """
+    import jax
+    import jax.numpy as jnp
+    import jax.random as jr
+
+    fdt = cm.dtype
+    scales = jnp.asarray(_SCALES, dtype=fdt)
+    probs = jnp.asarray(_SCALE_P, dtype=fdt)
+    nper = jnp.asarray(nper)
+    par_ix = jnp.asarray(par_ix)
+    W = par_ix.shape[1]
+    wmask = (jnp.arange(W)[None, :] < nper[:, None]).astype(fdt)
+    live = nper > 0
+    amp = 2.38 / jnp.sqrt(jnp.maximum(nper, 1).astype(fdt))
+    safe_ix = jnp.minimum(par_ix, cm.nx - 1)
+
+    k1, k3, k4 = jr.split(key, 3)
+    scale = jr.choice(k1, scales, (nsteps, cm.P), p=probs)
+    z = jr.normal(k3, (nsteps, cm.P, W), dtype=fdt)
+    noise = (jnp.einsum("pwv,spv->spw", jnp.asarray(chol, dtype=fdt), z)
+             * (amp[None, :, None] * scale[:, :, None])) * wmask[None]
+    logu = jnp.log(jr.uniform(k4, (nsteps, cm.P), dtype=fdt))
+
+    def step(carry, inp):
+        x, ll0 = carry
+        nz, lu = inp
+        xw = x[safe_ix]                           # (P, W)
+        qw = xw + nz
+        dlp = jnp.sum(wmask * (cm.coord_logpdf(par_ix, qw.astype(fdt))
+                               - cm.coord_logpdf(par_ix, xw.astype(fdt))),
+                      axis=1)
+        q = x.at[par_ix].add(nz.astype(x.dtype), mode="drop")
+        ll1 = ll_per_fn(q)
+        ok = jnp.isfinite(dlp) & jnp.isfinite(ll1)
+        logr = jnp.where(ok, (ll1 - ll0) + dlp, -jnp.inf)
+        acc = (logr > lu) & live
+        x = x.at[par_ix].add(
+            (nz * acc[:, None].astype(nz.dtype)).astype(x.dtype),
+            mode="drop")
+        ll0 = jnp.where(acc, ll1, ll0)
+        out = x[safe_ix] if record else None
+        return (x, ll0), out
+
+    (x, _), rec = jax.lax.scan(step, (x, ll_per_fn(x)), (noise, logu))
+    return x, rec
+
+
+def block_cov_chol(rec, nper, P_real):
+    """(P, W, W) per-pulsar Cholesky factors of the adapted block covariance
+    from a recorded (steps, P, W) chain; invalid rows/cols zeroed, tiny
+    jitter for rank safety."""
+    rec = np.asarray(rec, dtype=np.float64)
+    S, P, W = rec.shape
+    chol = np.zeros((P, W, W))
+    for p in range(P_real):
+        w = int(nper[p])
+        if w == 0:
+            continue
+        seg = rec[S // 2:, p, :w]
+        cov = np.atleast_2d(np.cov(seg, rowvar=False))
+        cov += (1e-10 * max(np.trace(cov) / w, 1e-12)
+                + 1e-14) * np.eye(w)
+        chol[p, :w, :w] = np.linalg.cholesky(cov)
+    return chol
+
+
+def white_ll_rel(cm: CompiledPTA, x0, r2):
+    """Block-relative per-pulsar white likelihood in the storage dtype.
+
+    ``ll(q) - ll(x0)`` with the cancellation done per element *before* the
+    sum: with ``z = N0/Nq``, ``delta_i = 0.5 (log z_i + w_i (z_i - 1))``,
+    ``w_i = r2_i / N0_i``.  Every intermediate is O(1), so float32 carries
+    the MH acceptance differences exactly where the absolute likelihood
+    (~1e6) would quantize them at ~0.06.
+    """
+    import jax.numpy as jnp
+
+    fdt = cm.dtype
+    N0f = cm.ndiag_fast(x0)
+    w = (r2.astype(fdt) / N0f)
+    mask = jnp.asarray(cm.toa_mask, dtype=fdt)
+
+    def ll_rel(q):
+        xev = cm.xe(q).astype(fdt)
+        efac = xev[cm.efac_ix]
+        equad = xev[cm.equad_ix]
+        Nq = efac * efac * jnp.asarray(cm.sigma2, fdt) + 10.0 ** (2.0 * equad)
+        z = N0f / Nq
+        return 0.5 * jnp.sum(mask * (jnp.log(z) + w * (z - 1.0)), axis=1)
+
+    return ll_rel
+
+
+def ecorr_ll_rel(cm: CompiledPTA, x0, b):
+    """Block-relative per-pulsar ECORR likelihood in the storage dtype:
+    ``delta_j = -ln10 (e_q - e_0) + 0.5 u_j (1 - 10^(2(e_0 - e_q)))`` with
+    ``u_j = b_j^2 / phi_0``."""
+    import jax.numpy as jnp
+
+    fdt = cm.dtype
+    xev0 = cm.xe(x0)
+    e0 = xev0[cm.ec_ix].astype(fdt)
+    mask = (cm.ec_cols < cm.Bmax).astype(fdt)
+    bj = jnp.take_along_axis(b, jnp.minimum(cm.ec_cols, cm.Bmax - 1), axis=1)
+    u = (bj * bj * 10.0 ** (-2.0 * xev0[cm.ec_ix])).astype(fdt)
+
+    def ll_rel(q):
+        eq = cm.xe(q).astype(fdt)[cm.ec_ix]
+        ratio = 10.0 ** (2.0 * (e0 - eq))
+        return jnp.sum(mask * (-np.log(10.0) * (eq - e0)
+                               + 0.5 * u * (1.0 - ratio)), axis=1)
+
+    return ll_rel
 
 
 def red_mh_block(cm: CompiledPTA, x, tau, key, U, S, nsteps):
@@ -291,10 +430,13 @@ def red_mh_block(cm: CompiledPTA, x, tau, key, U, S, nsteps):
 
 
 def _rho_grid(cm: CompiledPTA, lo, hi):
+    # grid math runs in the storage dtype: log-density values are O(+-100),
+    # so f32 carries the Gumbel-max draw exactly where it matters while
+    # avoiding ~20 ms/sweep of emulated-f64 transcendentals on TPU
     import jax.numpy as jnp
 
     return 10.0 ** jnp.linspace(np.log10(lo), np.log10(hi),
-                                settings.rho_grid_size, dtype=cm.cdtype)
+                                settings.rho_grid_size, dtype=cm.dtype)
 
 
 def rho_update(cm: CompiledPTA, x, b, key):
@@ -319,13 +461,16 @@ def rho_update(cm: CompiledPTA, x, b, key):
         rhonew = t / (t / cm.rhomax - jnp.log1p(-eta))
     else:
         grid = _rho_grid(cm, cm.rhomin, cm.rhomax)
-        other = cm.red_phi(x)  # (P, K)
-        logratio = (jnp.log(tau)[:, :, None]
-                    - jnp.logaddexp(jnp.log(other)[:, :, None],
+        fdt = cm.dtype
+        ltau = jnp.log(tau).astype(fdt)
+        lother = jnp.log(cm.red_phi(x)).astype(fdt)
+        logratio = (ltau[:, :, None]
+                    - jnp.logaddexp(lother[:, :, None],
                                     jnp.log(grid)[None, None, :]))
         logpdf = logratio - jnp.exp(logratio)
-        logpdf = jnp.sum(cm.psr_mask[:, None, None] * logpdf, axis=0)
-        gum = jr.gumbel(key, logpdf.shape, dtype=cm.cdtype)
+        logpdf = jnp.sum(jnp.asarray(cm.psr_mask, fdt)[:, None, None]
+                         * logpdf, axis=0)
+        gum = jr.gumbel(key, logpdf.shape, dtype=fdt)
         rhonew = grid[jnp.argmax(logpdf + gum, axis=-1)]
     return x.at[cm.rho_ix_x].set(
         (0.5 * jnp.log10(rhonew)).astype(x.dtype))
@@ -340,22 +485,28 @@ def red_conditional_update(cm: CompiledPTA, x, b, key):
 
     tau = cm.red_tau(b)
     grid = _rho_grid(cm, cm.red_rhomin, cm.red_rhomax)
-    other = cm.gw_phi_at_red(x)
-    logratio = (jnp.log(tau)[:, :, None]
-                - jnp.logaddexp(jnp.log(other)[:, :, None],
+    fdt = cm.dtype
+    ltau = jnp.log(tau).astype(fdt)
+    lother = jnp.log(cm.gw_phi_at_red(x)).astype(fdt)
+    logratio = (ltau[:, :, None]
+                - jnp.logaddexp(lother[:, :, None],
                                 jnp.log(grid)[None, None, :]))
     logpdf = logratio - jnp.exp(logratio)
-    gum = jr.gumbel(key, logpdf.shape, dtype=cm.cdtype)
+    gum = jr.gumbel(key, logpdf.shape, dtype=fdt)
     rhonew = grid[jnp.argmax(logpdf + gum, axis=-1)]  # (P, Kr)
     return x.at[cm.red_rho_ix_x].set(
         (0.5 * jnp.log10(rhonew)).astype(x.dtype), mode="drop")
 
 
 def residual_sq(cm: CompiledPTA, b):
+    """(y - T b)^2 in the storage dtype: |T_i . b| ~ |y| so the f32 matvec
+    error is ~1e-5 relative to the residual — far below what the white MH
+    deltas can resolve anyway."""
     import jax.numpy as jnp
 
-    r = cm.y - jnp.einsum("pnb,pb->pn", cm.T, b.astype(cm.dtype),
-                          preferred_element_type=cm.cdtype)
+    r = jnp.asarray(cm.y) - jnp.einsum("pnb,pb->pn", cm.T,
+                                       b.astype(cm.dtype),
+                                       precision="highest")
     return r * r
 
 
@@ -414,6 +565,8 @@ class JaxGibbsDriver:
 
         # adaptation state
         self.aclength_white = None
+        self.chol_white = None
+        self.chol_ecorr = None
         self.cov_red = None
         self.red_U = None
         self.red_S = None
@@ -440,20 +593,37 @@ class JaxGibbsDriver:
 
         if len(cm.idx.white):
             r2 = residual_sq(cm, b)
+            # phase 1: single-site walk -> per-pulsar block covariance
             self.key, k = jr.split(self.key)
             fn = jax.jit(lambda x, k: parallel_mh_scan(
-                cm, x, k, lambda q: lnlike_white_per(cm, q, r2),
+                cm, x, k, white_ll_rel(cm, x, r2),
                 cm.white_par_ix, cm.white_nper, self.white_adapt_iters))
             x, rec = fn(x, k)
-            self.aclength_white = self._act_from_rec(rec, cm.white_nper)
+            self.chol_white = block_cov_chol(rec, cm.white_nper, cm.P_real)
+            # phase 2: adapted-covariance proposals -> ACT that reflects the
+            # proposal actually used per sweep
+            self.key, k = jr.split(self.key)
+            n2 = max(200, self.white_adapt_iters // 2)
+            fn2 = jax.jit(lambda x, k: parallel_cov_mh_scan(
+                cm, x, k, white_ll_rel(cm, x, r2), cm.white_par_ix,
+                cm.white_nper, self.chol_white, n2))
+            x, rec2 = fn2(x, k)
+            self.aclength_white = self._act_from_rec(rec2, cm.white_nper)
 
         if len(cm.idx.ecorr) and cm.ec_cols.shape[1]:
             self.key, k = jr.split(self.key)
             fn = jax.jit(lambda x, k: parallel_mh_scan(
-                cm, x, k, lambda q: lnlike_ecorr_per(cm, q, b),
+                cm, x, k, ecorr_ll_rel(cm, x, b),
                 cm.ecorr_par_ix, cm.ecorr_nper, self.white_adapt_iters))
             x, rec = fn(x, k)
-            self.aclength_ecorr = self._act_from_rec(rec, cm.ecorr_nper)
+            self.chol_ecorr = block_cov_chol(rec, cm.ecorr_nper, cm.P_real)
+            self.key, k = jr.split(self.key)
+            n2 = max(200, self.white_adapt_iters // 2)
+            fn2 = jax.jit(lambda x, k: parallel_cov_mh_scan(
+                cm, x, k, ecorr_ll_rel(cm, x, b), cm.ecorr_par_ix,
+                cm.ecorr_nper, self.chol_ecorr, n2))
+            x, rec2 = fn2(x, k)
+            self.aclength_ecorr = self._act_from_rec(rec2, cm.ecorr_nper)
 
         if self.do_red_conditional:
             self.key, k = jr.split(self.key)
@@ -491,14 +661,19 @@ class JaxGibbsDriver:
         """Max integrated ACT over every (pulsar, parameter) sub-chain of an
         adaptation record (steps, P, W) — the static per-sweep scan length
         (reference ``aclength_white``, ``pulsar_gibbs.py:367-371``)."""
+        from ..native import acor_native
+
         rec = np.asarray(rec, dtype=np.float64)
         burn = rec[min(100, len(rec) // 2):]
         nper = np.asarray(nper)
-        worst = 1
-        for p in range(self.cm.P_real):
-            for w in range(int(nper[p])):
-                worst = max(worst, int(integrated_act(burn[:, p, w])))
-        return worst
+        cols = [burn[:, p, w] for p in range(self.cm.P_real)
+                for w in range(int(nper[p]))]
+        if not cols:
+            return 1
+        block = np.ascontiguousarray(np.column_stack(cols))
+        if acor_native.available():
+            return max(1, int(acor_native.act_many(block)))
+        return max(1, max(int(integrated_act(c)) for c in cols))
 
     def _set_red_eigs(self):
         import jax.numpy as jnp
@@ -525,13 +700,13 @@ class JaxGibbsDriver:
             k = jr.split(key, 6)
             if len(cm.idx.white) and nw:
                 r2 = residual_sq(cm, b)
-                x, _ = parallel_mh_scan(cm, x, k[0],
-                                        lambda q: lnlike_white_per(cm, q, r2),
-                                        cm.white_par_ix, cm.white_nper, nw)
+                x, _ = parallel_cov_mh_scan(
+                    cm, x, k[0], white_ll_rel(cm, x, r2), cm.white_par_ix,
+                    cm.white_nper, self.chol_white, nw, record=False)
             if len(cm.idx.ecorr) and ne and cm.ec_cols.shape[1]:
-                x, _ = parallel_mh_scan(cm, x, k[1],
-                                        lambda q: lnlike_ecorr_per(cm, q, b),
-                                        cm.ecorr_par_ix, cm.ecorr_nper, ne)
+                x, _ = parallel_cov_mh_scan(
+                    cm, x, k[1], ecorr_ll_rel(cm, x, b), cm.ecorr_par_ix,
+                    cm.ecorr_nper, self.chol_ecorr, ne, record=False)
             if self.do_red_conditional:
                 x = red_conditional_update(cm, x, b, k[2])
             if self.do_red_mh:
@@ -607,7 +782,8 @@ class JaxGibbsDriver:
         out = {"jax_key": np.asarray(jr.key_data(self.key)),
                "b_pad": np.asarray(self.b, dtype=np.float64),
                "x_cur": np.asarray(getattr(self, "x_cur", np.zeros(self.cm.nx)))}
-        for key in ("aclength_white", "cov_red", "aclength_ecorr"):
+        for key in ("aclength_white", "cov_red", "aclength_ecorr",
+                    "chol_white", "chol_ecorr"):
             val = getattr(self, key)
             if val is not None:
                 out[key] = np.asarray(val)
@@ -622,11 +798,21 @@ class JaxGibbsDriver:
         self.b = np.asarray(state["b_pad"], dtype=self.cm.cdtype)
         if "x_cur" in state:
             self.x_resume = np.asarray(state["x_cur"], dtype=np.float64)
-        for key in ("aclength_white", "cov_red", "aclength_ecorr"):
+        for key in ("aclength_white", "cov_red", "aclength_ecorr",
+                    "chol_white", "chol_ecorr"):
             if key in state:
                 val = np.asarray(state[key])
                 setattr(self, key, int(val) if val.ndim == 0 else val)
         if self.cov_red is not None:
             self._set_red_eigs()
-        if self.aclength_white is None and len(self.cm.idx.white):
-            raise RuntimeError("resume state lacks white-noise adaptation")
+        if len(self.cm.idx.white) and (self.aclength_white is None
+                                       or self.chol_white is None):
+            raise RuntimeError(
+                "resume checkpoint lacks white-noise adaptation state "
+                "(chol_white) — it was written by an incompatible version; "
+                "delete the chain directory to start fresh")
+        if (len(self.cm.idx.ecorr) and self.cm.ec_cols.shape[1]
+                and (self.aclength_ecorr is None or self.chol_ecorr is None)):
+            raise RuntimeError(
+                "resume checkpoint lacks ECORR adaptation state "
+                "(chol_ecorr); delete the chain directory to start fresh")

@@ -142,7 +142,7 @@ def test_lnlike_fullmarg_matches_oracle(pta8):
 # full-chain statistical equivalence (the BASELINE.json metric)
 # ---------------------------------------------------------------------------
 
-def test_jax_vs_numpy_posterior_ks(j1713):
+def test_jax_vs_numpy_posterior_ks(j1713, tmp_path):
     pta = model_general([j1713], tm_svd=True, red_var=False,
                         white_vary=False, common_psd="spectrum",
                         common_components=10)
@@ -150,8 +150,8 @@ def test_jax_vs_numpy_posterior_ks(j1713):
     chains = {}
     for backend, seed in [("jax", 1), ("numpy", 2)]:
         g = PulsarBlockGibbs(pta, backend=backend, seed=seed, progress=False)
-        chains[backend] = g.sample(x0, outdir=None if False else
-                                   f"/tmp/ptg_ks_{backend}", niter=2000)
+        chains[backend] = g.sample(x0, outdir=str(tmp_path / backend),
+                                   niter=2000)
     burn, thin = 200, 5
     pvals = [stats.ks_2samp(chains["jax"][burn::thin, k],
                             chains["numpy"][burn::thin, k]).pvalue
