@@ -36,10 +36,10 @@ _PULSAR_FIELDS = (
     "red_sin_ix", "red_cos_ix",
     "ec_cols", "ec_ix",
     "white_par_ix", "white_nper", "ecorr_par_ix", "ecorr_nper",
-    "Uw", "Vw", "ys",
 )
 #: replicated small arrays
-_REPLICATED_FIELDS = ("const_pool", "pkind", "pa", "pb", "rho_ix_x")
+_REPLICATED_FIELDS = ("const_pool", "pkind", "pa", "pb", "prop_scale",
+                      "rho_ix_x")
 
 
 def make_mesh(n_devices: int | None = None, axis: str = "pulsar"):

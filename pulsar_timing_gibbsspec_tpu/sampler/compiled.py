@@ -157,6 +157,7 @@ class CompiledPTA:
     pkind: object              # (nx,) 0 uniform / 1 normal / 2 linexp
     pa: object                 # (nx,) pmin or mu
     pb: object                 # (nx,) pmax or sigma
+    prop_scale: object         # (nx,) base proposal sd: 0.1x prior width
     # -- Gibbs blocks --------------------------------------------------------
     idx: BlockIndex
     # -- GW / red conditional metadata ---------------------------------------
@@ -177,15 +178,6 @@ class CompiledPTA:
     red_cos_ix: object         # (P, Kr)
     ec_cols: object            # (P, We) -> b columns (pad Bmax)
     ec_ix: object              # (P, We) -> xe
-    #: whitened-basis factors for the b-draw (see jax_backend.draw_b_fn):
-    #: ``C = chol(T^T diag(1/sigma^2) T + pad)`` per pulsar in f64 on host,
-    #: ``U = (T C^-T) / sigma`` satisfies U^T diag(sigma^2/N_ref) U = I, so
-    #: the per-sweep Gram matrix U^T diag(g) U has O(1) entries and runs on
-    #: the MXU in the storage dtype; ``Vw = C^-T`` maps whitened
-    #: coefficients back (b = Vw b_tilde)
-    Uw: object                 # (P, Nmax, Bmax) storage dtype
-    Vw: object                 # (P, Bmax, Bmax) float64
-    ys: object                 # (P, Nmax) y/sigma, storage dtype
     #: per-pulsar positions (in x) of that pulsar's white-noise parameters
     #: (pad nx) and their counts — the white conditional factorizes over
     #: pulsars given b, so the device backend runs P independent
@@ -232,12 +224,13 @@ class CompiledPTA:
         equad = xev[self.equad_ix]
         return efac * efac * self.sigma2 + 10.0 ** (2.0 * equad)
 
-    def phi(self, x):
+    def phi(self, x, dtype=None):
         """(P, Bmax) per-column prior variance (pads = 1)."""
         import jax.numpy as jnp
 
-        xev = self.xe(x)
-        phi = jnp.asarray(self.phi_base, dtype=self.cdtype)
+        dtype = dtype or self.cdtype
+        xev = self.xe(x).astype(dtype)
+        phi = jnp.asarray(self.phi_base, dtype=dtype)
         rows = jnp.arange(self.P)[:, None]
         for c in self.components:
             if c.kind in ("free_spectrum", "ecorr"):
@@ -435,40 +428,6 @@ def compile_pta(pta, pad_pulsars: int | None = None) -> CompiledPTA:
             sl_ = m._slices[s.name]
             phi_base[ii, sl_.start:sl_.stop] = 0.0
 
-    # ---- whitened-basis factors for the b-draw -----------------------------
-    Uw = np.zeros((P, Nmax, Bmax), np_dtype)
-    Vw = np.zeros((P, Bmax, Bmax), np.float64)
-    ys = np.zeros((P, Nmax), np_dtype)
-    for ii, m in enumerate(models):
-        n, w = m.pulsar.ntoa, widths[ii]
-        Tp = np.zeros((Nmax, Bmax))
-        Tp[:n, :w] = m.get_basis()
-        sig = np.ones(Nmax)
-        sig[:n] = m.pulsar.toaerrs
-        A = Tp.T @ (Tp / sig[:, None] ** 2)
-        A[np.arange(w, Bmax), np.arange(w, Bmax)] = 1.0  # pad columns
-        # low-frequency Fourier columns are nearly degenerate with the
-        # quadratic timing columns (cond ~ 1e16): jitter until the factor
-        # exists — any invertible V is a valid whitener, conditioning of
-        # the degenerate directions is restored by the Sigma_t ridge in
-        # draw_b_fn
-        jit_ = 1e-13 * np.trace(A) / Bmax
-        for _ in range(20):
-            try:
-                C = np.linalg.cholesky(A + jit_ * np.eye(Bmax))
-                break
-            except np.linalg.LinAlgError:
-                jit_ *= 10.0
-        else:
-            raise np.linalg.LinAlgError(
-                f"whitening factor failed for pulsar {m.pulsar.name}")
-        V = np.linalg.inv(C).T
-        Uw[ii] = (Tp @ V) / sig[:, None]
-        Vw[ii] = V
-        ys[ii, :n] = m.pulsar.residuals / sig[:n]
-    for ii in range(P_real, P):
-        Vw[ii] = np.eye(Bmax)
-
     # ---- GP components, grouped by position in the per-model signal lists --
     components: list = []
     n_fourier = {len(m._fourier) for m in models}
@@ -657,6 +616,14 @@ def compile_pta(pta, pad_pulsars: int | None = None) -> CompiledPTA:
         pb[ct:ct + nsc] = b_
         ct += nsc
 
+    # single-site proposal scale tied to each coordinate's prior width:
+    # scale-free (an efac spanning [0.01, 10] and an equad spanning 3.5
+    # decades both get jumps that traverse the support in ~10 moves at the
+    # scale-mixture's upper end), unlike the reference's dimension-scaled
+    # sigma = 0.05 * blockdim (pulsar_gibbs.py:346) which under-steps small
+    # per-pulsar blocks started far from the mode
+    prop_scale = np.where(pkind == 1, pb, 0.1 * np.abs(pb - pa))
+
     try:
         rhomin, rhomax = rho_bounds(pta, "gw")
     except ValueError:
@@ -676,6 +643,7 @@ def compile_pta(pta, pad_pulsars: int | None = None) -> CompiledPTA:
         const_pool=np.asarray(pool, np_dtype), phi_base=phi_base,
         components=components,
         pkind=pkind, pa=pa, pb=pb,
+        prop_scale=prop_scale.astype(np_dtype),
         idx=BlockIndex.build(names),
         gw_sin_ix=_as_i32(gw_sin if gw_sin is not None else zeros_pk),
         gw_cos_ix=_as_i32(gw_cos if gw_cos is not None else zeros_pk),
@@ -699,7 +667,6 @@ def compile_pta(pta, pad_pulsars: int | None = None) -> CompiledPTA:
         red_cos_ix=_as_i32(red_cos if red_cos is not None
                            else np.zeros((P, max(Kr, 1)))),
         ec_cols=ec_cols, ec_ix=ec_ix,
-        Uw=Uw, Vw=Vw, ys=ys,
         white_par_ix=white_par_ix, white_nper=white_nper,
         ecorr_par_ix=ecorr_par_ix, ecorr_nper=ecorr_nper,
         rhomin=float(rhomin), rhomax=float(rhomax),

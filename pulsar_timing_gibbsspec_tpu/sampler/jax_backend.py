@@ -114,56 +114,38 @@ def lnlike_fullmarg_fn(cm: CompiledPTA, x, TNT, d):
 
 
 def draw_b_fn(cm: CompiledPTA, x, key):
-    """b | everything: batched Gaussian draw in the *whitened* basis
+    """b | everything: batched preconditioned-Cholesky Gaussian draw
     (reference ``update_b``, ``pulsar_gibbs.py:489-520``).
 
-    The naive ``Sigma = T^T N^-1 T + diag(phi^-1)`` needs f64 accumulation
-    (oscillatory Fourier-column products cancel catastrophically in f32,
-    and kappa ~ 1e4 amplifies the error into the conditional mean), but the
-    f64 einsum is emulated off the MXU — the dominant cost of the whole
-    sweep.  Using the compile-time factors ``U`` (with ``U^T U = I``) and
-    ``Vw = C^-T`` instead:
-
-        Sigma_t = U^T diag(g) U + Vw^T diag(phi^-1) Vw,   g = sigma^2/N
-        b = Vw N(Sigma_t^-1 d_t, Sigma_t^-1),  d_t = U^T (g * y/sigma)
-
-    The (P, Nmax, Bmax^2) Gram einsum now has O(1) entries and runs in the
-    storage dtype on the MXU; since the f32 rounding perturbs exactly the
-    component of Sigma_t that provides its smallest eigenvalue, the solve
-    error stays ~4e-6 of the conditional mean regardless of phi's 1e20
-    dynamic range.  Only the O(P B^3) phi-projection and Cholesky stay f64.
+    Computed from ``Sigma = T^T N^-1 T + diag(phi^-1)`` with f64
+    accumulation (see :func:`tnt_d`).  A whitened-basis f32 variant was
+    benchmarked ~9 ms/sweep faster but cannot resolve the near-degenerate
+    Fourier/timing directions (preconditioned lambda_min ~ 1e-7 is below
+    f32 entry rounding), producing O(0.1 sigma) conditional-mean errors —
+    correctness keeps the f64-accumulated path.
     """
-    import jax.numpy as jnp
     import jax.random as jr
 
-    from ..ops.linalg import mvn_conditional_draw_dense
+    from ..ops.linalg import mvn_conditional_draw
 
     N = cm.ndiag_fast(x)
-    g = jnp.asarray(cm.sigma2) / N
-    Sg = jnp.einsum("pnb,pn,pnc->pbc", cm.Uw, g, cm.Uw,
-                    precision="highest")
-    dt = jnp.einsum("pnb,pn->pb", cm.Uw, g * jnp.asarray(cm.ys),
-                    precision="highest")
-    phiinv = (1.0 / cm.phi(x))
-    Phit = jnp.einsum("pkb,pk,pkc->pbc", cm.Vw, phiinv, cm.Vw)
-    # ridge >> the f32 Gram rounding (~3e-6): keeps Sigma_t SPD in the
-    # data-degenerate directions; biases posterior variances by ~1e-5
-    # relative, orders of magnitude under MC error
-    ridge = 1e-5 * jnp.eye(cm.Bmax, dtype=cm.cdtype)
-    Sigma_t = Sg.astype(cm.cdtype) + Phit + ridge
+    TNT, d = tnt_d(cm, N)
+    phi = cm.phi(x)
     z = jr.normal(key, (cm.P, cm.Bmax), dtype=cm.cdtype)
-    bt, _ = mvn_conditional_draw_dense(Sigma_t, dt.astype(cm.cdtype), z)
-    return jnp.einsum("pbc,pc->pb", cm.Vw, bt)
+    b, _ = mvn_conditional_draw(TNT, 1.0 / phi, d, z)
+    return b
 
 
-def _mh_step(cm: CompiledPTA, lnlike, ind, sigma):
+def _mh_step(cm: CompiledPTA, lnlike, ind):
     """One single-site Metropolis step with the reference's scale-mixture
-    proposal (``pulsar_gibbs.py:344-351``); returns a scan body."""
+    proposal (``pulsar_gibbs.py:344-351``), jump sd tied to the chosen
+    coordinate's prior width; returns a scan body."""
     import jax.numpy as jnp
     import jax.random as jr
 
     scales = jnp.asarray(_SCALES, dtype=cm.cdtype)
     probs = jnp.asarray(_SCALE_P, dtype=cm.cdtype)
+    prop = jnp.asarray(cm.prop_scale, dtype=cm.cdtype)
     ind = jnp.asarray(ind)
 
     def step(carry, key):
@@ -171,7 +153,7 @@ def _mh_step(cm: CompiledPTA, lnlike, ind, sigma):
         k1, k2, k3, k4 = jr.split(key, 4)
         scale = jr.choice(k1, scales, p=probs)
         j = ind[jr.randint(k2, (), 0, len(ind))]
-        q = x.at[j].add(jr.normal(k3, dtype=cm.cdtype) * sigma * scale)
+        q = x.at[j].add(jr.normal(k3, dtype=cm.cdtype) * prop[j] * scale)
         lp1 = cm.lnprior(q)
         ll1 = lnlike(q)
         ok = jnp.isfinite(lp1) & jnp.isfinite(ll1)
@@ -185,13 +167,13 @@ def _mh_step(cm: CompiledPTA, lnlike, ind, sigma):
     return step
 
 
-def mh_scan(cm: CompiledPTA, x, key, lnlike, ind, sigma, nsteps):
+def mh_scan(cm: CompiledPTA, x, key, lnlike, ind, nsteps):
     """Fixed-length single-site MH sub-chain; returns (x', recorded block
     coordinates (nsteps, len(ind)))."""
     import jax
     import jax.random as jr
 
-    step = _mh_step(cm, lnlike, ind, sigma)
+    step = _mh_step(cm, lnlike, ind)
     carry = (x, lnlike(x), cm.lnprior(x))
     (x, _, _), rec = jax.lax.scan(step, carry, jr.split(key, nsteps))
     return x, rec
@@ -228,14 +210,14 @@ def parallel_mh_scan(cm: CompiledPTA, x, key, ll_per_fn, par_ix, nper,
     probs = jnp.asarray(_SCALE_P, dtype=fdt)
     nper = jnp.asarray(nper)
     par_ix = jnp.asarray(par_ix)
-    sigma = 0.05 * nper.astype(fdt)
+    prop = jnp.asarray(cm.prop_scale, dtype=fdt)
     live = nper > 0
 
     k1, k2, k3, k4 = jr.split(key, 4)
     scale = jr.choice(k1, scales, (nsteps, cm.P), p=probs)
     jloc = jnp.floor(jr.uniform(k2, (nsteps, cm.P), dtype=fdt)
                      * jnp.maximum(nper, 1)).astype(jnp.int32)
-    noise = jr.normal(k3, (nsteps, cm.P), dtype=fdt) * sigma * scale
+    noise = jr.normal(k3, (nsteps, cm.P), dtype=fdt) * scale
     logu = jnp.log(jr.uniform(k4, (nsteps, cm.P), dtype=fdt))
 
     def step(carry, inp):
@@ -243,6 +225,7 @@ def parallel_mh_scan(cm: CompiledPTA, x, key, ll_per_fn, par_ix, nper,
         jl, nz, lu = inp
         j = jnp.take_along_axis(par_ix, jl[:, None], axis=1)[:, 0]
         xj = x[jnp.minimum(j, cm.nx - 1)]
+        nz = nz * prop[jnp.minimum(j, cm.nx - 1)]
         qj = xj + nz
         dlp = (cm.coord_logpdf(j, qj.astype(fdt))
                - cm.coord_logpdf(j, xj.astype(fdt)))
@@ -527,7 +510,8 @@ class JaxGibbsDriver:
     def __init__(self, pta, hypersample="conditional", redsample=None,
                  seed=None, common_rho=False, white_adapt_iters=1000,
                  red_adapt_iters=2000, red_steps=20, chunk_size=None,
-                 pad_pulsars=None, mesh=None):
+                 pad_pulsars=None, mesh=None, warmup_sweeps=50,
+                 warmup_white_steps=16):
         settings.apply()
         import jax
         import jax.random as jr
@@ -543,6 +527,8 @@ class JaxGibbsDriver:
         self.red_adapt_iters = red_adapt_iters
         self.red_steps = red_steps
         self.chunk_size = chunk_size or settings.chunk_size
+        self.warmup_sweeps = warmup_sweeps
+        self.warmup_white_steps = warmup_white_steps
         self.key = jr.key(np.random.SeedSequence(seed).generate_state(1)[0])
         self.common_rho = common_rho
 
@@ -639,8 +625,7 @@ class JaxGibbsDriver:
                 TNT, d = tnt_d(cm, N)
                 return mh_scan(cm, x, k,
                                lambda q: lnlike_fullmarg_fn(cm, q, TNT, d),
-                               cm.idx.red, 0.05 * len(cm.idx.red),
-                               self.red_adapt_iters)
+                               cm.idx.red, self.red_adapt_iters)
 
             x, rec = jax.jit(adapt)(x, k)
             rec = np.asarray(rec, dtype=np.float64)
@@ -720,6 +705,62 @@ class JaxGibbsDriver:
 
         return body
 
+    def _warmup_body(self):
+        """Pre-adaptation sweep: fixed-length single-site white/ECORR
+        sub-chains and prior-scaled joint red MH.  The reference adapts at
+        the initial state (``pulsar_gibbs.py:332-406`` runs its 1000-step
+        adaptation on sweep 0), where the conditional posterior can sit in
+        a transient corner (huge prior-drawn rho -> b interpolates the data
+        -> white noise pinned at the prior floor); warming up first makes
+        the measured covariances and ACT describe the stationary region."""
+        import jax.random as jr
+
+        cm = self.cm
+        nw = self.warmup_white_steps
+
+        def body(carry, key):
+            x, b = carry
+            out = (x, b)
+            k = jr.split(key, 6)
+            if len(cm.idx.white):
+                r2 = residual_sq(cm, b)
+                x, _ = parallel_mh_scan(cm, x, k[0], white_ll_rel(cm, x, r2),
+                                        cm.white_par_ix, cm.white_nper, nw,
+                                        record=False)
+            if len(cm.idx.ecorr) and cm.ec_cols.shape[1]:
+                x, _ = parallel_mh_scan(cm, x, k[1], ecorr_ll_rel(cm, x, b),
+                                        cm.ecorr_par_ix, cm.ecorr_nper, nw,
+                                        record=False)
+            if self.do_red_conditional:
+                x = red_conditional_update(cm, x, b, k[2])
+            if self.do_red_mh:
+                tau = cm.gw_tau(b)
+                x, _ = mh_scan(cm, x, k[5],
+                               lambda q: lnlike_red_fn(cm, q, tau),
+                               cm.idx.red, self.red_steps)
+            if cm.K and len(cm.rho_ix_x):
+                x = rho_update(cm, x, b, k[3])
+            b = draw_b_fn(cm, x, k[4])
+            return (x, b), out
+
+        return body
+
+    def _warmup_chunk_fn(self, n):
+        if ("warmup", n) not in self._sweep_fns:
+            import jax
+            import jax.random as jr
+
+            body = self._warmup_body()
+
+            def run_chunk(x, b, base_key, it0):
+                keys = jax.vmap(lambda t: jr.fold_in(base_key, t))(
+                    it0 + jax.numpy.arange(n))
+                (x, b), (xs, bs) = jax.lax.scan(body, (x, b), keys)
+                return x, b, xs, bs
+
+            self._sweep_fns[("warmup", n)] = jax.jit(run_chunk)
+        return self._sweep_fns[("warmup", n)]
+
     def _chunk_fn(self, n):
         """Jitted scan of ``n`` sweeps (cached per length).
 
@@ -756,10 +797,24 @@ class JaxGibbsDriver:
         x = jnp.asarray(np.asarray(x, dtype=np.float64), dtype=cm.cdtype)
         ii = start
         if ii == 0:
-            chain[0] = np.asarray(x, dtype=np.float64)
-            bchain[0] = self._b_flat(self.b)
+            W = min(self.warmup_sweeps, max(0, niter - 1))
+            if W > 0:
+                self.key, sub = self._jr.split(self.key)
+                fn = self._warmup_chunk_fn(W)
+                x, b, xs, bs = fn(x, jnp.asarray(self.b), sub,
+                                  jnp.asarray(0, jnp.int32))
+                self.b = b
+                chain[0:W] = np.asarray(xs, dtype=np.float64)
+                bchain[0:W] = self._b_flat(bs)
+            else:
+                chain[0] = np.asarray(x, dtype=np.float64)
+                bchain[0] = self._b_flat(self.b)
+                W = 0 if niter <= 1 else 1
+            row = max(W, 0)
+            chain[row if W else 0] = np.asarray(x, dtype=np.float64)
+            bchain[row if W else 0] = self._b_flat(self.b)
             x = self._first_sweep(x)
-            ii = 1
+            ii = row + 1 if W else 1
             self.x_cur = np.asarray(x, dtype=np.float64)
             yield ii
         while ii < niter:
