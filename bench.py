@@ -17,12 +17,13 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 
 import numpy as np
 
-REFDATA = "/root/reference/simulated_data"
+REFDATA = os.environ.get("PTGIBBS_REFDATA", "/root/reference/simulated_data")
 
 
 def build_pta(n_psr=45, nbins=10):

@@ -19,6 +19,11 @@ def _load():
     so = Path(__file__).parent / "libptgibbs_native.so"
     if so.exists():
         lib = ctypes.CDLL(str(so))
+        # a stale .so built before a symbol was added must degrade to the
+        # NumPy fallback, not break available()
+        if not (hasattr(lib, "ptg_integrated_act")
+                and hasattr(lib, "ptg_integrated_act_many")):
+            return None
         lib.ptg_integrated_act.restype = ctypes.c_double
         lib.ptg_integrated_act.argtypes = [
             ctypes.POINTER(ctypes.c_double), ctypes.c_long, ctypes.c_double]

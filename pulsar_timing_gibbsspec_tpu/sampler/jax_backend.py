@@ -437,9 +437,13 @@ def rho_update(cm: CompiledPTA, x, b, key):
         return x
     tau = cm.gw_tau(b)  # (P, K)
     if cm.P_real == 1 and cm.red_kind == "":
-        t = tau[0]
+        # clamp tau away from zero: at tau=0 the inverse-CDF below is 0/0
+        # (the NaN source of round 1 — b starts at zeros), and the clamped
+        # draw converges to the correct tau->0 limit p(rho) ~ 1/rho^2 with
+        # relative density error exp(-tau_min/rho) - 1 ~ 1e-6
+        t = jnp.maximum(tau[0], cm.rhomin * 1e-6)
         k1, = jr.split(key, 1)
-        hi = 1.0 - jnp.exp(t / cm.rhomax - t / cm.rhomin)
+        hi = -jnp.expm1(t / cm.rhomax - t / cm.rhomin)
         eta = hi * jr.uniform(k1, t.shape, dtype=cm.cdtype)
         rhonew = t / (t / cm.rhomax - jnp.log1p(-eta))
     else:
@@ -790,6 +794,23 @@ class JaxGibbsDriver:
         """(..., P, Bmax) -> (..., nb_total) reference layout."""
         return np.asarray(b_arr, dtype=np.float64)[..., self._b_pi, self._b_ci]
 
+    @staticmethod
+    def _check_finite(arr, it0, what):
+        """Host-side numerical-fault detector on every chunk writeback.
+
+        The reference degrades gracefully on numerical failure (QR fallback
+        ``pulsar_gibbs.py:511-516``, -inf likelihood ``:603-604``); the
+        compiled sweep instead guarantees that a non-finite state never
+        reaches the chain files silently (round-1 regression class: an NaN
+        propagated through 2000 sweeps unnoticed)."""
+        bad = ~np.isfinite(arr)
+        if bad.any():
+            first = int(np.argwhere(bad.any(axis=tuple(range(1, arr.ndim))))[0])
+            raise FloatingPointError(
+                f"non-finite {what} written at iteration {it0 + first}: "
+                "the device sweep produced NaN/inf — check priors/initial "
+                "state; chain files up to the previous checkpoint are valid")
+
     def run(self, x, chain, bchain, start, niter):
         import jax.numpy as jnp
 
@@ -797,6 +818,11 @@ class JaxGibbsDriver:
         x = jnp.asarray(np.asarray(x, dtype=np.float64), dtype=cm.cdtype)
         ii = start
         if ii == 0:
+            # draw b from the initial state before any conditional touches
+            # it (oracle order, numpy_backend.py:319-321): the first warmup
+            # sweep's rho draw then sees real tau, not the b=0 singularity
+            self.key, k0 = self._jr.split(self.key)
+            self.b = self._jit_draw_b(x, k0)
             W = min(self.warmup_sweeps, max(0, niter - 1))
             if W > 0:
                 self.key, sub = self._jr.split(self.key)
@@ -804,8 +830,12 @@ class JaxGibbsDriver:
                 x, b, xs, bs = fn(x, jnp.asarray(self.b), sub,
                                   jnp.asarray(0, jnp.int32))
                 self.b = b
-                chain[0:W] = np.asarray(xs, dtype=np.float64)
-                bchain[0:W] = self._b_flat(bs)
+                xs_h = np.asarray(xs, dtype=np.float64)
+                self._check_finite(xs_h, 0, "warmup state")
+                bs_h = self._b_flat(bs)
+                self._check_finite(bs_h, 0, "warmup b coefficients")
+                chain[0:W] = xs_h
+                bchain[0:W] = bs_h
             else:
                 chain[0] = np.asarray(x, dtype=np.float64)
                 bchain[0] = self._b_flat(self.b)
@@ -823,8 +853,12 @@ class JaxGibbsDriver:
             x, b, xs, bs = fn(x, jnp.asarray(self.b), self.key,
                               jnp.asarray(ii, dtype=jnp.int32))
             self.b = b
-            chain[ii:ii + n] = np.asarray(xs, dtype=np.float64)
-            bchain[ii:ii + n] = self._b_flat(bs)
+            xs_h = np.asarray(xs, dtype=np.float64)
+            self._check_finite(xs_h, ii, "chain state")
+            bs_h = self._b_flat(bs)
+            self._check_finite(bs_h, ii, "b coefficients")
+            chain[ii:ii + n] = xs_h
+            bchain[ii:ii + n] = bs_h
             ii += n
             self.x_cur = np.asarray(x, dtype=np.float64)
             yield ii

@@ -23,7 +23,7 @@ jax.config.update("jax_platforms", "cpu")
 import numpy as np
 import pytest
 
-REFDATA = "/root/reference/simulated_data"
+REFDATA = os.environ.get("PTGIBBS_REFDATA", "/root/reference/simulated_data")
 
 
 @pytest.fixture(scope="session")

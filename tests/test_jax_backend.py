@@ -184,6 +184,9 @@ def test_jax_resume_bitwise(j1713, tmp_path):
     resumed = g_b.sample(x0, outdir=str(tmp_path / "split"), niter=100,
                          resume=True, save_every=20)
 
+    # finiteness first: assert_array_equal treats NaN==NaN as equal, which
+    # made this test pass vacuously on NaN-poisoned chains in round 1
+    assert np.all(np.isfinite(full))
     np.testing.assert_array_equal(resumed, full)
 
 
