@@ -179,70 +179,6 @@ def mh_scan(cm: CompiledPTA, x, key, lnlike, ind, nsteps):
     return x, rec
 
 
-def parallel_mh_scan(cm: CompiledPTA, x, key, ll_per_fn, par_ix, nper,
-                     nsteps, record=True):
-    """P independent per-pulsar single-site MH chains, advanced in lockstep.
-
-    The white-noise (and ECORR) conditionals factorize over pulsars given b,
-    so one device step advances *every* pulsar's sub-chain at once: proposals
-    touch disjoint coordinate sets, ``ll_per_fn(x) -> (P,)`` gives per-pulsar
-    likelihoods (absolute or block-relative — MH only consumes differences),
-    and acceptance is per pulsar.  This replaces the reference's joint
-    single-site walk over the whole white block (``pulsar_gibbs.py:332-406``)
-    with an exactly-equivalent product-measure Gibbs block that does P times
-    the mixing work per step — and needs no cross-device collective when the
-    pulsar axis is sharded.
-
-    All per-step randomness (scale mixture, coordinate choice, jump, accept
-    threshold) is generated vectorized *outside* the scan in the storage
-    dtype: the scan body is then pure arithmetic, which keeps the compiled
-    step to a handful of fused kernels (profiled ~6x faster than in-body
-    threefry splitting in f64).
-
-    Returns ``(x', recorded (nsteps, P, W) block coordinates or None)``.
-    """
-    import jax
-    import jax.numpy as jnp
-    import jax.random as jr
-
-    fdt = cm.dtype
-    scales = jnp.asarray(_SCALES, dtype=fdt)
-    probs = jnp.asarray(_SCALE_P, dtype=fdt)
-    nper = jnp.asarray(nper)
-    par_ix = jnp.asarray(par_ix)
-    prop = jnp.asarray(cm.prop_scale, dtype=fdt)
-    live = nper > 0
-
-    k1, k2, k3, k4 = jr.split(key, 4)
-    scale = jr.choice(k1, scales, (nsteps, cm.P), p=probs)
-    jloc = jnp.floor(jr.uniform(k2, (nsteps, cm.P), dtype=fdt)
-                     * jnp.maximum(nper, 1)).astype(jnp.int32)
-    noise = jr.normal(k3, (nsteps, cm.P), dtype=fdt) * scale
-    logu = jnp.log(jr.uniform(k4, (nsteps, cm.P), dtype=fdt))
-
-    def step(carry, inp):
-        x, ll0 = carry
-        jl, nz, lu = inp
-        j = jnp.take_along_axis(par_ix, jl[:, None], axis=1)[:, 0]
-        xj = x[jnp.minimum(j, cm.nx - 1)]
-        nz = nz * prop[jnp.minimum(j, cm.nx - 1)]
-        qj = xj + nz
-        dlp = (cm.coord_logpdf(j, qj.astype(fdt))
-               - cm.coord_logpdf(j, xj.astype(fdt)))
-        q = x.at[j].add(nz.astype(x.dtype), mode="drop")
-        ll1 = ll_per_fn(q)
-        ok = jnp.isfinite(dlp) & jnp.isfinite(ll1)
-        logr = jnp.where(ok, (ll1 - ll0) + dlp, -jnp.inf)
-        acc = (logr > lu) & live
-        x = x.at[j].add(jnp.where(acc, nz, 0.0).astype(x.dtype), mode="drop")
-        ll0 = jnp.where(acc, ll1, ll0)
-        out = x[jnp.minimum(par_ix, cm.nx - 1)] if record else None
-        return (x, ll0), out
-
-    (x, _), rec = jax.lax.scan(step, (x, ll_per_fn(x)), (jloc, noise, logu))
-    return x, rec
-
-
 def parallel_cov_mh_scan(cm: CompiledPTA, x, key, ll_per_fn, par_ix, nper,
                          chol, nsteps, record=True):
     """Per-pulsar *full-block* MH with adapted covariance proposals.
@@ -304,33 +240,124 @@ def parallel_cov_mh_scan(cm: CompiledPTA, x, key, ll_per_fn, par_ix, nper,
     return x, rec
 
 
-def block_cov_chol(rec, nper, P_real):
-    """(P, W, W) per-pulsar Cholesky factors of the adapted block covariance
-    from a recorded (steps, P, W) chain; invalid rows/cols zeroed, tiny
-    jitter for rank safety."""
-    rec = np.asarray(rec, dtype=np.float64)
-    S, P, W = rec.shape
-    chol = np.zeros((P, W, W))
-    for p in range(P_real):
-        w = int(nper[p])
-        if w == 0:
-            continue
-        seg = rec[S // 2:, p, :w]
-        cov = np.atleast_2d(np.cov(seg, rowvar=False))
-        cov += (1e-10 * max(np.trace(cov) / w, 1e-12)
-                + 1e-14) * np.eye(w)
-        chol[p, :w, :w] = np.linalg.cholesky(cov)
-    return chol
+def _prior_halfwidth2(cm: CompiledPTA):
+    """(nx,) squared prior half-widths (normal: (1 sd)^2 scaled to 2 sd)."""
+    w = np.where(np.asarray(cm.pkind) == 1, 2.0 * np.asarray(cm.pb),
+                 np.abs(np.asarray(cm.pb) - np.asarray(cm.pa)))
+    return (0.5 * w) ** 2
+
+
+def laplace_newton_chol(cm: CompiledPTA, x, ll_per_fn, par_ix, nper,
+                        newton_iters=8):
+    """Per-pulsar Laplace proposal square roots for a factorized MH block.
+
+    The white/ECORR conditionals given ``b`` are near-Gaussian (hundreds of
+    TOAs per pulsar), so instead of the reference's empirical random-walk
+    adaptation (``pulsar_gibbs.py:332-371`` — which collapses when the
+    initial single-site walk never moves a tightly-constrained EFAC, the
+    round-1 white-mixing failure), the proposal covariance comes from the
+    *analytic* local curvature:
+
+    1. a few damped, per-pulsar-vectorized Newton steps move each block to
+       its conditional mode (pure initialization — does not affect the
+       stationary distribution);
+    2. the negative block Hessian ``A = -H`` is eigendecomposed and the
+       proposal square root is ``L = V diag(1/sqrt(clip(e)))``, eigenvalues
+       floored so no proposal sd exceeds half the prior width
+       (likelihood-unconstrained directions walk the prior at O(1)
+       acceptance instead of freezing).
+
+    The block Hessian is computed with ``W`` Hessian-vector products shared
+    across all pulsars at once — cross-pulsar blocks vanish because the
+    conditional factorizes, so a tangent of ``e_w`` broadcast over pulsars
+    returns every pulsar's ``H[:, :, w]`` column in one pass.
+
+    Returns ``(x_at_mode, L)`` with ``L`` (P, W, W) and pad rows zeroed.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    P, W = par_ix.shape
+    cdt = cm.cdtype
+    par_ix = jnp.asarray(par_ix)
+    nper = jnp.asarray(nper)
+    safe_ix = jnp.minimum(par_ix, cm.nx - 1)
+    wmask = jnp.arange(W)[None, :] < nper[:, None]          # (P, W) bool
+    live = nper > 0
+
+    hw2 = jnp.asarray(_prior_halfwidth2(cm), cdt)[safe_ix]  # (P, W)
+    vmax = jnp.max(jnp.where(wmask, hw2, 1e-30), axis=1)    # (P,)
+    pk = jnp.asarray(cm.pkind)[safe_ix]
+    a = jnp.asarray(cm.pa, cdt)[safe_ix]
+    b_ = jnp.asarray(cm.pb, cdt)[safe_ix]
+    lo = jnp.where(pk == 1, a - 8.0 * b_, a)
+    hi = jnp.where(pk == 1, a + 8.0 * b_, b_)
+    margin = 1e-6 * (hi - lo)
+    lo, hi = lo + margin, hi - margin
+
+    x = jnp.asarray(x, cdt)
+    theta0 = x[safe_ix]
+    eyeW = jnp.eye(W, dtype=cdt)
+
+    def q_of(theta):
+        return x.at[par_ix].set(jnp.where(wmask, theta, theta0), mode="drop")
+
+    def f_sum(theta):
+        return jnp.sum(ll_per_fn(q_of(theta)).astype(cdt))
+
+    grad_f = jax.grad(f_sum)
+
+    def decomp(theta):
+        cols = [jax.jvp(grad_f, (theta,),
+                        (jnp.broadcast_to(eyeW[w], (P, W)),))[1]
+                for w in range(W)]
+        H = jnp.stack(cols, axis=-1)                        # (P, W, W)
+        A = -0.5 * (H + jnp.swapaxes(H, 1, 2))
+        mo = wmask[:, :, None] & wmask[:, None, :]
+        A = (jnp.where(mo, A, 0.0)
+             + jnp.where(wmask, 0.0, 1.0)[:, :, None] * eyeW[None])
+        return jnp.linalg.eigh(A)
+
+    def newton_body(theta, _):
+        g = grad_f(theta)
+        e, V = decomp(theta)
+        # saddle-free Newton: |e| handles the log-convex far tail (e.g.
+        # lnL ~ -n log(efac) at efac >> mode has e < 0); the floor keeps
+        # steps <= O(prior width); per-pulsar keep-if-better damps the rest
+        e = jnp.maximum(jnp.abs(e), 1.0 / vmax[:, None])
+        step = jnp.einsum("pwk,pk->pw", V,
+                          jnp.einsum("pwk,pw->pk", V, g) / e)
+        best = ll_per_fn(q_of(theta))
+        out = theta
+        for alpha in (1.0, 0.25):
+            cand = jnp.clip(theta + alpha * step, lo, hi)
+            llc = ll_per_fn(q_of(cand))
+            better = (llc > best) & live
+            out = jnp.where(better[:, None], cand, out)
+            best = jnp.where(better, llc, best)
+        return out, None
+
+    theta = theta0
+    if newton_iters:
+        theta, _ = jax.lax.scan(newton_body, theta0, None,
+                                length=newton_iters)
+    e, V = decomp(theta)
+    e = jnp.clip(e, 1.0 / vmax[:, None], None)              # sd <= halfwidth
+    L = V * (1.0 / jnp.sqrt(e))[:, None, :]
+    L = L * (wmask[:, :, None] & wmask[:, None, :]).astype(cdt)
+    return q_of(theta), L
 
 
 def white_ll_rel(cm: CompiledPTA, x0, r2):
     """Block-relative per-pulsar white likelihood in the storage dtype.
 
     ``ll(q) - ll(x0)`` with the cancellation done per element *before* the
-    sum: with ``z = N0/Nq``, ``delta_i = 0.5 (log z_i + w_i (z_i - 1))``,
-    ``w_i = r2_i / N0_i``.  Every intermediate is O(1), so float32 carries
-    the MH acceptance differences exactly where the absolute likelihood
-    (~1e6) would quantize them at ~0.06.
+    sum: with ``z = N0/Nq``, ``delta_i = 0.5 (log z_i - w_i (z_i - 1))``,
+    ``w_i = r2_i / N0_i`` (from ``ll = -0.5 (log N + r2/N)`` per element:
+    ``r2 (1/Nq - 1/N0) = w (z - 1)`` enters with a minus).  Every
+    intermediate is O(1), so float32 carries the MH acceptance differences
+    exactly where the absolute likelihood (~1e6) would quantize them at
+    ~0.06.
     """
     import jax.numpy as jnp
 
@@ -345,9 +372,24 @@ def white_ll_rel(cm: CompiledPTA, x0, r2):
         equad = xev[cm.equad_ix]
         Nq = efac * efac * jnp.asarray(cm.sigma2, fdt) + 10.0 ** (2.0 * equad)
         z = N0f / Nq
-        return 0.5 * jnp.sum(mask * (jnp.log(z) + w * (z - 1.0)), axis=1)
+        return 0.5 * jnp.sum(mask * (jnp.log(z) - w * (z - 1.0)), axis=1)
 
     return ll_rel
+
+
+def lnlike_ecorr_per(cm: CompiledPTA, x, b):
+    """Per-pulsar ECORR conditional ll (P,) in the compute dtype: the basis
+    coefficients at the ECORR columns are iid N(0, 10^(2 ecorr)).  Used for
+    Laplace curvature, where the f32 relative form is too noisy."""
+    import jax.numpy as jnp
+
+    cdt = cm.cdtype
+    mask = (cm.ec_cols < cm.Bmax).astype(cdt)
+    bj = jnp.take_along_axis(
+        b, jnp.minimum(cm.ec_cols, cm.Bmax - 1), axis=1).astype(cdt)
+    e = cm.xe(x)[cm.ec_ix]
+    return jnp.sum(mask * (-np.log(10.0) * e
+                           - 0.5 * bj * bj * 10.0 ** (-2.0 * e)), axis=1)
 
 
 def ecorr_ll_rel(cm: CompiledPTA, x0, b):
@@ -515,7 +557,7 @@ class JaxGibbsDriver:
                  seed=None, common_rho=False, white_adapt_iters=1000,
                  red_adapt_iters=2000, red_steps=20, chunk_size=None,
                  pad_pulsars=None, mesh=None, warmup_sweeps=50,
-                 warmup_white_steps=16):
+                 warmup_white_steps=16, white_steps_max=64):
         settings.apply()
         import jax
         import jax.random as jr
@@ -533,6 +575,11 @@ class JaxGibbsDriver:
         self.chunk_size = chunk_size or settings.chunk_size
         self.warmup_sweeps = warmup_sweeps
         self.warmup_white_steps = warmup_white_steps
+        #: cap on the ACT-sized white/ECORR sub-chain length: with Laplace
+        #: proposals the measured ACT is O(few); a larger measurement means
+        #: a near-unidentified parameter whose exactness does not justify
+        #: hundreds of device steps per sweep
+        self.white_steps_max = white_steps_max
         self.key = jr.key(np.random.SeedSequence(seed).generate_state(1)[0])
         self.common_rho = common_rho
 
@@ -582,38 +629,47 @@ class JaxGibbsDriver:
         b = self._jit_draw_b(x, k)
 
         if len(cm.idx.white):
-            r2 = residual_sq(cm, b)
-            # phase 1: single-site walk -> per-pulsar block covariance
+            # Laplace proposals at the conditional mode (replaces the
+            # collapse-prone empirical two-phase adaptation), then one
+            # record scan to measure the ACT that sizes later sub-chains
+            def lap_white(x, b):
+                r2 = residual_sq(cm, b)
+                return laplace_newton_chol(
+                    cm, x, lambda q: lnlike_white_per(cm, q, r2),
+                    cm.white_par_ix, cm.white_nper)
+
+            x, chol = jax.jit(lap_white)(x, b)
+            self.chol_white = np.asarray(chol, np.float64)
             self.key, k = jr.split(self.key)
-            fn = jax.jit(lambda x, k: parallel_mh_scan(
-                cm, x, k, white_ll_rel(cm, x, r2),
-                cm.white_par_ix, cm.white_nper, self.white_adapt_iters))
-            x, rec = fn(x, k)
-            self.chol_white = block_cov_chol(rec, cm.white_nper, cm.P_real)
-            # phase 2: adapted-covariance proposals -> ACT that reflects the
-            # proposal actually used per sweep
-            self.key, k = jr.split(self.key)
-            n2 = max(200, self.white_adapt_iters // 2)
-            fn2 = jax.jit(lambda x, k: parallel_cov_mh_scan(
-                cm, x, k, white_ll_rel(cm, x, r2), cm.white_par_ix,
-                cm.white_nper, self.chol_white, n2))
-            x, rec2 = fn2(x, k)
-            self.aclength_white = self._act_from_rec(rec2, cm.white_nper)
+
+            def rec_white(x, b, k):
+                r2 = residual_sq(cm, b)
+                return parallel_cov_mh_scan(
+                    cm, x, k, white_ll_rel(cm, x, r2), cm.white_par_ix,
+                    cm.white_nper, self.chol_white, self.white_adapt_iters)
+
+            x, rec2 = jax.jit(rec_white)(x, b, k)
+            self.aclength_white = min(self._act_from_rec(rec2, cm.white_nper),
+                                      self.white_steps_max)
 
         if len(cm.idx.ecorr) and cm.ec_cols.shape[1]:
+            def lap_ec(x, b):
+                return laplace_newton_chol(
+                    cm, x, lambda q: lnlike_ecorr_per(cm, q, b),
+                    cm.ecorr_par_ix, cm.ecorr_nper)
+
+            x, chol = jax.jit(lap_ec)(x, b)
+            self.chol_ecorr = np.asarray(chol, np.float64)
             self.key, k = jr.split(self.key)
-            fn = jax.jit(lambda x, k: parallel_mh_scan(
-                cm, x, k, ecorr_ll_rel(cm, x, b),
-                cm.ecorr_par_ix, cm.ecorr_nper, self.white_adapt_iters))
-            x, rec = fn(x, k)
-            self.chol_ecorr = block_cov_chol(rec, cm.ecorr_nper, cm.P_real)
-            self.key, k = jr.split(self.key)
-            n2 = max(200, self.white_adapt_iters // 2)
-            fn2 = jax.jit(lambda x, k: parallel_cov_mh_scan(
-                cm, x, k, ecorr_ll_rel(cm, x, b), cm.ecorr_par_ix,
-                cm.ecorr_nper, self.chol_ecorr, n2))
-            x, rec2 = fn2(x, k)
-            self.aclength_ecorr = self._act_from_rec(rec2, cm.ecorr_nper)
+
+            def rec_ec(x, b, k):
+                return parallel_cov_mh_scan(
+                    cm, x, k, ecorr_ll_rel(cm, x, b), cm.ecorr_par_ix,
+                    cm.ecorr_nper, self.chol_ecorr, self.white_adapt_iters)
+
+            x, rec2 = jax.jit(rec_ec)(x, b, k)
+            self.aclength_ecorr = min(self._act_from_rec(rec2, cm.ecorr_nper),
+                                      self.white_steps_max)
 
         if self.do_red_conditional:
             self.key, k = jr.split(self.key)
@@ -727,14 +783,24 @@ class JaxGibbsDriver:
             out = (x, b)
             k = jr.split(key, 6)
             if len(cm.idx.white):
+                # Laplace proposal square roots recomputed at the current
+                # state each warmup sweep (2 HVPs — cheap) so the white
+                # block actually travels toward the typical set instead of
+                # freezing under prior-width single-site jumps
                 r2 = residual_sq(cm, b)
-                x, _ = parallel_mh_scan(cm, x, k[0], white_ll_rel(cm, x, r2),
-                                        cm.white_par_ix, cm.white_nper, nw,
-                                        record=False)
+                _, chol = laplace_newton_chol(
+                    cm, x, lambda q: lnlike_white_per(cm, q, r2),
+                    cm.white_par_ix, cm.white_nper, newton_iters=0)
+                x, _ = parallel_cov_mh_scan(
+                    cm, x, k[0], white_ll_rel(cm, x, r2), cm.white_par_ix,
+                    cm.white_nper, chol, nw, record=False)
             if len(cm.idx.ecorr) and cm.ec_cols.shape[1]:
-                x, _ = parallel_mh_scan(cm, x, k[1], ecorr_ll_rel(cm, x, b),
-                                        cm.ecorr_par_ix, cm.ecorr_nper, nw,
-                                        record=False)
+                _, chol = laplace_newton_chol(
+                    cm, x, lambda q: lnlike_ecorr_per(cm, q, b),
+                    cm.ecorr_par_ix, cm.ecorr_nper, newton_iters=0)
+                x, _ = parallel_cov_mh_scan(
+                    cm, x, k[1], ecorr_ll_rel(cm, x, b), cm.ecorr_par_ix,
+                    cm.ecorr_nper, chol, nw, record=False)
             if self.do_red_conditional:
                 x = red_conditional_update(cm, x, b, k[2])
             if self.do_red_mh:
@@ -841,8 +907,14 @@ class JaxGibbsDriver:
                 bchain[0] = self._b_flat(self.b)
                 W = 0 if niter <= 1 else 1
             row = max(W, 0)
-            chain[row if W else 0] = np.asarray(x, dtype=np.float64)
-            bchain[row if W else 0] = self._b_flat(self.b)
+            x_h = np.asarray(x, dtype=np.float64)
+            b_h = self._b_flat(self.b)
+            # the final warmup carry is not in xs (the scan records
+            # pre-sweep states), so guard this row separately
+            self._check_finite(x_h[None], row, "post-warmup state")
+            self._check_finite(b_h[None], row, "post-warmup b coefficients")
+            chain[row if W else 0] = x_h
+            bchain[row if W else 0] = b_h
             x = self._first_sweep(x)
             ii = row + 1 if W else 1
             self.x_cur = np.asarray(x, dtype=np.float64)

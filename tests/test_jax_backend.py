@@ -63,12 +63,19 @@ def test_conditionals_match_oracle_at_state(pta8):
     ll_jx = float(jb.lnlike_white_fn(cm, x, r2))
     assert abs(ll_jx - ll_np) < 1e-6 * abs(ll_np)
     rng = np.random.default_rng(3)
+    ll_rel = jb.white_ll_rel(cm, x, r2)
+    rel0 = np.asarray(ll_rel(x))
     for _ in range(5):
         q = x.copy()
         q[rng.choice(g.idx.white)] += 0.1 * rng.standard_normal()
         d_np = g.lnlike_white(q) - ll_np
         d_jx = float(jb.lnlike_white_fn(cm, q, r2)) - ll_jx
         assert abs(d_jx - d_np) < 1e-6 * max(1.0, abs(d_np))
+        # the f32 block-relative form the MH scans consume must agree with
+        # the absolute-likelihood difference (its sign error is the round-2
+        # bug that drove every white chain to the prior floor)
+        d_rel = float(np.sum(np.asarray(ll_rel(q)) - rel0))
+        assert abs(d_rel - d_np) < 1e-3 * max(1.0, abs(d_np))
 
     # common-rho conditional log-PDF grid (sum over pulsars == reference's
     # per-pulsar PDF product, pta_gibbs.py:205)
@@ -158,6 +165,32 @@ def test_jax_vs_numpy_posterior_ks(j1713, tmp_path):
              for k in range(10)]
     # Bonferroni-style: no bin catastrophically off (null-control chains
     # occasionally reach p ~ 1e-3 from residual autocorrelation)
+    assert min(pvals) > 1e-4, pvals
+    assert np.median(pvals) > 0.05, pvals
+
+
+def test_jax_vs_numpy_white_vary_ks(j1713, tmp_path):
+    """KS agreement of the white-noise (EFAC/EQUAD) and rho posteriors when
+    the white block varies — the coverage that was missing when the round-1
+    empirical covariance adaptation collapsed to frozen EFAC chains."""
+    pta = model_general([j1713], tm_svd=True, red_var=False,
+                        white_vary=True, common_psd="spectrum",
+                        common_components=10)
+    x0 = pta.initial_sample(np.random.default_rng(17))
+    chains = {}
+    for backend, seed in [("jax", 3), ("numpy", 4)]:
+        g = PulsarBlockGibbs(pta, backend=backend, seed=seed, progress=False)
+        chains[backend] = g.sample(x0, outdir=str(tmp_path / backend),
+                                   niter=2000)
+    burn, thin = 200, 5
+    idx = BlockIndex.build(pta.param_names)
+    cols = list(idx.white) + list(idx.rho[:4])
+    pvals = [stats.ks_2samp(chains["jax"][burn::thin, k],
+                            chains["numpy"][burn::thin, k]).pvalue
+             for k in cols]
+    # the white chains must actually mix: reject frozen pseudo-chains
+    for k in idx.white:
+        assert np.std(chains["jax"][burn:, k]) > 1e-3
     assert min(pvals) > 1e-4, pvals
     assert np.median(pvals) > 0.05, pvals
 
