@@ -106,7 +106,7 @@ def gumbel_grid_draw(rng, logpdf, grid):
 
 def align_phi(raw, k):
     """Truncate/floor-pad a per-frequency phi array to ``k`` entries."""
-    out = np.full(k, 1e-40)
+    out = np.full(k, 1e-30)
     n = min(k, len(raw))
     out[:n] = raw[:n]
     return out

@@ -37,13 +37,17 @@ from ..models.priors import Constant, LinearExp, Normal, Uniform
 from .blocks import BlockIndex, rho_bounds
 
 #: prior-variance stand-in for "infinite" (marginalized timing-model
-#: columns).  1e40 matches enterprise in f64; f32 caps at 1e30 to stay below
-#: float32 max while remaining >=1e15 times any physical phi.
-BIG_PHI = {"f32": 1e30, "f64": 1e40}
+#: columns).  Enterprise uses 1e40 in f64, but TPU emulates f64 as an
+#: f32 pair (double-double): full f64 *precision*, f32 *exponent range*
+#: (~1e+-38, subnormals flushed to 0).  1e30 stays inside that range on
+#: every backend while remaining >=1e12 times any physical phi.
+BIG_PHI = {"f32": 1e30, "f64": 1e30}
 #: floor used where a red process has fewer modes than the GW grid
 #: (reference pads with a negligible value, see numpy_backend
-#: ``_red_phi_at_gw_freqs``)
-PHI_FLOOR = 1e-40
+#: ``_red_phi_at_gw_freqs``).  1e-30 rather than 1e-40: the latter is a
+#: float32 subnormal, which the TPU flushes to 0 (making 1/phi = inf);
+#: 1e-30 is still <=1e-12 of any physical phi (rho in [1e-18, 1e-8]).
+PHI_FLOOR = 1e-30
 
 _LN10 = np.log(10.0)
 _LN12PI2 = np.log(12.0 * np.pi ** 2)
@@ -241,7 +245,11 @@ class CompiledPTA:
                         for h in range(c.hyp_ix.shape[1])]
                 vals = jnp.exp(fn(c.f, c.df, *args))
             phi = phi.at[rows, c.cols].add(vals, mode="drop")
-        return phi
+        # powerlaw-family phi can underflow to exactly 0 at prior corners
+        # (e.g. log10_A = -20: exp(lnphi) ~ 1e-44 flushes to 0 under the
+        # TPU's f32-exponent-range f64), which would make 1/phi = inf in
+        # the b-draw; the floor is sampling-neutral (see PHI_FLOOR)
+        return jnp.maximum(phi, PHI_FLOOR)
 
     def lnprior(self, x):
         import jax.numpy as jnp
@@ -501,7 +509,7 @@ def compile_pta(pta, pad_pulsars: int | None = None) -> CompiledPTA:
     def fsig(m, frag):
         return next((s for s in m._fourier if frag in s.name), None)
 
-    floor_ref = const_ref(-20.0)  # 10^(2*-20) == PHI_FLOOR
+    floor_ref = const_ref(-15.0)  # 10^(2*-15) == PHI_FLOOR
 
     if any(fsig(m, "gw") for m in models):
         sigs = [fsig(m, "gw") for m in models]

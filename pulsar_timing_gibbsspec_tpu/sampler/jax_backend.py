@@ -38,7 +38,7 @@ import numpy as np
 
 from ..config import settings
 from ..ops.acf import integrated_act
-from .compiled import PHI_FLOOR, CompiledPTA, compile_pta
+from .compiled import CompiledPTA, compile_pta
 
 _SCALES = np.array([0.1, 0.5, 1.0, 3.0, 10.0])
 _SCALE_P = np.array([0.1, 0.15, 0.5, 0.15, 0.1])
@@ -76,11 +76,27 @@ def lnlike_white_fn(cm: CompiledPTA, x, r2):
 def lnlike_white_per(cm: CompiledPTA, x, r2):
     """Per-pulsar white-noise likelihood (P,) — the conditional factorizes
     over pulsars given b, which is what lets the device backend run the
-    white MH as P independent parallel chains."""
+    white MH as P independent parallel chains.
+
+    Evaluated in sigma^2-scaled form ``N = sigma^2 M`` with
+    ``M = efac^2 + 10^(2 equad)/sigma^2``: with raw seconds units
+    (sigma^2 ~ 1e-15) the Hessian of the raw form has intermediates like
+    ``N^3 ~ 1e-42`` that underflow the TPU's f32-exponent-range f64
+    emulation; in scaled form every intermediate of the value, gradient
+    and Hessian is O(1)-O(1e4).  Algebraically identical to
+    ``-0.5 sum(log N + r2/N)`` (reference ``pulsar_gibbs.py:523-546``).
+    """
     import jax.numpy as jnp
 
-    N = cm.ndiag(x)
-    return -0.5 * jnp.sum(cm.toa_mask * (jnp.log(N) + r2 / N), axis=1)
+    cdt = cm.cdtype
+    xev = cm.xe(x)
+    efac = xev[cm.efac_ix]
+    equad = xev[cm.equad_ix]
+    s2 = jnp.asarray(cm.sigma2, cdt)
+    ln_s2 = jnp.log(s2)
+    M = efac * efac + jnp.exp(2.0 * np.log(10.0) * equad - ln_s2)
+    w = r2.astype(cdt) / s2
+    return -0.5 * jnp.sum(cm.toa_mask * (ln_s2 + jnp.log(M) + w / M), axis=1)
 
 
 def lnlike_red_fn(cm: CompiledPTA, x, tau):
@@ -183,16 +199,17 @@ def parallel_cov_mh_scan(cm: CompiledPTA, x, key, ll_per_fn, par_ix, nper,
                          chol, nsteps, record=True):
     """Per-pulsar *full-block* MH with adapted covariance proposals.
 
-    After the single-site adaptation pass measures each pulsar's block
-    covariance, later sub-chains propose all of a pulsar's block parameters
-    jointly: ``q_p = x_p + scale * (2.38/sqrt(W_p)) L_p z`` (the standard AM
+    Each sub-chain proposes all of a pulsar's block parameters jointly:
+    ``q_p = x_p + scale * (2.38/sqrt(W_p)) L_p z`` (the standard AM
     scaling; the reference gets the same effect from PTMCMCSampler's AM/SCAM
     jumps, ``pulsar_gibbs.py:288-296``).  Joint adapted proposals cut the
     measured autocorrelation time — and hence the static per-sweep scan
     length — by roughly the block dimension relative to single-site walks.
 
-    ``chol`` is (P, W, W): per-pulsar lower Cholesky factors of the adapted
-    covariances, rows/cols beyond ``nper[p]`` zeroed.
+    ``chol`` is (P, W, W): any per-pulsar square roots of the proposal
+    covariances (in practice the Laplace eigen square roots from
+    :func:`laplace_newton_chol` — not triangular), rows/cols beyond
+    ``nper[p]`` zeroed.
     """
     import jax
     import jax.numpy as jnp
@@ -229,9 +246,10 @@ def parallel_cov_mh_scan(cm: CompiledPTA, x, key, ll_per_fn, par_ix, nper,
         ok = jnp.isfinite(dlp) & jnp.isfinite(ll1)
         logr = jnp.where(ok, (ll1 - ll0) + dlp, -jnp.inf)
         acc = (logr > lu) & live
+        # where(acc, nz, 0) rather than nz * acc: a non-finite proposal
+        # (NaN * 0 = NaN) must never poison an unaccepted state
         x = x.at[par_ix].add(
-            (nz * acc[:, None].astype(nz.dtype)).astype(x.dtype),
-            mode="drop")
+            jnp.where(acc[:, None], nz, 0.0).astype(x.dtype), mode="drop")
         ll0 = jnp.where(acc, ll1, ll0)
         out = x[safe_ix] if record else None
         return (x, ll0), out
@@ -784,7 +802,8 @@ class JaxGibbsDriver:
             k = jr.split(key, 6)
             if len(cm.idx.white):
                 # Laplace proposal square roots recomputed at the current
-                # state each warmup sweep (2 HVPs — cheap) so the white
+                # state each warmup sweep (W HVPs + a batched WxW eigh,
+                # small next to the b-draw for the W<=2 blocks) so the white
                 # block actually travels toward the typical set instead of
                 # freezing under prior-width single-site jumps
                 r2 = residual_sq(cm, b)

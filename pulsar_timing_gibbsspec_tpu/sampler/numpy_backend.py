@@ -132,7 +132,7 @@ class NumpyGibbs:
         it has fewer (red and GW share leading Fourier columns)."""
         kgw = len(self.gwid) // 2
         if self.red_sig is None:
-            return np.full(kgw, 1e-40)
+            return np.full(kgw, 1e-30)
         return align_phi(np.asarray(self.red_sig.get_phi(params))[::2], kgw)
 
     def lnlike_red(self, xs):

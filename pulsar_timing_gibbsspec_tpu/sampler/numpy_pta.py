@@ -144,7 +144,7 @@ class NumpyPTAGibbs:
         for ii in range(self.P):
             tau = self._gw_tau(ii)
             kgw = len(tau)
-            irn = np.full(kgw, 1e-40)
+            irn = np.full(kgw, 1e-30)
             if self.red_sigs[ii] is not None:
                 irn = align_phi(
                     np.asarray(self.red_sigs[ii].get_phi(params))[::2], kgw)
@@ -219,7 +219,7 @@ class NumpyPTAGibbs:
             if self.red_sigs[ii] is not None:
                 other = np.asarray(self.red_sigs[ii].get_phi(params))[::2][:K]
             else:
-                other = np.full(K, 1e-40)
+                other = np.full(K, 1e-30)
             logpdf += self._rho_log_pdf_grid(tau, other, grid)
         # Gumbel-max across the grid == inverse-CDF on the discrete pdf
         xnew[self.idx.rho] = 0.5 * np.log10(
