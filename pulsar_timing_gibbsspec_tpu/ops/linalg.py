@@ -70,15 +70,6 @@ def mvn_conditional_draw(TNT, phiinv, d, z):
     return precond_sample(L, dj, mean, z), mean
 
 
-def mvn_conditional_draw_dense(Sigma, d, z):
-    """As :func:`mvn_conditional_draw` but with a fully-assembled ``Sigma``
-    (used by the whitened b-draw, where the prior term is a dense
-    projection rather than a diagonal)."""
-    L, dj = precond_cholesky(Sigma)
-    mean = precond_solve(L, dj, d)
-    return precond_sample(L, dj, mean, z), mean
-
-
 def _batched_diag(v):
     """diag embedding that broadcasts over leading batch dimensions."""
     return v[..., :, None] * jnp.eye(v.shape[-1], dtype=v.dtype)

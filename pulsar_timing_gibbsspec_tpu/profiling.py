@@ -1,0 +1,248 @@
+"""Profiling subsystem: per-block sweep timers, FLOP/MFU accounting, and
+``jax.profiler`` trace capture.
+
+The reference's only instrumentation is a wall-clock print every 100
+iterations (``pta_gibbs.py:663,707-711``) and a tqdm bar
+(``pulsar_gibbs.py:8,656``).  Here every Gibbs block can be timed as its
+own compiled kernel (so the per-sweep cost budget is attributable), the
+dominant FLOP terms are counted analytically, and a full XLA trace can be
+dumped for tensorboard/xprof.
+
+Typical use::
+
+    drv = JaxGibbsDriver(pta, ...)
+    ...run a few sweeps so adaptation state exists...
+    report = profile_blocks(drv, x)     # {block: ms, ...}
+    print(format_report(report, flops=sweep_flops(drv.cm)))
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+
+import numpy as np
+
+#: advertised peak dense-matmul throughput per chip, FLOP/s.  Keyed by a
+#: substring of ``jax.devices()[0].device_kind``; used only to report MFU,
+#: never to gate anything.  f32 rate (the TNT einsums run f32 inputs with
+#: wider accumulation).
+_PEAK_FLOPS = {
+    "v5 lite": 197e12 / 2,   # bf16 197 TFLOP/s, f32 ~ half
+    "v5e": 197e12 / 2,
+    "v4": 275e12 / 2,
+    "cpu": 5e10,
+}
+
+
+def device_peak_flops() -> float:
+    import jax
+
+    kind = jax.devices()[0].device_kind.lower()
+    for frag, peak in _PEAK_FLOPS.items():
+        if frag in kind:
+            return peak
+    return _PEAK_FLOPS["cpu"]
+
+
+def _sync(out):
+    """Force completion by a device-to-host copy of one small leaf.
+
+    ``jax.block_until_ready`` does not reliably wait on remote/tunneled
+    platforms (observed on the "axon" TPU tunnel: it returns while the
+    computation is still in flight); a D2H transfer is an honest barrier.
+    """
+    import jax
+
+    np.asarray(jax.tree_util.tree_leaves(out)[0])
+
+
+def _timeit(fn, args, repeats=10):
+    """Median wall time of a compiled callable, D2H-synced; compile
+    excluded by a warmup call."""
+    out = fn(*args)
+    _sync(out)
+    ts = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        _sync(fn(*args))
+        ts.append(time.perf_counter() - t0)
+    return float(np.median(ts))
+
+
+def _scan_time(body2, x, b, inner, repeats):
+    """Per-iteration device time of ``body2(x, b, key) -> (x, b)`` measured
+    inside a ``lax.scan`` of ``inner`` iterations, with the per-dispatch
+    overhead measured separately (a length-1 scan) and subtracted.  On a
+    tunneled/remote device a single dispatch costs ~100 ms, so timing one
+    kernel call measures the network, not the kernel."""
+    import jax
+    import jax.random as jr
+
+    def scanned(n):
+        def run(x, b, key):
+            def step(carry, k):
+                x, b = carry
+                return body2(x, b, k), None
+
+            (x, b), _ = jax.lax.scan(step, (x, b), jr.split(key, n))
+            return x, b
+
+        return jax.jit(run)
+
+    key = jr.key(0)
+    t_inner = _timeit(scanned(inner), (x, b, key), repeats)
+    t_one = _timeit(scanned(1), (x, b, key), repeats)
+    return max(t_inner - t_one, 1e-9) / (inner - 1)
+
+
+def profile_blocks(driver, x, repeats=5, inner=50):
+    """Per-block device times (seconds per sweep) of one post-adaptation
+    Gibbs sweep, at the driver's actual ``nchains`` width (each block is
+    vmapped over the chains axis exactly as the production sweep runs it,
+    so the breakdown sums to the real sweep and matches the MFU line).
+    Each block is timed inside its own ``lax.scan`` of ``inner``
+    iterations so per-dispatch overhead (dominant on remote devices)
+    cancels; ``dispatch`` reports that overhead per call.  Requires the
+    driver to have completed adaptation (``_first_sweep``).
+    """
+    import jax
+    import jax.numpy as jnp
+    import jax.random as jr
+
+    from .sampler import jax_backend as jb
+
+    cm = driver.cm
+    C = driver.C
+    x = np.asarray(x, np.float64)
+    if x.ndim == 1:
+        x = np.tile(x, (C, 1))
+    x = jnp.asarray(x, cm.cdtype)                 # (C, nx)
+    b = jnp.asarray(driver.b)                     # (C, P, Bmax)
+    out = {}
+
+    def vm(single):
+        """Lift a per-chain body (x1, b1, k1) -> (x1, b1) to the (C, ...)
+        state with per-chain keys — the production sweep's layout."""
+        def body(x, b, k):
+            return jax.vmap(single)(x, b, jr.split(k, C))
+
+        return body
+
+    if len(cm.idx.white) and driver.aclength_white:
+        nw = driver.aclength_white
+        aux_w = tuple(jnp.asarray(a, cm.dtype) for a in (
+            driver.chol_white, driver.mode_white, driver.asqrt_white))
+
+        def white1(x, b, k, chol, mw, aw):
+            r2 = jb.residual_sq(cm, b)
+            xn, _ = jb.parallel_cov_mh_scan(
+                cm, x, k, jb.white_ll_rel(cm, x, r2), cm.white_par_ix,
+                cm.white_nper, chol, nw, record=False, mode=mw, asqrt=aw)
+            return xn, b
+
+        def white(x, b, k):
+            return jax.vmap(white1)(x, b, jr.split(k, C), *aux_w)
+
+        out[f"white_mh[{nw}]"] = _scan_time(white, x, b, inner, repeats)
+
+    if len(cm.idx.ecorr) and driver.aclength_ecorr and cm.ec_cols.shape[1]:
+        ne = driver.aclength_ecorr
+        aux_e = tuple(jnp.asarray(a, cm.dtype) for a in (
+            driver.chol_ecorr, driver.mode_ecorr, driver.asqrt_ecorr))
+
+        def ecorr1(x, b, k, chol, me, ae):
+            xn, _ = jb.parallel_cov_mh_scan(
+                cm, x, k, jb.ecorr_ll_rel(cm, x, b), cm.ecorr_par_ix,
+                cm.ecorr_nper, chol, ne, record=False, mode=me, asqrt=ae)
+            return xn, b
+
+        def ecorr(x, b, k):
+            return jax.vmap(ecorr1)(x, b, jr.split(k, C), *aux_e)
+
+        out[f"ecorr_mh[{ne}]"] = _scan_time(ecorr, x, b, inner, repeats)
+
+    if driver.do_red_conditional:
+        out["red_conditional"] = _scan_time(
+            vm(lambda x, b, k: (jb.red_conditional_update(cm, x, b, k), b)),
+            x, b, inner, repeats)
+
+    if driver.do_red_mh:
+        ns = driver.red_steps
+        U = jnp.asarray(driver.red_U)
+        S = jnp.asarray(driver.red_S)
+
+        def red1(x, b, k, U, S):
+            return jb.red_mh_block(cm, x, cm.gw_tau(b), k, U, S, ns), b
+
+        def redmh(x, b, k):
+            return jax.vmap(red1)(x, b, jr.split(k, C), U, S)
+
+        out[f"red_mh[{ns}]"] = _scan_time(redmh, x, b, inner, repeats)
+
+    if cm.K and len(cm.rho_ix_x):
+        out["rho_gumbel"] = _scan_time(
+            vm(lambda x, b, k: (jb.rho_update(cm, x, b, k), b)),
+            x, b, inner, repeats)
+
+    out["b_draw"] = _scan_time(
+        vm(lambda x, b, k: (x, jb.draw_b_fn(cm, x, k))), x, b, inner,
+        repeats)
+
+    # the composed sweep, timed the same way (this is what the chunked
+    # driver actually runs), plus the per-dispatch overhead for context
+    body = driver._sweep_body()
+    aux = driver._aux()
+
+    def full(x, b, k):
+        return jax.vmap(
+            lambda x1, b1, k1, a: body((x1, b1), k1, a)[0],
+            in_axes=(0, 0, 0, 0))(x, b, jr.split(k, C), aux)
+
+    out["full_sweep"] = _scan_time(full, x, b, inner, repeats)
+    out["dispatch"] = _timeit(
+        jax.jit(lambda x: x + 1.0), (jnp.zeros(()),), repeats)
+    return out
+
+
+def sweep_flops(cm, nchains=1):
+    """Analytic FLOP count of the dominant per-sweep kernels.
+
+    Only the terms that can matter on a TPU are counted: the TNT einsum
+    (2 P N B^2), the T b matvec, the batched Cholesky (P B^3 / 3) and
+    triangular solves (3 P B^2).  Elementwise work (grids, MH deltas) is
+    bandwidth- not FLOP-bound and is excluded.
+    """
+    P, N, B = cm.P, cm.Nmax, cm.Bmax
+    ein = 2.0 * P * N * B * B + 2.0 * P * N * B
+    chol = P * (B ** 3) / 3.0 + 3.0 * P * B * B
+    return {"tnt_einsum": ein * nchains, "cholesky": chol * nchains,
+            "total": (ein + chol) * nchains}
+
+
+def format_report(times: dict, flops: dict | None = None,
+                  sweeps_per_sec: float | None = None) -> str:
+    """Human-readable per-block breakdown, optionally with achieved
+    FLOP/s and MFU when the sweep rate is known."""
+    lines = ["per-block sweep profile:"]
+    for k, v in sorted(times.items(), key=lambda kv: -kv[1]):
+        lines.append(f"  {k:<20s} {v * 1e3:8.2f} ms")
+    if flops and sweeps_per_sec:
+        achieved = flops["total"] * sweeps_per_sec
+        peak = device_peak_flops()
+        lines.append(f"  counted FLOPs/sweep   {flops['total']:.3g}")
+        lines.append(f"  achieved FLOP/s       {achieved:.3g} "
+                     f"(MFU {100.0 * achieved / peak:.2f}% of {peak:.3g})")
+    return "\n".join(lines)
+
+
+@contextlib.contextmanager
+def trace(outdir: str):
+    """Dump a full XLA profiler trace (view with tensorboard/xprof)."""
+    import jax
+
+    jax.profiler.start_trace(outdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

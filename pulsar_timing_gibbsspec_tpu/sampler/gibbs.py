@@ -20,9 +20,12 @@ from .numpy_backend import NumpyGibbs
 
 
 class _GibbsBase:
-    def __init__(self, pta, hypersample="conditional", ecorrsample="mh",
-                 redsample="mh", psr=None, backend="jax", seed=None,
+    def __init__(self, pta, hypersample=None, ecorrsample=None,
+                 redsample=None, psr=None, backend="jax", seed=None,
                  progress=True, **backend_opts):
+        from .blocks import validate_sampling_flags
+
+        validate_sampling_flags(pta, hypersample, ecorrsample, redsample)
         self.pta = pta
         self.backend_name = backend
         self.progress = progress
@@ -72,17 +75,28 @@ class _GibbsBase:
                save_every=100):
         """Run ``niter`` Gibbs sweeps, persisting chains to ``outdir``
         (reference ``sample`` at ``pulsar_gibbs.py:620-710``, with resume
-        reading what was saved and adaptation state checkpointed)."""
+        reading what was saved and adaptation state checkpointed).
+
+        With ``nchains=C > 1`` (jax backend) the chain files gain a chains
+        axis — ``chain.npy`` is (niter, C, npar) — and ``xs`` may be either
+        one start point (tiled) or per-chain (C, npar) starts."""
         xs = np.atleast_1d(np.asarray(xs, dtype=np.float64))
         npar = len(self.param_names)
-        if xs.shape != (npar,):
+        C = getattr(self._backend, "C", 1)
+        ok_shapes = [(npar,)] + ([(C, npar)] if C > 1 else [])
+        if xs.shape not in ok_shapes:
             raise ValueError(
                 f"x0 has shape {xs.shape}; this model has {npar} parameters "
-                f"(see .param_names)")
+                f"(see .param_names)" + (f" and {C} chains" if C > 1 else ""))
         store = ChainStore(outdir, self.param_names, self.b_param_names)
 
-        chain = np.zeros((niter, len(xs)))
-        bchain = np.zeros((niter, self._backend.nb_total))
+        if hasattr(self._backend, "chain_shapes"):
+            cshape, bshape = self._backend.chain_shapes(niter)
+        else:
+            cshape = (niter, npar)
+            bshape = (niter, self._backend.nb_total)
+        chain = np.zeros(cshape)
+        bchain = np.zeros(bshape)
         start = 0
         x = xs
         if resume:
@@ -90,6 +104,12 @@ class _GibbsBase:
             if got is not None:
                 prev_c, prev_b, upto, adapt = got
                 upto = min(upto, niter)
+                if prev_c.shape[1:] != chain.shape[1:]:
+                    raise RuntimeError(
+                        f"{outdir}: cannot resume — saved chain rows have "
+                        f"shape {prev_c.shape[1:]} but this sampler "
+                        f"(nchains={C}) produces {chain.shape[1:]}; resume "
+                        "with the original nchains or start fresh")
                 chain[:upto] = prev_c[:upto]
                 bchain[:upto] = prev_b[:upto]
                 start = upto

@@ -29,18 +29,19 @@ import scipy.linalg as sl
 from ..ops.acf import integrated_act
 from .blocks import (BlockIndex, align_phi, gumbel_grid_draw,
                      proposal_step, rho_bounds, rho_grid,
-                     rho_log_pdf_grid)
+                     rho_log_pdf_grid, validate_sampling_flags)
 
 
 class NumpyGibbs:
     """Single-pulsar oracle sampler over a host PTA model."""
 
-    def __init__(self, pta, hypersample="conditional", redsample="mh",
+    def __init__(self, pta, hypersample=None, redsample=None,
                  white_adapt_iters=1000, red_adapt_iters=2000, red_steps=20,
                  seed=None):
         self.pta = pta
         if len(pta.pulsars) != 1:
             raise ValueError("NumpyGibbs is single-pulsar; use the PTA facade")
+        validate_sampling_flags(pta, hypersample, redsample=redsample)
         self.hypersample = hypersample
         self.redsample = redsample
         self.white_adapt_iters = white_adapt_iters

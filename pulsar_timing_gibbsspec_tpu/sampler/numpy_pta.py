@@ -26,18 +26,19 @@ import scipy.linalg as sl
 from ..ops.acf import integrated_act
 from .blocks import (BlockIndex, align_phi, gumbel_grid_draw,
                      proposal_step, rho_bounds, rho_grid,
-                     rho_log_pdf_grid)
+                     rho_log_pdf_grid, validate_sampling_flags)
 
 
 class NumpyPTAGibbs:
     """Multi-pulsar oracle sampler: common GW free spectrum + per-pulsar
     noise blocks."""
 
-    def __init__(self, pta, hypersample="conditional", redsample="conditional",
+    def __init__(self, pta, hypersample=None, redsample=None,
                  white_adapt_iters=1000, red_adapt_iters=2000, red_steps=20,
                  seed=None):
         self.pta = pta
         self.P = len(pta.pulsars)
+        validate_sampling_flags(pta, hypersample, redsample=redsample)
         self.hypersample = hypersample
         self.redsample = redsample
         self.white_adapt_iters = white_adapt_iters

@@ -224,6 +224,103 @@ def test_jax_resume_bitwise(j1713, tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# reference-API kernel-selector flags: honored or loud, never ignored
+# ---------------------------------------------------------------------------
+
+def test_sampling_flags_validated(j1713, pta8):
+    pta_fs = model_general([j1713], tm_svd=True, red_var=True,
+                           red_psd="spectrum", red_components=5,
+                           white_vary=False, common_psd="spectrum",
+                           common_components=5)
+    # auto + structurally-consistent explicit values pass
+    PulsarBlockGibbs(pta_fs, backend="numpy", seed=0)
+    PulsarBlockGibbs(pta_fs, backend="numpy", seed=0,
+                     hypersample="conditional", redsample="conditional")
+    # asking for kernels the structure does not provide raises loudly
+    with pytest.raises(NotImplementedError):
+        PulsarBlockGibbs(pta_fs, backend="numpy", seed=0, redsample="mh")
+    with pytest.raises(NotImplementedError):
+        PulsarBlockGibbs(pta_fs, backend="numpy", seed=0, hypersample="mh")
+    with pytest.raises(NotImplementedError):
+        PulsarBlockGibbs(pta_fs, backend="numpy", seed=0, ecorrsample="gibbs")
+    pta_pl = model_general([j1713], tm_svd=True, red_var=True,
+                           red_psd="powerlaw", white_vary=False,
+                           common_psd="spectrum", common_components=5)
+    PulsarBlockGibbs(pta_pl, backend="numpy", seed=0, redsample="mh")
+    with pytest.raises(NotImplementedError):
+        PulsarBlockGibbs(pta_pl, backend="numpy", seed=0,
+                         redsample="conditional")
+    # common_rho asserts a shared free-spectrum block exists
+    from pulsar_timing_gibbsspec_tpu.sampler.jax_backend import JaxGibbsDriver
+
+    pta_nogw = model_general([j1713], tm_svd=True, red_var=True,
+                             red_psd="spectrum", red_components=5,
+                             white_vary=False)
+    with pytest.raises(ValueError):
+        JaxGibbsDriver(pta_nogw, seed=0, common_rho=True)
+
+
+# ---------------------------------------------------------------------------
+# multi-chain axis (nchains): every chain a valid posterior, resume exact
+# ---------------------------------------------------------------------------
+
+def test_nchains_ks_and_shapes(j1713, tmp_path):
+    """nchains=K vmaps whole sweeps over a chains axis: chain files gain a
+    chains axis, every chain is finite and KS-consistent with the single-
+    chain run, and pooled samples match too (the throughput axis must not
+    change the sampled law; SURVEY §7 hard part (a))."""
+    pta = model_general([j1713], tm_svd=True, red_var=False,
+                        white_vary=True, common_psd="spectrum",
+                        common_components=5)
+    x0 = pta.initial_sample(np.random.default_rng(11))
+    g1 = PulsarBlockGibbs(pta, backend="jax", seed=21, progress=False,
+                          white_adapt_iters=200)
+    c1 = g1.sample(x0, outdir=str(tmp_path / "c1"), niter=1200)
+    gk = PulsarBlockGibbs(pta, backend="jax", seed=22, progress=False,
+                          white_adapt_iters=200, nchains=3)
+    ck = gk.sample(x0, outdir=str(tmp_path / "ck"), niter=1200)
+    npar = len(pta.param_names)
+    assert c1.shape == (1200, npar)
+    assert ck.shape == (1200, 3, npar)
+    assert np.all(np.isfinite(ck))
+    saved = np.load(tmp_path / "ck" / "chain.npy")
+    assert saved.shape == (1200, 3, npar)
+
+    burn, thin = 200, 5
+    idx = BlockIndex.build(pta.param_names)
+    cols = list(idx.rho[:3]) + list(idx.white[:2])
+    ref = c1[burn::thin]
+    for c in range(3):
+        pv = [stats.ks_2samp(ck[burn::thin, c, k], ref[:, k]).pvalue
+              for k in cols]
+        assert min(pv) > 1e-4, (c, pv)
+    pooled = ck[burn::thin].reshape(-1, npar)
+    pv = [stats.ks_2samp(pooled[:, k], ref[:, k]).pvalue for k in cols]
+    assert min(pv) > 1e-4, pv
+    # chains are genuinely distinct stochastic processes
+    assert np.std(ck[burn:, 0, cols[0]] - ck[burn:, 1, cols[0]]) > 0
+
+
+def test_nchains_resume_bitwise(j1713, tmp_path):
+    pta = model_general([j1713], tm_svd=True, red_var=False,
+                        white_vary=True, common_psd="spectrum",
+                        common_components=5)
+    x0 = pta.initial_sample(np.random.default_rng(5))
+    kw = dict(backend="jax", seed=31, progress=False, white_adapt_iters=100,
+              chunk_size=20, nchains=2)
+    g_full = PulsarBlockGibbs(pta, **kw)
+    full = g_full.sample(x0, outdir=str(tmp_path / "full"), niter=100,
+                         save_every=20)
+    g_a = PulsarBlockGibbs(pta, **kw)
+    g_a.sample(x0, outdir=str(tmp_path / "split"), niter=60, save_every=20)
+    g_b = PulsarBlockGibbs(pta, **kw)
+    resumed = g_b.sample(x0, outdir=str(tmp_path / "split"), niter=100,
+                         resume=True, save_every=20)
+    assert np.all(np.isfinite(full))
+    np.testing.assert_array_equal(resumed, full)
+
+
+# ---------------------------------------------------------------------------
 # sharded multi-pulsar path
 # ---------------------------------------------------------------------------
 
