@@ -48,7 +48,7 @@ def _reject_unsupported(kw: dict):
     unsupported = {
         "tm_var": False, "tm_linear": False, "tmparam_list": None,
         "bayesephem": False, "is_wideband": False, "use_dmdata": False,
-        "dm_var": False, "dm_annual": False, "dm_chrom": False,
+        "dm_annual": False, "dm_chrom": False,
         "gequad": False, "coefficients": False, "red_select": None,
         "red_breakflat": False, "pshift": False,
     }
@@ -78,6 +78,7 @@ def model_general(psrs, tm_svd=False, tm_norm=True, noisedict=None,
                   upper_limit_common=None, upper_limit=False,
                   red_var=True, red_psd="powerlaw", red_components=30,
                   upper_limit_red=None,
+                  dm_var=False, dm_psd="powerlaw", dm_components=30,
                   select="backend", **extra) -> PTA:
     """Build a PTA model over ``data.Pulsar`` objects.  See module docstring
     for the supported subset; returns a :class:`~..models.pta.PTA`."""
@@ -152,6 +153,24 @@ def model_general(psrs, tm_svd=False, tm_norm=True, noisedict=None,
             sigs.append(FourierGPSignal(
                 psr.toas / 86400.0, red_components, Tspan,
                 psd_name=red_psd, psd_params=rps, name=rname, modes=grid))
+
+        if dm_var:
+            # dispersion-measure GP: chromatic (nu^-2) Fourier process with
+            # its own basis columns (reference model_definition.py:19-31
+            # via enterprise's dm_noise_block; amplitudes referenced to
+            # 1400 MHz)
+            if dm_psd != "powerlaw":
+                raise NotImplementedError(
+                    f"dm_psd='{dm_psd}': the DM GP currently supports the "
+                    "powerlaw PSD (its hypers join the adaptive MH block)")
+            dname = f"{psr.name}_dm_gp"
+            amp_cls = LinearExp if amp_prior == "uniform" else Uniform
+            dps = [amp_cls(-20.0, -11.0, name=f"{dname}_log10_A"),
+                   Uniform(0.0, 7.0, name=f"{dname}_gamma")]
+            sigs.append(FourierGPSignal(
+                psr.toas / 86400.0, dm_components, Tspan,
+                psd_name=dm_psd, psd_params=dps, name=dname, modes=grid,
+                radio_freqs=psr.freqs, chrom_index=2.0))
 
         # ---- white noise -------------------------------------------------
         masks = SELECTIONS[select](psr.backend_flags)

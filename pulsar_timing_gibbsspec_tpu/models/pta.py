@@ -20,21 +20,30 @@ from .signals import BasisSignal, WhiteNoiseSignal
 class SignalModel:
     """One pulsar: ordered basis signals + white noise over its TOAs.
 
-    Basis layout: ``[timing-model block | shared Fourier block | ECORR
-    block]``.  All Fourier signals (red, common GW) share leading columns of
-    the Fourier block — the reference's "red + GW share a basis" convention
-    (``pulsar_gibbs.py:101-102``); the block is as wide as the largest
-    requested mode count.
+    Basis layout: ``[timing-model block | shared Fourier block | chromatic
+    blocks | ECORR block]``.  Achromatic Fourier signals (red, common GW)
+    share leading columns of the Fourier block — the reference's "red + GW
+    share a basis" convention (``pulsar_gibbs.py:101-102``); the block is
+    as wide as the largest requested mode count.  Chromatic GPs (DM,
+    scattering) have radio-frequency-scaled bases, so each keeps its own
+    columns.
     """
 
     def __init__(self, pulsar, basis_signals: list, white: WhiteNoiseSignal | None):
         self.pulsar = pulsar
         self.white = white
 
-        self._timing = [s for s in basis_signals if not s.shares_fourier and s.name != "basis_ecorr"]
-        self._fourier = [s for s in basis_signals if s.shares_fourier]
+        def chrom(s):
+            return getattr(s, "chromatic", False)
+
+        self._timing = [s for s in basis_signals
+                        if not getattr(s, "shares_fourier", False)
+                        and not chrom(s) and s.name != "basis_ecorr"]
+        self._fourier = [s for s in basis_signals
+                         if getattr(s, "shares_fourier", False)]
+        self._chrom = [s for s in basis_signals if chrom(s)]
         self._ecorr = [s for s in basis_signals if s.name == "basis_ecorr"]
-        self.signals = self._timing + self._fourier + self._ecorr
+        self.signals = self._timing + self._fourier + self._chrom + self._ecorr
 
         blocks, self._slices = [], {}
         off = 0
@@ -51,7 +60,7 @@ class SignalModel:
             for s in self._fourier:
                 self._slices[s.name] = slice(off, off + s.get_basis().shape[1])
             off += wmax
-        for s in self._ecorr:
+        for s in self._chrom + self._ecorr:
             B = s.get_basis()
             blocks.append(B)
             self._slices[s.name] = slice(off, off + B.shape[1])

@@ -72,26 +72,38 @@ class TimingModelSignal(BasisSignal):
 
 
 class FourierGPSignal(BasisSignal):
-    """Rank-reduced Fourier-basis GP (red noise / common GW process).
+    """Rank-reduced Fourier-basis GP (red noise / common GW process / DM).
 
     ``psd_name`` selects from ``models/psd.py``; ``psd_params`` is the
     ordered list of hyperparameter objects matching the psd function
     signature after ``(f, df)``.  ``orf_name`` tags common processes with
     their inter-pulsar correlation (consumed by the PTA container; the
     per-pulsar phi is ORF-independent).
+
+    ``chrom_index`` (with per-TOA ``radio_freqs`` in MHz) makes the
+    process chromatic: each basis row is scaled by ``(1400/nu)^index``
+    (index 2 = dispersion-measure variations, 4 = chromatic scattering;
+    the reference gets these from enterprise's dm/chrom noise blocks,
+    ``model_definition.py:19-31``).  Amplitudes are thus referenced to
+    1400 MHz.  Chromatic signals keep their own basis columns — they
+    cannot share the achromatic Fourier block.
     """
 
-    shares_fourier = True
-
     def __init__(self, toas_mjd, nmodes: int, Tspan: float, psd_name: str,
-                 psd_params: list, name: str, modes=None, orf_name: str = "crn"):
+                 psd_params: list, name: str, modes=None, orf_name: str = "crn",
+                 radio_freqs=None, chrom_index: float | None = None):
         self.name = name
         self.params = list(psd_params)
         self.psd_name = psd_name
         self.orf_name = orf_name
         self.nmodes = nmodes
         self.Tspan = Tspan
+        self.chromatic = chrom_index is not None
+        self.shares_fourier = not self.chromatic
         self._F, self._f = fourier_basis(toas_mjd, nmodes, Tspan, modes=modes)
+        if self.chromatic:
+            scale = (1400.0 / np.asarray(radio_freqs)) ** float(chrom_index)
+            self._F = self._F * scale[:, None]
         # per-column bin width: spacing between consecutive unique
         # frequencies, first bin measured from 0 (uniform 1/Tspan on the
         # default grid; essential for logfreq/custom grids)

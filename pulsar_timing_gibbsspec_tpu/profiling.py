@@ -173,7 +173,7 @@ def profile_blocks(driver, x, repeats=5, inner=50):
         S = jnp.asarray(driver.red_S)
 
         def red1(x, b, k, U, S):
-            return jb.red_mh_block(cm, x, cm.gw_tau(b), k, U, S, ns), b
+            return jb.red_mh_block(cm, x, b, k, U, S, ns), b
 
         def redmh(x, b, k):
             return jax.vmap(red1)(x, b, jr.split(k, C), U, S)

@@ -199,6 +199,10 @@ class CompiledPTA:
     #: b-draw and the quadratic-form rho conditional
     orf_name: str = "crn"
     orf_Ginv: object = None    # (P, P) inverse ORF matrix (identity pads)
+    #: (P, Bmax) 1.0 on Fourier/chromatic GP columns — the coefficient
+    #: set whose N(0, phi(x)) prior is the generic b-conditional
+    #: likelihood of the powerlaw-family hyper MH block
+    gp_mask: object = None
 
     # =======================================================================
     # device-side pure functions (jit/vmap-safe; arrays close over as consts)
@@ -233,15 +237,15 @@ class CompiledPTA:
         equad = xev[self.equad_ix]
         return efac * efac * self.sigma2 + 10.0 ** (2.0 * equad)
 
-    def phi(self, x, dtype=None):
-        """(P, Bmax) per-column prior variance (pads = 1)."""
+    def _phi_accum(self, x, base, comps, dtype=None):
+        """Scatter-add the selected components' variances onto ``base``."""
         import jax.numpy as jnp
 
         dtype = dtype or self.cdtype
         xev = self.xe(x).astype(dtype)
-        phi = jnp.asarray(self.phi_base, dtype=dtype)
+        phi = jnp.asarray(base, dtype=dtype)
         rows = jnp.arange(self.P)[:, None]
-        for c in self.components:
+        for c in comps:
             if c.kind in ("free_spectrum", "ecorr"):
                 vals = 10.0 ** (2.0 * xev[c.rho_ix])
             else:
@@ -250,11 +254,38 @@ class CompiledPTA:
                         for h in range(c.hyp_ix.shape[1])]
                 vals = jnp.exp(fn(c.f, c.df, *args))
             phi = phi.at[rows, c.cols].add(vals, mode="drop")
+        return phi
+
+    def phi(self, x, dtype=None):
+        """(P, Bmax) per-column prior variance (pads = 1)."""
+        import jax.numpy as jnp
+
+        phi = self._phi_accum(x, self.phi_base, self.components, dtype)
         # powerlaw-family phi can underflow to exactly 0 at prior corners
         # (e.g. log10_A = -20: exp(lnphi) ~ 1e-44 flushes to 0 under the
         # TPU's f32-exponent-range f64), which would make 1/phi = inf in
         # the b-draw; the floor is sampling-neutral (see PHI_FLOOR)
         return jnp.maximum(phi, PHI_FLOOR)
+
+    def phi_hyper_split(self, x, dtype=None):
+        """``(static, dyn_fn)``: the part of phi that is constant while
+        only powerlaw-family hypers move (free-spectrum rho, ECORR — their
+        parameters belong to other Gibbs blocks), evaluated once, plus a
+        function accumulating the hyper-dependent part.  Lets the MH block
+        avoid re-evaluating every component per step."""
+        stat_comps = [c for c in self.components
+                      if c.kind in ("free_spectrum", "ecorr")]
+        dyn_comps = [c for c in self.components
+                     if c.kind not in ("free_spectrum", "ecorr")]
+        static = self._phi_accum(x, self.phi_base, stat_comps, dtype)
+
+        def dyn(q):
+            import jax.numpy as jnp
+
+            return jnp.maximum(
+                self._phi_accum(q, static, dyn_comps, dtype), PHI_FLOOR)
+
+        return static, dyn
 
     def lnprior(self, x):
         import jax.numpy as jnp
@@ -418,8 +449,13 @@ def compile_pta(pta, pad_pulsars: int | None = None) -> CompiledPTA:
     equad_ix = np.full((P, Nmax), equad_off, np.int32)
     phi_base = np.ones((P, Bmax), np_dtype)
 
+    gp_mask = np.zeros((P, Bmax), np_dtype)
+
     for ii, m in enumerate(models):
         n, w = m.pulsar.ntoa, widths[ii]
+        for s in m._fourier + m._chrom:
+            sl_ = m._slices[s.name]
+            gp_mask[ii, sl_.start:sl_.stop] = 1.0
         y[ii, :n] = m.pulsar.residuals
         T[ii, :n, :w] = m.get_basis()
         toa_mask[ii, :n] = 1.0
@@ -437,7 +473,7 @@ def compile_pta(pta, pad_pulsars: int | None = None) -> CompiledPTA:
             sl_ = m._slices[s.name]
             phi_base[ii, sl_] = big_phi
         # GP columns start at 0 and accumulate component contributions
-        for s in m._fourier + m._ecorr:
+        for s in m._fourier + m._chrom + m._ecorr:
             sl_ = m._slices[s.name]
             phi_base[ii, sl_.start:sl_.stop] = 0.0
 
@@ -468,6 +504,28 @@ def compile_pta(pta, pad_pulsars: int | None = None) -> CompiledPTA:
             else:
                 hyp = [ref(p) for p in s.params]
             rows.append((cols, f, df, hyp, rho))
+        comp_specs.append((kind, rows))
+    # chromatic GPs (DM, scattering): own columns, same component machinery
+    n_chrom = {len(m._chrom) for m in models}
+    if len(n_chrom) > 1:
+        raise ValueError("pulsars disagree on chromatic signal count; the "
+                         "compiled batch requires a homogeneous model "
+                         "(build with model_general)")
+    for c in range(n_chrom.pop() if n_chrom else 0):
+        kinds = {m._chrom[c].psd_name for m in models}
+        if len(kinds) > 1:
+            raise ValueError(f"chromatic signal #{c} has mixed PSDs {kinds}")
+        kind = kinds.pop()
+        if kind == "free_spectrum":
+            raise NotImplementedError(
+                "free-spectrum chromatic GPs have no conditional sampler "
+                "block; use a powerlaw-family PSD")
+        rows = []
+        for m in models:
+            s = m._chrom[c]
+            sl_ = m._slices[s.name]
+            rows.append((np.arange(sl_.start, sl_.stop), s.freqs, s._df,
+                         [ref(p) for p in s.params], []))
         comp_specs.append((kind, rows))
     ec_rows = []
     for m in models:
@@ -725,5 +783,5 @@ def compile_pta(pta, pad_pulsars: int | None = None) -> CompiledPTA:
         ecorr_par_ix=ecorr_par_ix, ecorr_nper=ecorr_nper,
         rhomin=float(rhomin), rhomax=float(rhomax),
         red_rhomin=float(red_rhomin), red_rhomax=float(red_rhomax),
-        orf_name=orf_name, orf_Ginv=orf_Ginv,
+        orf_name=orf_name, orf_Ginv=orf_Ginv, gp_mask=gp_mask,
     )

@@ -108,14 +108,24 @@ def lnlike_white_per(cm: CompiledPTA, x, r2):
     return -0.5 * jnp.sum(cm.toa_mask * (ln_s2 + jnp.log(M) + w / M), axis=1)
 
 
-def lnlike_red_fn(cm: CompiledPTA, x, tau):
-    """b-conditional red-hyper likelihood (reference ``:549-566``)."""
+def lnlike_hyper_fn(cm: CompiledPTA, x, b, phi_fn=None):
+    """Generic b-conditional likelihood of every GP-prior hyperparameter:
+    ``sum over GP columns of -0.5 (log phi_c(x) + b_c^2 / phi_c(x))``.
+
+    Equal (up to hyper-independent constants) to the reference's
+    conditional red likelihood (``pulsar_gibbs.py:549-566``:
+    ``logratio - exp(logratio)`` per shared frequency), and additionally
+    covers GPs on their own columns (the chromatic DM block), which the
+    per-frequency tau fold cannot see.  This is the target of the
+    powerlaw-family MH block.  ``phi_fn`` (from
+    ``cm.phi_hyper_split``) lets a scan evaluate only the
+    hyper-dependent components per step."""
     import jax.numpy as jnp
 
-    irn = cm.red_phi(x)
-    gw = cm.gw_phi(x)
-    logratio = jnp.log(tau) - jnp.logaddexp(jnp.log(irn), jnp.log(gw))
-    return jnp.sum(cm.psr_mask[:, None] * (logratio - jnp.exp(logratio)))
+    phi = cm.phi(x) if phi_fn is None else phi_fn(x)
+    mask = jnp.asarray(cm.gp_mask, cm.cdtype)
+    b2 = (b * b).astype(cm.cdtype)
+    return -0.5 * jnp.sum(mask * (jnp.log(phi) + b2 / phi))
 
 
 def lnlike_fullmarg_fn(cm: CompiledPTA, x, TNT, d):
@@ -527,8 +537,9 @@ def ecorr_ll_rel(cm: CompiledPTA, x0, b):
     return ll_rel
 
 
-def red_mh_block(cm: CompiledPTA, x, tau, key, U, S, nsteps):
-    """Per-sweep power-law red block: `nsteps` MH steps mixing adapted-
+def red_mh_block(cm: CompiledPTA, x, b, key, U, S, nsteps):
+    """Per-sweep power-law hyper block (intrinsic red, varied common
+    process, chromatic DM): `nsteps` MH steps mixing adapted-
     eigendirection (SCAM, reference PTMCMC's workhorse jump) and the
     single-site scale-mixture proposal, on the cheap b-conditional
     likelihood (reference ``pulsar_gibbs.py:300-327``)."""
@@ -538,7 +549,8 @@ def red_mh_block(cm: CompiledPTA, x, tau, key, U, S, nsteps):
 
     rind = jnp.asarray(cm.idx.red)
     sigma = 0.05 * len(cm.idx.red)
-    lnlike = lambda q: lnlike_red_fn(cm, q, tau)
+    _, phi_dyn = cm.phi_hyper_split(x)      # static comps evaluated once
+    lnlike = lambda q: lnlike_hyper_fn(cm, q, b, phi_fn=phi_dyn)
     scales = jnp.asarray(_SCALES, dtype=cm.cdtype)
     probs = jnp.asarray(_SCALE_P, dtype=cm.cdtype)
 
@@ -1019,8 +1031,7 @@ class JaxGibbsDriver:
             if self.do_red_conditional:
                 x = red_conditional_update(cm, x, b, k[2])
             if self.do_red_mh:
-                tau = cm.gw_tau(b)
-                x = red_mh_block(cm, x, tau, k[5], red_U, red_S,
+                x = red_mh_block(cm, x, b, k[5], red_U, red_S,
                                  self.red_steps)
             if cm.K and len(cm.rho_ix_x):
                 x = rho_update(cm, x, b, k[3])
@@ -1069,9 +1080,10 @@ class JaxGibbsDriver:
             if self.do_red_conditional:
                 x = red_conditional_update(cm, x, b, k[2])
             if self.do_red_mh:
-                tau = cm.gw_tau(b)
+                _, phi_dyn = cm.phi_hyper_split(x)
                 x, _ = mh_scan(cm, x, k[5],
-                               lambda q: lnlike_red_fn(cm, q, tau),
+                               lambda q: lnlike_hyper_fn(cm, q, b,
+                                                         phi_fn=phi_dyn),
                                cm.idx.red, self.red_steps)
             if cm.K and len(cm.rho_ix_x):
                 x = rho_update(cm, x, b, k[3])

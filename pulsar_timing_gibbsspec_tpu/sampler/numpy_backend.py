@@ -137,13 +137,21 @@ class NumpyGibbs:
         return align_phi(np.asarray(self.red_sig.get_phi(params))[::2], kgw)
 
     def lnlike_red(self, xs):
-        """b-conditional likelihood of the red hypers (reference :549-566)."""
+        """b-conditional likelihood of every GP hyper (reference :549-566
+        for the shared red/GW columns, extended with the N(0, phi) terms of
+        GPs on their own columns — the chromatic DM block)."""
         params = self.map_params(xs)
         tau = self._gw_tau()
         irn = self._red_phi_at_gw_freqs(params)
         gw = np.asarray(self.gw_sig.get_phi(params))[::2]
         logratio = np.log(tau) - np.logaddexp(np.log(irn), np.log(gw))
-        return float(np.sum(logratio - np.exp(logratio)))
+        out = float(np.sum(logratio - np.exp(logratio)))
+        for s in self._model._chrom:
+            sl_ = self._model._slices[s.name]
+            phi = np.asarray(s.get_phi(params))
+            bb = self.b[sl_]
+            out += float(np.sum(-0.5 * np.log(phi) - 0.5 * bb * bb / phi))
+        return out
 
     def lnlike_ecorr(self, xs):
         """b-conditional likelihood of ECORR variances: the ECORR basis

@@ -166,8 +166,8 @@ class NumpyPTAGibbs:
         return out
 
     def lnlike_red(self, xs):
-        """b-conditional likelihood of all per-pulsar red hypers (sum of the
-        single-pulsar expressions)."""
+        """b-conditional likelihood of all per-pulsar GP hypers (sum of the
+        single-pulsar expressions; chromatic own-column GPs included)."""
         params = self.map_params(xs)
         out = 0.0
         for ii in range(self.P):
@@ -180,6 +180,13 @@ class NumpyPTAGibbs:
             gw = np.asarray(self.gw_sigs[ii].get_phi(params))[::2]
             logratio = np.log(tau) - np.logaddexp(np.log(irn), np.log(gw))
             out += float(np.sum(logratio - np.exp(logratio)))
+            m = self.pta.model(ii)
+            for s in m._chrom:
+                sl_ = m._slices[s.name]
+                phi = np.asarray(s.get_phi(params))
+                bb = self.b[ii][sl_]
+                out += float(np.sum(-0.5 * np.log(phi)
+                                    - 0.5 * bb * bb / phi))
         return out
 
     def lnlike_ecorr(self, xs):

@@ -1,0 +1,102 @@
+"""DM-variation GP (chromatic nu^-2 Fourier process).
+
+The reference's ``model_general`` accepts ``dm_var`` and builds the block
+via enterprise's dm-noise machinery (``model_definition.py:19-31``); round
+1 rejected the kwarg.  These tests pin the chromatic basis scaling, the
+generic hyper conditional that samples the DM hypers alongside the red/
+common block, and jax-vs-numpy statistical equivalence.
+"""
+
+import numpy as np
+import pytest
+from scipy import stats
+
+from pulsar_timing_gibbsspec_tpu.models.factory import model_general
+from pulsar_timing_gibbsspec_tpu.sampler.blocks import BlockIndex
+from pulsar_timing_gibbsspec_tpu.sampler.compiled import compile_pta
+from pulsar_timing_gibbsspec_tpu.sampler.gibbs import PulsarBlockGibbs
+
+
+@pytest.fixture(scope="module")
+def dm_psr(j1713):
+    """J1713 with artificial dual-band radio frequencies so the chromatic
+    basis is distinguishable from the achromatic one."""
+    import dataclasses
+
+    rng = np.random.default_rng(0)
+    freqs = np.where(rng.uniform(size=j1713.ntoa) < 0.5, 800.0, 1400.0)
+    return dataclasses.replace(j1713, freqs=freqs)
+
+
+def test_dm_basis_chromatic_scaling(dm_psr):
+    pta = model_general([dm_psr], tm_svd=True, red_var=False,
+                        white_vary=False, common_psd="spectrum",
+                        common_components=5, dm_var=True, dm_components=5)
+    assert any("dm_gp" in n for n in pta.param_names)
+    m = pta.model(0)
+    dm_sig = next(s for s in m.signals if "dm_gp" in s.name)
+    gw_sig = next(s for s in m.signals if "gw" in s.name)
+    F_dm, F_gw = dm_sig.get_basis(), gw_sig.get_basis()
+    scale = (1400.0 / dm_psr.freqs) ** 2
+    np.testing.assert_allclose(F_dm, F_gw[:, :F_dm.shape[1]]
+                               * scale[:, None], rtol=1e-12)
+    # own columns, not shared with the Fourier block
+    assert m._slices[dm_sig.name].start >= m._slices[gw_sig.name].stop
+
+
+def test_dm_hypers_join_mh_block_and_compile(dm_psr):
+    pta = model_general([dm_psr], tm_svd=True, red_var=False,
+                        white_vary=False, common_psd="spectrum",
+                        common_components=5, dm_var=True, dm_components=5)
+    idx = BlockIndex.build(pta.param_names)
+    dm_cols = [k for k, n in enumerate(pta.param_names) if "dm_gp" in n]
+    assert set(dm_cols) <= set(idx.red.tolist())
+    cm = compile_pta(pta)
+    # the compiled phi carries the DM contribution on its own columns
+    x = pta.initial_sample(np.random.default_rng(1))
+    ph = np.asarray(cm.phi(x))[0]
+    ph_host = pta.get_phi(pta.map_params(x))[0]
+    sel = ph_host < 1e20
+    np.testing.assert_allclose(ph[:len(ph_host)][sel], ph_host[sel],
+                               rtol=1e-4)
+    # gp_mask covers exactly the Fourier + DM columns
+    m = pta.model(0)
+    gp_cols = np.zeros(len(ph_host))
+    for s in m._fourier + m._chrom:
+        sl = m._slices[s.name]
+        gp_cols[sl] = 1.0
+    np.testing.assert_array_equal(np.asarray(cm.gp_mask)[0][:len(ph_host)],
+                                  gp_cols)
+
+
+def test_dm_jax_vs_numpy_ks(dm_psr, tmp_path):
+    pta = model_general([dm_psr], tm_svd=True, red_var=False,
+                        white_vary=False, common_psd="spectrum",
+                        common_components=5, dm_var=True, dm_components=5)
+    x0 = pta.initial_sample(np.random.default_rng(23))
+    chains = {}
+    for backend, seed in [("jax", 31), ("numpy", 32)]:
+        g = PulsarBlockGibbs(pta, backend=backend, seed=seed, progress=False)
+        chains[backend] = g.sample(x0, outdir=str(tmp_path / backend),
+                                   niter=1500)
+    burn, thin = 300, 5
+    dm_cols = [k for k, n in enumerate(pta.param_names) if "dm_gp" in n]
+    idx = BlockIndex.build(pta.param_names)
+    # rho bins mix in O(1) sweeps: KS directly
+    pvals = [stats.ks_2samp(chains["jax"][burn::thin, k],
+                            chains["numpy"][burn::thin, k]).pvalue
+             for k in idx.rho[:3]]
+    assert min(pvals) > 1e-4, pvals
+    # the unconstrained DM hypers mix slowly under the 20-step MH block
+    # (ACT 30-120 here), so compare them with an ESS-aware z-test
+    from pulsar_timing_gibbsspec_tpu.ops.acf import integrated_act
+
+    for k in dm_cols:
+        cj = chains["jax"][burn:, k]
+        cn = chains["numpy"][burn:, k]
+        assert np.std(cj) > 1e-3     # the block must actually move
+        ess_j = len(cj) / max(integrated_act(cj), 1.0)
+        ess_n = len(cn) / max(integrated_act(cn), 1.0)
+        z = abs(cj.mean() - cn.mean()) / np.sqrt(
+            cj.var() / ess_j + cn.var() / ess_n)
+        assert z < 4.0, (pta.param_names[k], z)
