@@ -14,13 +14,16 @@ stack (SURVEY §5).  Supported here natively:
   ``model_general`` accepts these kwargs but never adds the block (its
   notebooks hand-build it); here the advertised behavior is implemented
 - white noise: ``white_vary``, per-backend EFAC/EQUAD via
-  ``select='backend'``, fixed values via ``noisedict``
+  ``select='backend'``, fixed values via ``noisedict``, global EQUAD via
+  ``gequad``
+- chromatic GPs: ``dm_var`` (nu^-2 dispersion-measure GP) and ``dm_chrom``
+  (nu^-chrom_idx scattering GP), powerlaw PSDs, own basis columns
 - ECORR (basis) for pulsars carrying a NANOGrav pta flag, as in
   ``model_definition.py:221-223``
 - ``Tspan``/``modes``/``logfreq`` frequency-grid control, upper-limit
   (LinearExp) amplitude priors
 
-Unsupported reference kwargs (BayesEphem, DM/chromatic GPs, wideband,
+Unsupported reference kwargs (BayesEphem, wideband, DM annual,
 t-process PSDs, band selections) raise ``NotImplementedError`` loudly rather
 than silently no-op.
 """
@@ -48,8 +51,8 @@ def _reject_unsupported(kw: dict):
     unsupported = {
         "tm_var": False, "tm_linear": False, "tmparam_list": None,
         "bayesephem": False, "is_wideband": False, "use_dmdata": False,
-        "dm_annual": False, "dm_chrom": False,
-        "gequad": False, "coefficients": False, "red_select": None,
+        "dm_annual": False,
+        "coefficients": False, "red_select": None,
         "red_breakflat": False, "pshift": False,
     }
     for key, default in unsupported.items():
@@ -79,6 +82,8 @@ def model_general(psrs, tm_svd=False, tm_norm=True, noisedict=None,
                   red_var=True, red_psd="powerlaw", red_components=30,
                   upper_limit_red=None,
                   dm_var=False, dm_psd="powerlaw", dm_components=30,
+                  dm_chrom=False, chrom_psd="powerlaw", chrom_components=30,
+                  chrom_idx=4.0, gequad=False,
                   select="backend", **extra) -> PTA:
     """Build a PTA model over ``data.Pulsar`` objects.  See module docstring
     for the supported subset; returns a :class:`~..models.pta.PTA`."""
@@ -154,23 +159,30 @@ def model_general(psrs, tm_svd=False, tm_norm=True, noisedict=None,
                 psr.toas / 86400.0, red_components, Tspan,
                 psd_name=red_psd, psd_params=rps, name=rname, modes=grid))
 
-        if dm_var:
-            # dispersion-measure GP: chromatic (nu^-2) Fourier process with
-            # its own basis columns (reference model_definition.py:19-31
-            # via enterprise's dm_noise_block; amplitudes referenced to
-            # 1400 MHz)
-            if dm_psd != "powerlaw":
+        # chromatic GPs (reference model_definition.py:19-31 via
+        # enterprise's dm/chrom noise blocks; amplitudes referenced to
+        # 1400 MHz): dm_var = nu^-2 dispersion measure, dm_chrom =
+        # nu^-chrom_idx scattering.  Own basis columns each.
+        def chrom_gp(suffix, psd, components, index):
+            if psd != "powerlaw":
                 raise NotImplementedError(
-                    f"dm_psd='{dm_psd}': the DM GP currently supports the "
-                    "powerlaw PSD (its hypers join the adaptive MH block)")
-            dname = f"{psr.name}_dm_gp"
+                    f"{suffix} psd='{psd}': chromatic GPs currently "
+                    "support the powerlaw PSD (their hypers join the "
+                    "adaptive MH block)")
+            cname = f"{psr.name}_{suffix}"
             amp_cls = LinearExp if amp_prior == "uniform" else Uniform
-            dps = [amp_cls(-20.0, -11.0, name=f"{dname}_log10_A"),
-                   Uniform(0.0, 7.0, name=f"{dname}_gamma")]
-            sigs.append(FourierGPSignal(
-                psr.toas / 86400.0, dm_components, Tspan,
-                psd_name=dm_psd, psd_params=dps, name=dname, modes=grid,
-                radio_freqs=psr.freqs, chrom_index=2.0))
+            ps = [amp_cls(-20.0, -11.0, name=f"{cname}_log10_A"),
+                  Uniform(0.0, 7.0, name=f"{cname}_gamma")]
+            return FourierGPSignal(
+                psr.toas / 86400.0, components, Tspan, psd_name=psd,
+                psd_params=ps, name=cname, modes=grid,
+                radio_freqs=psr.freqs, chrom_index=float(index))
+
+        if dm_var:
+            sigs.append(chrom_gp("dm_gp", dm_psd, dm_components, 2.0))
+        if dm_chrom:
+            sigs.append(chrom_gp("chrom_gp", chrom_psd, chrom_components,
+                                 chrom_idx))
 
         # ---- white noise -------------------------------------------------
         masks = SELECTIONS[select](psr.backend_flags)
@@ -189,7 +201,16 @@ def model_general(psrs, tm_svd=False, tm_norm=True, noisedict=None,
                                        name=f"{stem}_log10_tnequad")
                 ecorrs[lab] = Constant(nd.get(f"{stem}_log10_ecorr", -40.0),
                                        name=f"{stem}_log10_ecorr")
-        white = WhiteNoiseSignal(psr.toaerrs, masks, efacs, equads)
+        geq = None
+        if gequad:
+            gname = f"{psr.name}_log10_gequad"
+            if white_vary:
+                geq = Uniform(-8.5, -5.0, name=gname)
+            else:
+                geq = Constant((noisedict or {}).get(gname, -40.0),
+                               name=gname)
+        white = WhiteNoiseSignal(psr.toaerrs, masks, efacs, equads,
+                                 gequad=geq)
 
         # basis ECORR only for NANOGrav-flagged pulsars, as the reference
         # gates it (model_definition.py:221-223)

@@ -189,27 +189,34 @@ def _quantize(toas: np.ndarray, dt_sec: float):
 
 
 class WhiteNoiseSignal:
-    """Diagonal measurement covariance: per-backend EFAC and EQUAD.
+    """Diagonal measurement covariance: per-backend EFAC and EQUAD, plus
+    an optional global EQUAD.
 
-    ``N_i = efac_b(i)^2 sigma_i^2 + 10^(2 log10_tnequad_b(i))`` (the tnequad
-    convention).  With ``vary=False`` the parameters are Constants (efac 1,
-    equad off) or come from a noise dictionary — mirroring
-    ``white_noise_block(vary, select)`` usage at reference
-    ``model_definition.py:219-228``.
+    ``N_i = efac_b(i)^2 sigma_i^2 + 10^(2 log10_tnequad_b(i))
+    [+ 10^(2 log10_gequad)]`` (the tnequad convention; ``gequad`` is the
+    reference's backend-independent extra white term,
+    ``model_definition.py`` kwarg ``gequad``).  With ``vary=False`` the
+    parameters are Constants (efac 1, equad off) or come from a noise
+    dictionary — mirroring ``white_noise_block(vary, select)`` usage at
+    reference ``model_definition.py:219-228``.
     """
 
     name = "measurement_noise"
 
     def __init__(self, toaerrs: np.ndarray, masks: dict,
-                 efac_by_backend: dict, equad_by_backend: dict | None):
+                 efac_by_backend: dict, equad_by_backend: dict | None,
+                 gequad=None):
         self._sigma2 = toaerrs**2
         labels = sorted(efac_by_backend)
         self._masks = {lab: np.asarray(masks[lab], dtype=bool) for lab in labels}
         self._efac = dict(efac_by_backend)
         self._equad = dict(equad_by_backend) if equad_by_backend else None
+        self._gequad = gequad
         self.params = [efac_by_backend[lab] for lab in labels]
         if self._equad:
             self.params += [self._equad[lab] for lab in labels]
+        if gequad is not None:
+            self.params.append(gequad)
 
     def get_basis(self):
         return None
@@ -224,4 +231,6 @@ class WhiteNoiseSignal:
             N[mask] = efac**2 * self._sigma2[mask]
             if self._equad:
                 N[mask] += 10.0 ** (2.0 * vals[self._equad[lab].name])
+        if self._gequad is not None:
+            N += 10.0 ** (2.0 * vals[self._gequad.name])
         return N

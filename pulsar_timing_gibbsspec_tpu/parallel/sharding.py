@@ -30,7 +30,7 @@ from ..sampler.compiled import CompiledPTA, GPComponent
 #: CompiledPTA array fields whose leading axis is the pulsar axis
 _PULSAR_FIELDS = (
     "y", "T", "toa_mask", "basis_mask", "psr_mask", "sigma2",
-    "efac_ix", "equad_ix", "phi_base",
+    "efac_ix", "equad_ix", "gequad_ix", "phi_base", "gp_mask",
     "gw_sin_ix", "gw_cos_ix", "gw_f", "gw_df", "gw_hyp_ix", "gw_rho_ix",
     "red_valid", "red_hyp_ix", "red_rho_ix", "red_rho_ix_x",
     "red_sin_ix", "red_cos_ix",

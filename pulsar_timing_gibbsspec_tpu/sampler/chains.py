@@ -41,6 +41,19 @@ class ChainStore:
             np.savez(tmp, iter=np.int64(upto), **adapt_state)
             os.replace(tmp, self.outdir / "adapt.npz")
 
+    def log_metrics(self, record: dict):
+        """Append one JSON line to ``metrics.jsonl`` — the structured
+        observability stream (iteration progress, rates, adaptation
+        state); the reference only ever prints a percent line
+        (``pta_gibbs.py:707-711``)."""
+        import json
+        import time as _time
+
+        record = {"ts": round(_time.time(), 3),
+                  **{k: v for k, v in record.items() if v is not None}}
+        with open(self.outdir / "metrics.jsonl", "a") as fh:
+            fh.write(json.dumps(record) + "\n")
+
     def load_resume(self):
         """Return (chain, bchain, start_iter, adapt_state) or None if there
         is nothing to resume from."""

@@ -154,6 +154,7 @@ class CompiledPTA:
     sigma2: object             # (P, Nmax)
     efac_ix: object            # (P, Nmax) -> xe
     equad_ix: object           # (P, Nmax) -> xe
+    gequad_ix: object          # (P, Nmax) -> xe (global EQUAD; off pad)
     const_pool: object         # (npool,)
     phi_base: object           # (P, Bmax)
     components: list
@@ -223,11 +224,13 @@ class CompiledPTA:
 
     def ndiag(self, x):
         """(P, Nmax) diagonal measurement covariance
-        (``WhiteNoiseSignal.get_ndiag`` compiled to two gathers)."""
+        (``WhiteNoiseSignal.get_ndiag`` compiled to three gathers)."""
         xev = self.xe(x)
         efac = xev[self.efac_ix]
         equad = xev[self.equad_ix]
-        return efac * efac * self.sigma2 + 10.0 ** (2.0 * equad)
+        gequad = xev[self.gequad_ix]
+        return (efac * efac * self.sigma2 + 10.0 ** (2.0 * equad)
+                + 10.0 ** (2.0 * gequad))
 
     def ndiag_fast(self, x):
         """(P, Nmax) measurement covariance in the *storage* dtype — the
@@ -235,7 +238,9 @@ class CompiledPTA:
         xev = self.xe(x).astype(self.dtype)
         efac = xev[self.efac_ix]
         equad = xev[self.equad_ix]
-        return efac * efac * self.sigma2 + 10.0 ** (2.0 * equad)
+        gequad = xev[self.gequad_ix]
+        return (efac * efac * self.sigma2 + 10.0 ** (2.0 * equad)
+                + 10.0 ** (2.0 * gequad))
 
     def _phi_accum(self, x, base, comps, dtype=None):
         """Scatter-add the selected components' variances onto ``base``."""
@@ -447,6 +452,7 @@ def compile_pta(pta, pad_pulsars: int | None = None) -> CompiledPTA:
     sigma2 = np.ones((P, Nmax), np_dtype)
     efac_ix = np.full((P, Nmax), efac1, np.int32)
     equad_ix = np.full((P, Nmax), equad_off, np.int32)
+    gequad_ix = np.full((P, Nmax), equad_off, np.int32)
     phi_base = np.ones((P, Bmax), np_dtype)
 
     gp_mask = np.zeros((P, Bmax), np_dtype)
@@ -468,6 +474,8 @@ def compile_pta(pta, pad_pulsars: int | None = None) -> CompiledPTA:
                 efac_ix[ii, where] = ref(m.white._efac[lab])
                 if m.white._equad:
                     equad_ix[ii, where] = ref(m.white._equad[lab])
+            if m.white._gequad is not None:
+                gequad_ix[ii, :n] = ref(m.white._gequad)
         # timing-model columns: effectively-infinite prior variance
         for s in m._timing:
             sl_ = m._slices[s.name]
@@ -752,6 +760,7 @@ def compile_pta(pta, pad_pulsars: int | None = None) -> CompiledPTA:
         cdtype=np_cdtype,
         y=y, T=T, toa_mask=toa_mask, basis_mask=basis_mask, psr_mask=psr_mask,
         sigma2=sigma2, efac_ix=efac_ix, equad_ix=equad_ix,
+        gequad_ix=gequad_ix,
         const_pool=np.asarray(pool, np_dtype), phi_base=phi_base,
         components=components,
         pkind=pkind, pa=pa, pb=pb,

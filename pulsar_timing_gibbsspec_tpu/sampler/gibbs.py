@@ -133,11 +133,22 @@ class _GibbsBase:
             if upto - last_saved >= save_every or upto >= niter:
                 store.save(chain, bchain, upto,
                            adapt_state=self._backend.adapt_state())
+                el = time.time() - t0
+                done = upto - start
+                rate = done / el if el > 0 else float("nan")
+                store.log_metrics({
+                    "iter": int(upto), "niter": int(niter),
+                    "elapsed_s": round(el, 3),
+                    "sweeps_per_s": round(rate, 3),
+                    "backend": self.backend_name,
+                    "nchains": int(getattr(self._backend, "C", 1)),
+                    "aclength_white": getattr(
+                        self._backend, "aclength_white", None),
+                    "aclength_ecorr": getattr(
+                        self._backend, "aclength_ecorr", None),
+                })
                 last_saved = upto
                 if self.progress:
-                    el = time.time() - t0
-                    done = upto - start
-                    rate = done / el if el > 0 else float("nan")
                     print(f"\r[{self.backend_name}] {upto}/{niter} sweeps "
                           f"({rate:.1f}/s)", end="", flush=True)
         if self.progress:

@@ -101,9 +101,12 @@ def lnlike_white_per(cm: CompiledPTA, x, r2):
     xev = cm.xe(x)
     efac = xev[cm.efac_ix]
     equad = xev[cm.equad_ix]
+    gequad = xev[cm.gequad_ix]
     s2 = jnp.asarray(cm.sigma2, cdt)
     ln_s2 = jnp.log(s2)
-    M = efac * efac + jnp.exp(2.0 * np.log(10.0) * equad - ln_s2)
+    ln10_2 = 2.0 * np.log(10.0)
+    M = (efac * efac + jnp.exp(ln10_2 * equad - ln_s2)
+         + jnp.exp(ln10_2 * gequad - ln_s2))
     w = r2.astype(cdt) / s2
     return -0.5 * jnp.sum(cm.toa_mask * (ln_s2 + jnp.log(M) + w / M), axis=1)
 
@@ -493,7 +496,9 @@ def white_ll_rel(cm: CompiledPTA, x0, r2):
         xev = cm.xe(q).astype(fdt)
         efac = xev[cm.efac_ix]
         equad = xev[cm.equad_ix]
-        Nq = efac * efac * jnp.asarray(cm.sigma2, fdt) + 10.0 ** (2.0 * equad)
+        gequad = xev[cm.gequad_ix]
+        Nq = (efac * efac * jnp.asarray(cm.sigma2, fdt)
+              + 10.0 ** (2.0 * equad) + 10.0 ** (2.0 * gequad))
         z = N0f / Nq
         return 0.5 * jnp.sum(mask * (jnp.log(z) - w * (z - 1.0)), axis=1)
 
@@ -1226,6 +1231,27 @@ class JaxGibbsDriver:
             ii = row + 1 if W else 1
             self.x_cur = np.asarray(x, dtype=np.float64)
             yield ii
+        # double-buffered steady loop: dispatch chunk i+1 (async on device)
+        # BEFORE converting chunk i's outputs, so host-side writeback and
+        # the device-to-host transfer overlap device compute (on the
+        # tunneled TPU the per-chunk transfer+conversion otherwise
+        # serializes with the sweep and costs ~40% of wall time).
+        # Checkpoint consistency: the state yielded with chunk i's rows is
+        # chunk i's own carry (x_end, b_end) — never the in-flight chunk's.
+        b_dev = jnp.asarray(self.b)
+        pending = None          # (row, n, xs, bs, x_end, b_end)
+
+        def _writeback(row, n, xs, bs, x_end, b_end):
+            xs_h = self._squeeze(np.asarray(xs, dtype=np.float64))
+            self._check_finite(xs_h, row, "chain state")
+            bs_h = self._squeeze(self._b_flat(bs))
+            self._check_finite(bs_h, row, "b coefficients")
+            chain[row:row + n] = xs_h
+            bchain[row:row + n] = bs_h
+            self.x_cur = np.asarray(x_end, dtype=np.float64)
+            self.b = b_end
+            return row + n
+
         while ii < niter:
             n = min(self.chunk_size, niter - ii)
             # always run the full compiled chunk length: a trailing
@@ -1236,21 +1262,18 @@ class JaxGibbsDriver:
             # including on resume: the final state is read from the
             # recorded pre-sweep states at position n.
             fn = self._chunk_fn(self.chunk_size)
-            x, b, xs, bs = fn(x, jnp.asarray(self.b), self.key,
-                              jnp.asarray(ii, dtype=jnp.int32), self._aux())
+            x, b_dev, xs, bs = fn(x, b_dev, self.key,
+                                  jnp.asarray(ii, dtype=jnp.int32),
+                                  self._aux())
             if n < self.chunk_size:
-                x, b = xs[n], bs[n]
+                x, b_dev = xs[n], bs[n]
                 xs, bs = xs[:n], bs[:n]
-            self.b = b
-            xs_h = self._squeeze(np.asarray(xs, dtype=np.float64))
-            self._check_finite(xs_h, ii, "chain state")
-            bs_h = self._squeeze(self._b_flat(bs))
-            self._check_finite(bs_h, ii, "b coefficients")
-            chain[ii:ii + n] = xs_h
-            bchain[ii:ii + n] = bs_h
+            if pending is not None:
+                yield _writeback(*pending)
+            pending = (ii, n, xs, bs, x, b_dev)
             ii += n
-            self.x_cur = np.asarray(x, dtype=np.float64)
-            yield ii
+        if pending is not None:
+            yield _writeback(*pending)
 
     # ---- checkpointable state ----------------------------------------------
 

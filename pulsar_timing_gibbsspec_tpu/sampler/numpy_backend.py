@@ -137,20 +137,38 @@ class NumpyGibbs:
         return align_phi(np.asarray(self.red_sig.get_phi(params))[::2], kgw)
 
     def lnlike_red(self, xs):
-        """b-conditional likelihood of every GP hyper (reference :549-566
-        for the shared red/GW columns, extended with the N(0, phi) terms of
-        GPs on their own columns — the chromatic DM block)."""
+        """b-conditional likelihood of every GP hyper: the N(0, phi(x))
+        terms of all Fourier + chromatic columns (reference :549-566 is
+        the same sum on the shared columns up to hyper-independent
+        constants).  Per-column form over the *whole* shared block — not
+        truncated to the GW grid — so red-only tail frequencies (when
+        red_components > common_components) are included, matching the
+        device backend's generic target exactly."""
         params = self.map_params(xs)
-        tau = self._gw_tau()
-        irn = self._red_phi_at_gw_freqs(params)
-        gw = np.asarray(self.gw_sig.get_phi(params))[::2]
-        logratio = np.log(tau) - np.logaddexp(np.log(irn), np.log(gw))
-        out = float(np.sum(logratio - np.exp(logratio)))
-        for s in self._model._chrom:
-            sl_ = self._model._slices[s.name]
-            phi = np.asarray(s.get_phi(params))
-            bb = self.b[sl_]
-            out += float(np.sum(-0.5 * np.log(phi) - 0.5 * bb * bb / phi))
+        out = 0.0
+        m = self._model
+        for kind in (m._fourier, m._chrom):
+            if not kind:
+                continue
+            if kind is m._fourier:
+                # shared block: per-column phi sums every Fourier signal
+                start = min(m._slices[s.name].start for s in kind)
+                stop = max(m._slices[s.name].stop for s in kind)
+                phi = np.zeros(stop - start)
+                for s in kind:
+                    sl_ = m._slices[s.name]
+                    phi[sl_.start - start:sl_.stop - start] += \
+                        np.asarray(s.get_phi(params))
+                bb = self.b[start:stop]
+                out += float(np.sum(-0.5 * np.log(phi)
+                                    - 0.5 * bb * bb / phi))
+            else:
+                for s in kind:
+                    sl_ = m._slices[s.name]
+                    phi = np.asarray(s.get_phi(params))
+                    bb = self.b[sl_]
+                    out += float(np.sum(-0.5 * np.log(phi)
+                                        - 0.5 * bb * bb / phi))
         return out
 
     def lnlike_ecorr(self, xs):

@@ -166,21 +166,25 @@ class NumpyPTAGibbs:
         return out
 
     def lnlike_red(self, xs):
-        """b-conditional likelihood of all per-pulsar GP hypers (sum of the
-        single-pulsar expressions; chromatic own-column GPs included)."""
+        """b-conditional likelihood of all per-pulsar GP hypers: per-column
+        N(0, phi(x)) terms over the whole shared Fourier block (not
+        truncated to the GW grid) plus chromatic own-column GPs — the same
+        generic target as the device backend."""
         params = self.map_params(xs)
         out = 0.0
         for ii in range(self.P):
-            tau = self._gw_tau(ii)
-            kgw = len(tau)
-            irn = np.full(kgw, 1e-30)
-            if self.red_sigs[ii] is not None:
-                irn = align_phi(
-                    np.asarray(self.red_sigs[ii].get_phi(params))[::2], kgw)
-            gw = np.asarray(self.gw_sigs[ii].get_phi(params))[::2]
-            logratio = np.log(tau) - np.logaddexp(np.log(irn), np.log(gw))
-            out += float(np.sum(logratio - np.exp(logratio)))
             m = self.pta.model(ii)
+            if m._fourier:
+                start = min(m._slices[s.name].start for s in m._fourier)
+                stop = max(m._slices[s.name].stop for s in m._fourier)
+                phi = np.zeros(stop - start)
+                for s in m._fourier:
+                    sl_ = m._slices[s.name]
+                    phi[sl_.start - start:sl_.stop - start] += \
+                        np.asarray(s.get_phi(params))
+                bb = self.b[ii][start:stop]
+                out += float(np.sum(-0.5 * np.log(phi)
+                                    - 0.5 * bb * bb / phi))
             for s in m._chrom:
                 sl_ = m._slices[s.name]
                 phi = np.asarray(s.get_phi(params))
