@@ -17,15 +17,19 @@ stack (SURVEY §5).  Supported here natively:
   ``select='backend'``, fixed values via ``noisedict``, global EQUAD via
   ``gequad``
 - chromatic GPs: ``dm_var`` (nu^-2 dispersion-measure GP) and ``dm_chrom``
-  (nu^-chrom_idx scattering GP), powerlaw PSDs, own basis columns
+  (nu^-chrom_idx scattering GP), powerlaw PSDs, own basis columns;
+  ``dm_annual`` as a *marginalized* linearized annual DM sinusoid (two
+  nu^-2 sin/cos columns with improper prior — the same 2-d subspace the
+  reference's sampled amplitude/phase parameterizes, with no extra
+  sampling block)
 - ECORR (basis) for pulsars carrying a NANOGrav pta flag, as in
   ``model_definition.py:221-223``
 - ``Tspan``/``modes``/``logfreq`` frequency-grid control, upper-limit
   (LinearExp) amplitude priors
 
-Unsupported reference kwargs (BayesEphem, wideband, DM annual,
-t-process PSDs, band selections) raise ``NotImplementedError`` loudly rather
-than silently no-op.
+Unsupported reference kwargs (BayesEphem, wideband, t-process PSDs, band
+selections) raise ``NotImplementedError`` loudly rather than silently
+no-op.
 """
 
 from __future__ import annotations
@@ -51,7 +55,6 @@ def _reject_unsupported(kw: dict):
     unsupported = {
         "tm_var": False, "tm_linear": False, "tmparam_list": None,
         "bayesephem": False, "is_wideband": False, "use_dmdata": False,
-        "dm_annual": False,
         "coefficients": False, "red_select": None,
         "red_breakflat": False, "pshift": False,
     }
@@ -82,6 +85,7 @@ def model_general(psrs, tm_svd=False, tm_norm=True, noisedict=None,
                   red_var=True, red_psd="powerlaw", red_components=30,
                   upper_limit_red=None,
                   dm_var=False, dm_psd="powerlaw", dm_components=30,
+                  dm_annual=False,
                   dm_chrom=False, chrom_psd="powerlaw", chrom_components=30,
                   chrom_idx=4.0, gequad=False,
                   select="backend", **extra) -> PTA:
@@ -183,6 +187,10 @@ def model_general(psrs, tm_svd=False, tm_norm=True, noisedict=None,
         if dm_chrom:
             sigs.append(chrom_gp("chrom_gp", chrom_psd, chrom_components,
                                  chrom_idx))
+        if dm_annual:
+            from .signals import DMAnnualSignal
+
+            sigs.append(DMAnnualSignal(psr.toas, psr.freqs))
 
         # ---- white noise -------------------------------------------------
         masks = SELECTIONS[select](psr.backend_flags)

@@ -130,6 +130,36 @@ class FourierGPSignal(BasisSignal):
         return self._psd_fn(self._f, self._df, *args)
 
 
+class DMAnnualSignal(BasisSignal):
+    """Linearized annual dispersion-measure variation.
+
+    Two nu^-2-scaled columns, ``sin(2 pi t / yr)`` and ``cos(2 pi t /
+    yr)``, marginalized like timing-model columns (improper prior).  The
+    reference's ``dm_annual`` is a deterministic sinusoid with sampled
+    amplitude and phase (enterprise ``dm_annual``,
+    ``model_definition.py:19-31``); amplitude x phase parameterizes
+    exactly the 2-d linear subspace these columns span, so marginalizing
+    the linear coefficients covers the same component without a nonlinear
+    sampling block.
+    """
+
+    name = "dm_annual"
+    YEAR = 365.25 * 86400.0
+
+    def __init__(self, toas_sec: np.ndarray, radio_freqs: np.ndarray):
+        w = 2.0 * np.pi / self.YEAR
+        scale = (1400.0 / np.asarray(radio_freqs)) ** 2
+        self._T = np.column_stack([np.sin(w * toas_sec),
+                                   np.cos(w * toas_sec)]) * scale[:, None]
+        self.params = []
+
+    def get_basis(self):
+        return self._T
+
+    def get_phi(self, params):
+        return np.full(2, 1e40)
+
+
 class EcorrBasisSignal(BasisSignal):
     """Epoch-correlated white noise as a basis GP ('basis_ecorr').
 

@@ -20,15 +20,19 @@ import jax
 import jax.numpy as jnp
 
 
-def precond_cholesky(Sigma):
+def precond_cholesky(Sigma, ridge=0.0):
     """Jacobi-preconditioned Cholesky.
 
     Returns ``(L, dj)`` where ``L`` is the lower Cholesky factor of
-    ``D Sigma D`` and ``dj`` the diagonal of ``D = diag(1/sqrt(diag Sigma))``.
-    """
+    ``D Sigma D [+ ridge I]`` and ``dj`` the diagonal of
+    ``D = diag(1/sqrt(diag Sigma))``.  ``ridge`` (on the unit-diagonal
+    preconditioned matrix) guards an f32 factorization against entry
+    rounding making a near-singular system indefinite."""
     diag = jnp.diagonal(Sigma, axis1=-2, axis2=-1)
     dj = 1.0 / jnp.sqrt(diag)
     A = Sigma * dj[..., :, None] * dj[..., None, :]
+    if ridge:
+        A = A + Sigma.dtype.type(ridge) * jnp.eye(A.shape[-1], dtype=A.dtype)
     L = jnp.linalg.cholesky(A)
     return L, dj
 

@@ -190,14 +190,20 @@ def profile_blocks(driver, x, repeats=5, inner=50):
         repeats)
 
     # the composed sweep, timed the same way (this is what the chunked
-    # driver actually runs), plus the per-dispatch overhead for context
+    # driver actually runs; t=1 exercises the Metropolised-b-draw branch),
+    # plus the per-dispatch overhead for context
     body = driver._sweep_body()
     aux = driver._aux()
 
     def full(x, b, k):
-        return jax.vmap(
-            lambda x1, b1, k1, a: body((x1, b1), k1, a)[0],
-            in_axes=(0, 0, 0, 0))(x, b, jr.split(k, C), aux)
+        def one(x1, b1, k1, a):
+            u1 = jb.b_matvec(cm, b1)
+            (x1, b1, _), _ = body((x1, b1, u1), k1, a, 1)
+            return x1, b1
+
+        xn, bn = jax.vmap(one, in_axes=(0, 0, 0, 0))(x, b,
+                                                     jr.split(k, C), aux)
+        return xn, bn
 
     out["full_sweep"] = _scan_time(full, x, b, inner, repeats)
     out["dispatch"] = _timeit(

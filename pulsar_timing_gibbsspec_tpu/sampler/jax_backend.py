@@ -675,6 +675,110 @@ def red_conditional_update(cm: CompiledPTA, x, b, key):
         (0.5 * jnp.log10(rhonew)).astype(x.dtype), mode="drop")
 
 
+#: every EXACT_EVERY-th sweep uses the exact f64 b-draw instead of the
+#: Metropolised f32-proposal draw, bounding how long an occasional
+#: ill-conditioned proposal can leave a pulsar's coefficients unmoved
+EXACT_EVERY = 8
+#: diagonal ridge on the f32-preconditioned proposal system: larger than
+#: the f32 entry rounding of the unit-diagonal matrix so its Cholesky
+#: cannot break down, small enough to barely touch the proposal shape
+_PROP_RIDGE = 4e-6
+
+
+def b_matvec(cm: CompiledPTA, b):
+    """``u = T b`` in the storage dtype — the sufficient statistic for the
+    white-noise part of the exact b log-density; cached across sweeps
+    because it depends only on ``b``.  ``precision="highest"`` matters:
+    TPU's default matmul precision multiplies in bf16 (~1e-3 relative),
+    which would perturb the MH target by O(0.1) in log density; full-f32
+    multiplies keep the documented ~1e-5 accuracy."""
+    import jax.numpy as jnp
+
+    return jnp.einsum("pnb,pb->pn", cm.T, b.astype(cm.dtype),
+                      precision="highest")
+
+
+def _logpi_b_per(cm: CompiledPTA, x, b, u):
+    """Per-pulsar log pi(b | x) up to b-independent constants, from the
+    cached matvec ``u = T b``: ``-0.5 u^2/N + (y/N) u - 0.5 b^2/phi``.
+    f32 elementwise with f64 accumulation: the absolute error is ~1e-5 on
+    an O(100) log-ratio — far below what an accept/reject step can see."""
+    import jax.numpy as jnp
+
+    fdt = cm.dtype
+    N = cm.ndiag_fast(x)
+    t1 = ((-0.5 * u + jnp.asarray(cm.y)) * (u / N)
+          * jnp.asarray(cm.toa_mask, fdt))
+    phi32 = cm.phi(x, dtype=fdt)
+    bb = b.astype(fdt)
+    t2 = -0.5 * bb * bb / phi32
+    return (jnp.sum(t1.astype(cm.cdtype), axis=1)
+            + jnp.sum(t2.astype(cm.cdtype), axis=1))
+
+
+def draw_b_mh(cm: CompiledPTA, x, b, u, key):
+    """Metropolised b-draw: propose from the f32-factored conditional,
+    accept per pulsar with the exact Hastings ratio.
+
+    The exact f64 draw (:func:`draw_b_fn`) costs ~15 ms/sweep in TPU's
+    software f64; the f32 proposal pipeline (MXU einsum, native batched
+    Cholesky + triangular solves) is essentially free, and the exact
+    log-density ratio needs only one ``T b'`` matvec thanks to the cached
+    ``u = T b``.  The f32 factor is a *proposal* — any error only lowers
+    acceptance (measured ~98% mean across states; per-pulsar accepts keep
+    one hard pulsar from stalling the rest, and the periodic exact draw
+    in the sweep body bounds worst-case stickiness).  The chain's
+    stationary distribution stays the exact conditional.
+
+    Returns ``(b', u', accepted_mask)``.
+    """
+    import jax
+    import jax.numpy as jnp
+    import jax.random as jr
+
+    from ..ops.linalg import precond_cholesky, precond_sample, precond_solve
+
+    fdt = cm.dtype
+    k1, k2 = jr.split(key)
+    # ---- f32 proposal: N(mean32, Sigma32^-1) ------------------------------
+    # full-f32 multiplies here too: bf16 default precision would blur the
+    # proposal mean/covariance and only lower acceptance, but the 3-pass
+    # f32 MXU path is still essentially free next to the f64 work
+    N = cm.ndiag_fast(x)
+    TN = cm.T / N[:, :, None]
+    TNT = jnp.einsum("pnb,pnc->pbc", TN, cm.T,
+                     preferred_element_type=fdt, precision="highest")
+    d = jnp.einsum("pnb,pn->pb", TN, cm.y, preferred_element_type=fdt,
+                   precision="highest")
+    phi32 = cm.phi(x, dtype=fdt)
+    eye = jnp.eye(cm.Bmax, dtype=fdt)
+    Sig = TNT + (1.0 / phi32)[:, :, None] * eye
+    L, dj = precond_cholesky(Sig, ridge=_PROP_RIDGE)
+    mean = precond_solve(L, dj, d)
+    z = jr.normal(k1, (cm.P, cm.Bmax), fdt)
+    bp32 = precond_sample(L, dj, mean, z)
+    bp = bp32.astype(cm.cdtype)
+    up = b_matvec(cm, bp)
+    # ---- exact log-density ratio + proposal correction --------------------
+    lpi_new = _logpi_b_per(cm, x, bp, up)
+    lpi_old = _logpi_b_per(cm, x, b, u)
+    # logq(v) = -0.5 || L^T ((v - mean)/dj) ||^2 (+ const that cancels);
+    # for the fresh proposal that quadratic form is exactly ||z||^2 —
+    # which is why w_old needs full-f32 precision: it enters the ratio
+    # against that exactly-known value
+    w_old = jnp.einsum("pji,pj->pi", L, (b.astype(fdt) - mean) / dj,
+                       precision="highest")
+    logq_old = -0.5 * jnp.sum(w_old * w_old, axis=1).astype(cm.cdtype)
+    logq_new = -0.5 * jnp.sum(z * z, axis=1).astype(cm.cdtype)
+    logr = (lpi_new - lpi_old) + (logq_old - logq_new)
+    ok = (jnp.all(jnp.isfinite(bp32), axis=1) & jnp.isfinite(logr))
+    logu = jnp.log(jr.uniform(k2, (cm.P,), cm.cdtype))
+    acc = ok & (logr > logu)
+    b_new = jnp.where(acc[:, None], bp, b)
+    u_new = jnp.where(acc[:, None], up, u)
+    return b_new, u_new, acc
+
+
 def residual_sq(cm: CompiledPTA, b):
     """(y - T b)^2 in the storage dtype: |T_i . b| ~ |y| so the f32 matvec
     error is ~1e-5 relative to the residual — far below what the white MH
@@ -1005,25 +1109,36 @@ class JaxGibbsDriver:
             None if self.red_S is None else jnp.asarray(self.red_S),
         )
 
-    def _sweep_body(self):
+    def _sweep_body(self, bdraw="mh"):
         """One post-adaptation Gibbs sweep (reference order,
         ``pulsar_gibbs.py:656-698``) as a single-chain body
-        ``body(carry, key, aux)``; the chunk functions vmap it over the
-        chains axis."""
+        ``body(carry, key, aux, t)`` over carry ``(x, b, u)`` with
+        ``u = T b`` cached; the chunk functions vmap it over the chains
+        axis.
+
+        ``bdraw`` selects the b-draw kernel: "mh" (f32 proposal + exact
+        Hastings accept) or "exact" (f64).  The periodic exact refresh is
+        selected per *iteration* by the chunk step's ``lax.cond`` between
+        the two compiled bodies — the predicate is chain-independent, and
+        a cond inside the vmapped body would lower to ``select`` and
+        execute both draws every sweep."""
+        import jax.numpy as jnp
         import jax.random as jr
 
         cm = self.cm
         nw = self.aclength_white or 0
         ne = self.aclength_ecorr or 0
 
-        def body(carry, key, aux):
-            x, b = carry
+        def body(carry, key, aux, t):
+            x, b, u = carry
             (chol_w, mode_w, asq_w, chol_e, mode_e, asq_e,
              red_U, red_S) = aux
             out = (x, b)
             k = jr.split(key, 6)
             if len(cm.idx.white) and nw:
-                r2 = residual_sq(cm, b)
+                # the cached u = T b makes the white residual free
+                r = jnp.asarray(cm.y) - u
+                r2 = r * r
                 x, _ = parallel_cov_mh_scan(
                     cm, x, k[0], white_ll_rel(cm, x, r2), cm.white_par_ix,
                     cm.white_nper, chol_w, nw, record=False,
@@ -1040,8 +1155,15 @@ class JaxGibbsDriver:
                                  self.red_steps)
             if cm.K and len(cm.rho_ix_x):
                 x = rho_update(cm, x, b, k[3])
-            b = draw_b_fn(cm, x, k[4])
-            return (x, b), out
+            if cm.orf_name != "crn":
+                b = draw_b_joint(cm, x, k[4])
+                u = b_matvec(cm, b)
+            elif bdraw == "mh":
+                b, u, _ = draw_b_mh(cm, x, b, u, k[4])
+            else:
+                b = draw_b_fn(cm, x, k[4])
+                u = b_matvec(cm, b)
+            return (x, b, u), out
 
         return body
 
@@ -1053,13 +1175,14 @@ class JaxGibbsDriver:
         a transient corner (huge prior-drawn rho -> b interpolates the data
         -> white noise pinned at the prior floor); warming up first makes
         the measured covariances and ACT describe the stationary region."""
+        import jax
         import jax.random as jr
 
         cm = self.cm
         nw = self.warmup_white_steps
 
-        def body(carry, key, aux):
-            x, b = carry
+        def body(carry, key, aux, t):
+            x, b, u = carry
             out = (x, b)
             k = jr.split(key, 6)
             if len(cm.idx.white):
@@ -1068,7 +1191,8 @@ class JaxGibbsDriver:
                 # small next to the b-draw for the W<=2 blocks) so the white
                 # block actually travels toward the typical set instead of
                 # freezing under prior-width single-site jumps
-                r2 = residual_sq(cm, b)
+                r = jax.numpy.asarray(cm.y) - u
+                r2 = r * r
                 _, chol, _ = laplace_newton_chol(
                     cm, x, lambda q: lnlike_white_per(cm, q, r2),
                     cm.white_par_ix, cm.white_nper, newton_iters=0)
@@ -1093,7 +1217,8 @@ class JaxGibbsDriver:
             if cm.K and len(cm.rho_ix_x):
                 x = rho_update(cm, x, b, k[3])
             b = draw_b_fn(cm, x, k[4])
-            return (x, b), out
+            u = b_matvec(cm, b)
+            return (x, b, u), out
 
         return body
 
@@ -1108,22 +1233,43 @@ class JaxGibbsDriver:
         process, which makes resume bitwise-exact (fixing the reference's
         lost-adaptation resume bug class, SURVEY §5).  ``aux`` (per-chain
         proposal state) is an explicit argument so cached chunk functions
-        never bake in stale adaptation."""
+        never bake in stale adaptation.  The cached matvec ``u = T b`` is
+        a pure function of ``b``, recomputed at chunk entry and carried
+        within the scan — chunk boundaries cannot change it either."""
         import jax
         import jax.numpy as jnp
         import jax.random as jr
 
+        cm = self.cm
         chains = jnp.arange(self.C)
-        vbody = jax.vmap(body, in_axes=(0, 0, 0))
+        if isinstance(body, tuple):
+            body_main, body_exact = body
+        else:
+            body_main, body_exact = body, None
+        vbody = jax.vmap(body_main, in_axes=(0, 0, 0, None))
+        vexact = (None if body_exact is None
+                  else jax.vmap(body_exact, in_axes=(0, 0, 0, None)))
 
         def run_chunk(x, b, base_key, it0, aux):
+            u = jax.vmap(lambda b1: b_matvec(cm, b1))(b)
+
             def step(carry, t):
                 kt = jr.fold_in(base_key, t)
                 keys = jax.vmap(lambda c: jr.fold_in(kt, c))(chains)
-                return vbody(carry, keys, aux)
+                if vexact is None:
+                    return vbody(carry, keys, aux, t)
+                # iteration-level branch: the predicate is uniform across
+                # chains, so cond picks ONE compiled body per sweep (a
+                # cond inside the vmapped body would become select and
+                # run both b-draws every sweep)
+                return jax.lax.cond(
+                    t % EXACT_EVERY == 0,
+                    lambda c: vexact(c, keys, aux, t),
+                    lambda c: vbody(c, keys, aux, t),
+                    carry)
 
-            (x, b), (xs, bs) = jax.lax.scan(step, (x, b),
-                                            it0 + jnp.arange(n))
+            (x, b, u), (xs, bs) = jax.lax.scan(step, (x, b, u),
+                                               it0 + jnp.arange(n))
             return x, b, xs, bs
 
         return jax.jit(run_chunk)
@@ -1136,7 +1282,14 @@ class JaxGibbsDriver:
 
     def _chunk_fn(self, n):
         if n not in self._sweep_fns:
-            self._sweep_fns[n] = self._make_chunk(self._sweep_body(), n)
+            if self.cm.orf_name != "crn":
+                # correlated ORF: both bdraw variants reduce to the joint
+                # draw — a body pair would trace the large joint program
+                # twice into one executable for nothing
+                bodies = self._sweep_body("exact")
+            else:
+                bodies = (self._sweep_body("mh"), self._sweep_body("exact"))
+            self._sweep_fns[n] = self._make_chunk(bodies, n)
         return self._sweep_fns[n]
 
     # ---- facade protocol ----------------------------------------------------

@@ -106,6 +106,35 @@ def test_chrom_and_gequad_build_and_sample(dm_psr, tmp_path):
         assert np.std(c[30:, igeq]) > 1e-3
 
 
+def test_dm_annual_marginalized(dm_psr, tmp_path):
+    """dm_annual adds two nu^-2 sin/cos columns at 1/yr, marginalized
+    like timing columns (no new sampled parameters)."""
+    pta = model_general([dm_psr], tm_svd=True, red_var=False,
+                        white_vary=False, common_psd="spectrum",
+                        common_components=5, dm_annual=True)
+    base = model_general([dm_psr], tm_svd=True, red_var=False,
+                         white_vary=False, common_psd="spectrum",
+                         common_components=5)
+    assert pta.param_names == base.param_names      # no new parameters
+    m = pta.model(0)
+    ann = next(s for s in m.signals if s.name == "dm_annual")
+    T = ann.get_basis()
+    assert T.shape == (dm_psr.ntoa, 2)
+    w = 2 * np.pi / (365.25 * 86400.0)
+    scale = (1400.0 / dm_psr.freqs) ** 2
+    np.testing.assert_allclose(T[:, 0], np.sin(w * dm_psr.toas) * scale,
+                               rtol=1e-12)
+    # marginalized: infinite prior variance, counted in the basis width
+    assert pta.get_phi(pta.map_params(pta.initial_sample(
+        np.random.default_rng(0))))[0].shape[0] == \
+        base.get_basis()[0].shape[1] + 2
+    g = PulsarBlockGibbs(pta, backend="jax", seed=51, progress=False,
+                         white_adapt_iters=100)
+    c = g.sample(pta.initial_sample(np.random.default_rng(3)),
+                 outdir=str(tmp_path / "ann"), niter=120)
+    assert np.all(np.isfinite(c))
+
+
 def test_hyper_conditional_matches_oracle_unequal_modes(j1713):
     """The red-hyper conditional must agree between backends even when
     red_components > common_components: the red-only tail frequencies

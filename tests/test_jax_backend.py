@@ -474,6 +474,42 @@ def test_graft_entry_single_and_multichip():
     mod.dryrun_multichip(8)
 
 
+def test_draw_b_mh_acceptance_and_law(pta8):
+    """The Metropolised b-draw must accept most proposals (the f32
+    proposal is a near-perfect approximation of the conditional) and,
+    composed with the periodic exact draw, reproduce the exact draw's law
+    at a fixed state."""
+    import jax
+    import jax.numpy as jnp
+    import jax.random as jr
+
+    cm = compile_pta(pta8)
+    x = jnp.asarray(pta8.initial_sample(np.random.default_rng(2)),
+                    cm.cdtype)
+    b = jb.draw_b_fn(cm, x, jr.key(0))
+    u = jb.b_matvec(cm, b)
+    f = jax.jit(lambda b, u, k: jb.draw_b_mh(cm, x, b, u, k))
+    accs = []
+    for i in range(60):
+        b, u, acc = f(b, u, jr.key(i + 1))
+        accs.append(np.asarray(acc)[np.asarray(cm.psr_mask) > 0])
+    rate = np.mean(accs)
+    assert rate > 0.7, rate
+    # law check: long alternating MH chain vs fresh exact draws, KS on a
+    # few representative coefficients of pulsar 0
+    chain, exact = [], []
+    for i in range(400):
+        b, u, _ = f(b, u, jr.key(1000 + i))
+        if i % 8 == 0:      # periodic exact refresh, as the sweep body does
+            b = jb.draw_b_fn(cm, x, jr.key(5000 + i))
+            u = jb.b_matvec(cm, b)
+        chain.append(np.asarray(b)[0, :6])
+        exact.append(np.asarray(jb.draw_b_fn(cm, x, jr.key(9000 + i)))[0, :6])
+    chain, exact = np.asarray(chain), np.asarray(exact)
+    pv = [stats.ks_2samp(chain[::4, j], exact[:, j]).pvalue for j in range(6)]
+    assert min(pv) > 1e-4, pv
+
+
 def test_draw_b_conditional_accuracy(pta8):
     """The b-draw's conditional mean and (gw-column) variances must match
     the f64 oracle to ~1e-5 of the posterior sd at prior-typical states —
