@@ -40,8 +40,8 @@ from ..data.dataset import get_tspan
 from .priors import Constant, LinearExp, Uniform
 from .selections import SELECTIONS
 from .pta import PTA, SignalModel
-from .signals import (EcorrBasisSignal, FourierGPSignal, TimingModelSignal,
-                      WhiteNoiseSignal)
+from .signals import (DMAnnualSignal, EcorrBasisSignal, FourierGPSignal,
+                      TimingModelSignal, WhiteNoiseSignal)
 
 _PSD_HYPERS = {
     "powerlaw": ("log10_A", "gamma"),
@@ -188,8 +188,6 @@ def model_general(psrs, tm_svd=False, tm_norm=True, noisedict=None,
             sigs.append(chrom_gp("chrom_gp", chrom_psd, chrom_components,
                                  chrom_idx))
         if dm_annual:
-            from .signals import DMAnnualSignal
-
             sigs.append(DMAnnualSignal(psr.toas, psr.freqs))
 
         # ---- white noise -------------------------------------------------

@@ -742,15 +742,10 @@ def compile_pta(pta, pad_pulsars: int | None = None) -> CompiledPTA:
             raise NotImplementedError(
                 "correlated ORF requires a homogeneous common mode count "
                 f"across pulsars (got {sorted(ksets)})")
-        if P * Bmax > 1024:
-            raise NotImplementedError(
-                f"correlated-ORF joint b-draw assembles a dense "
-                f"{P * Bmax}x{P * Bmax} system; supported up to 1024 "
-                "total coefficients (beyond that the recursive factor's "
-                "XLA program becomes impractically large — measured to "
-                "break the remote-compile transport at dim 1665).  Use "
-                "orf='crn' for larger arrays, or split the array, until "
-                "the per-frequency structured factorization lands")
+        # no size gate: up to HD_DENSE_MAX total coefficients the sweep
+        # uses the dense joint draw; larger arrays switch to the
+        # sequential pulsar-wise conditional sweep (jax_backend.
+        # draw_b_hd_sequential), whose program size is O(Bmax^2)
         G = np.eye(P)
         G[:P_real, :P_real] = orf_matrix(
             orf_name, [m.pulsar.pos for m in models])

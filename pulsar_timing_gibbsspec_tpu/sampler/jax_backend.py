@@ -151,7 +151,7 @@ def lnlike_fullmarg_fn(cm: CompiledPTA, x, TNT, d):
         jnp.sum(d * expval, axis=-1) - logdet_sigma - logdet_phi)
 
 
-def draw_b_fn(cm: CompiledPTA, x, key):
+def draw_b_fn(cm: CompiledPTA, x, key, b=None):
     """b | everything: batched preconditioned-Cholesky Gaussian draw
     (reference ``update_b``, ``pulsar_gibbs.py:489-520``).
 
@@ -163,19 +163,100 @@ def draw_b_fn(cm: CompiledPTA, x, key):
     correctness keeps the f64-accumulated path.
 
     With a correlated ORF the per-pulsar draws are replaced by one joint
-    cross-pulsar Gaussian (:func:`draw_b_joint`).
+    cross-pulsar Gaussian (:func:`draw_b_joint`), or — past
+    ``HD_DENSE_MAX`` total coefficients — by the sequential pulsar-wise
+    conditional sweep starting from ``b`` (zeros if not given).
     """
+    import jax.numpy as jnp
     import jax.random as jr
 
     from ..ops.linalg import mvn_conditional_draw
 
     if cm.orf_name != "crn":
-        return draw_b_joint(cm, x, key)
+        if cm.P * cm.Bmax <= HD_DENSE_MAX:
+            return draw_b_joint(cm, x, key)
+        if b is None:
+            b = jnp.zeros((cm.P, cm.Bmax), cm.cdtype)
+        return draw_b_hd_sequential(cm, x, b, key)
     N = cm.ndiag_fast(x)
     TNT, d = tnt_d(cm, N)
     phi = cm.phi(x)
     z = jr.normal(key, (cm.P, cm.Bmax), dtype=cm.cdtype)
     b, _ = mvn_conditional_draw(TNT, 1.0 / phi, d, z)
+    return b
+
+
+def draw_b_hd_sequential(cm: CompiledPTA, x, b, key):
+    """Correlated-ORF b-draw as a sequential pulsar-wise Gibbs sweep —
+    the scalable alternative to :func:`draw_b_joint` (whose dense
+    ``(P Bmax)^2`` program is capped at 1024 coefficients).
+
+    The joint prior of the GW coefficients per (frequency, phase) group
+    is ``N(0, rho_k G)`` over pulsars; pulsar ``p``'s conditional given
+    the others is Gaussian with precision ``(G^-1)_pp / rho_k`` and mean
+    ``-(1/(G^-1)_pp) sum_{q != p} (G^-1)_pq a_qk``, so each pulsar's full
+    coefficient draw is the *standard per-pulsar system* with a modified
+    GW prior and a linear offset — one ``lax.scan`` over pulsars, each
+    step an exact conditional (a valid Gibbs sweep; it mixes the
+    cross-pulsar correlations over sweeps instead of within one).
+
+    The per-step factorization is XLA's native f64 Cholesky of a single
+    ``(Bmax, Bmax)`` system — the batched-vs-serial penalty does not
+    apply when the scan is already sequential.
+    """
+    import jax
+    import jax.numpy as jnp
+    import jax.random as jr
+
+    from ..ops.linalg import precond_cholesky, precond_sample, precond_solve
+
+    cdt = cm.cdtype
+    B, P, K = cm.Bmax, cm.P, cm.K
+    N = cm.ndiag_fast(x)
+    TNT, d = tnt_d(cm, N)                          # (P, B, B), (P, B)
+    phi = cm.phi(x)
+    pinv = 1.0 / phi                               # (P, B)
+    rows_p = jnp.arange(P)[:, None]
+    gw_cols = jnp.concatenate([cm.gw_sin_ix, cm.gw_cos_ix], axis=1)
+    pinv = pinv.at[rows_p, gw_cols].set(0.0, mode="drop")
+    rho = 10.0 ** (2.0 * jnp.asarray(x, cdt)[cm.rho_ix_x])       # (K,)
+    Ginv = jnp.asarray(cm.orf_Ginv, cdt)           # (P, P)
+    keys = jr.split(key, P)
+    eye = jnp.eye(B, dtype=cdt)
+    gsin = jnp.asarray(cm.gw_sin_ix)
+    gcos = jnp.asarray(cm.gw_cos_ix)
+    live_mask = jnp.asarray(cm.psr_mask, cdt)
+
+    def gather_a(b):
+        """(P, K, 2) GW coefficients from the padded b array."""
+        a_s = jnp.take_along_axis(b, gsin, axis=1)
+        a_c = jnp.take_along_axis(b, gcos, axis=1)
+        return jnp.stack([a_s, a_c], axis=-1)
+
+    def step(b, p):
+        a = gather_a(b) * live_mask[:, None, None]
+        g_row = Ginv[p]                            # (P,)
+        gpp = g_row[p]
+        # conditional prior precision on p's gw cols and its linear term
+        prior_prec = gpp / rho                     # (K,)
+        cross = (jnp.einsum("q,qkf->kf", g_row, a)
+                 - gpp * a[p]) / rho[:, None]      # (K, 2)
+        pin_p = pinv[p]
+        pin_p = pin_p.at[gsin[p]].set(prior_prec, mode="drop")
+        pin_p = pin_p.at[gcos[p]].set(prior_prec, mode="drop")
+        d_p = d[p]
+        d_p = d_p.at[gsin[p]].add(-cross[:, 0], mode="drop")
+        d_p = d_p.at[gcos[p]].add(-cross[:, 1], mode="drop")
+        Sigma = TNT[p] + pin_p[:, None] * eye
+        L, dj = precond_cholesky(Sigma)
+        mean = precond_solve(L, dj, d_p)
+        z = jr.normal(keys[p], (B,), cdt)
+        bp = precond_sample(L, dj, mean, z)
+        # pad pulsars keep their inert coords; real rows update
+        b = b.at[p].set(jnp.where(live_mask[p] > 0, bp, b[p]))
+        return b, None
+
+    b, _ = jax.lax.scan(step, b, jnp.arange(P))
     return b
 
 
@@ -679,6 +760,12 @@ def red_conditional_update(cm: CompiledPTA, x, b, key):
 #: Metropolised f32-proposal draw, bounding how long an occasional
 #: ill-conditioned proposal can leave a pulsar's coefficients unmoved
 EXACT_EVERY = 8
+#: correlated-ORF arrays up to this many total coefficients use the
+#: dense joint b-draw (best mixing: one exact draw of everything);
+#: larger arrays use the sequential pulsar-wise conditional sweep —
+#: the dense recursive factor's XLA program grows ~O((P Bmax)^2) and
+#: was measured to break the remote-compile transport at dim 1665
+HD_DENSE_MAX = 1024
 #: diagonal ridge on the f32-preconditioned proposal system: larger than
 #: the f32 entry rounding of the unit-diagonal matrix so its Cholesky
 #: cannot break down, small enough to barely touch the proposal shape
@@ -882,8 +969,12 @@ class JaxGibbsDriver:
         self.b = np.zeros((self.C, cm.P, cm.Bmax), dtype=cm.cdtype)
         self._sweep_fns = {}
 
-        self._jit_draw_b = jax.jit(
-            jax.vmap(lambda x, k: draw_b_fn(cm, x, k)))
+        # b passed through so large correlated-ORF models can take the
+        # sequential conditional path (a no-op for the others)
+        self._jit_draw_b_b = jax.jit(
+            jax.vmap(lambda x, k, b: draw_b_fn(cm, x, k, b)))
+        self._jit_draw_b = lambda x, keys: self._jit_draw_b_b(
+            x, keys, jax.numpy.asarray(self.b))
 
     # ---- adaptation (first sweep) ------------------------------------------
 
@@ -1156,7 +1247,7 @@ class JaxGibbsDriver:
             if cm.K and len(cm.rho_ix_x):
                 x = rho_update(cm, x, b, k[3])
             if cm.orf_name != "crn":
-                b = draw_b_joint(cm, x, k[4])
+                b = draw_b_fn(cm, x, k[4], b)    # joint or sequential HD
                 u = b_matvec(cm, b)
             elif bdraw == "mh":
                 b, u, _ = draw_b_mh(cm, x, b, u, k[4])
@@ -1216,7 +1307,10 @@ class JaxGibbsDriver:
                                cm.idx.red, self.red_steps)
             if cm.K and len(cm.rho_ix_x):
                 x = rho_update(cm, x, b, k[3])
-            b = draw_b_fn(cm, x, k[4])
+            # pass the carried b: the sequential HD path conditions each
+            # pulsar on the others' CURRENT coefficients (restarting from
+            # zeros would sample a shrunken, decorrelated conditional)
+            b = draw_b_fn(cm, x, k[4], b)
             u = b_matvec(cm, b)
             return (x, b, u), out
 
