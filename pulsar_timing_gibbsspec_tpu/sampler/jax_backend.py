@@ -1025,6 +1025,10 @@ class JaxGibbsDriver:
 
         self.key, k = jr.split(self.key)
         b = self._jit_draw_b(x, self._chain_keys(k))
+        # keep self.b current: the sequential HD path conditions each
+        # pulsar on the others' coefficients via self.b, and the final
+        # draw below must not see the stale warmup-end state
+        self.b = b
 
         if len(cm.idx.white):
             # Laplace proposals at the conditional mode (replaces the
