@@ -1,0 +1,66 @@
+"""Hellings-Downs PTA free-spectrum sampling — beyond the reference.
+
+The reference's model factory can build Hellings-Downs-correlated common
+processes (``model_definition.py:198-216``) but its experimental PTA
+sampler only ever handles the uncorrelated-CRN case
+(``pta_gibbs.py:533`` assumes a block-diagonal phi).  This framework
+samples the correlated model exactly: a joint cross-pulsar b-draw (dense
+for small arrays, sequential pulsar-wise past 1024 coefficients) and the
+quadratic-form rho_k conditional ``p(rho | a) ~ rho^-P exp(-taut/rho)``
+with ``taut = 0.5 sum_phase a^T G^-1 a``.
+
+Runs in ~3 min on CPU:  ``python examples/hd_pta_demo.py``
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+REFDATA = os.environ.get("PTGIBBS_REFDATA", "/root/reference/simulated_data")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--niter", type=int, default=1200)
+    ap.add_argument("--npsr", type=int, default=6)
+    ap.add_argument("--nbins", type=int, default=5)
+    ap.add_argument("--backend", default="jax", choices=["jax", "numpy"])
+    args = ap.parse_args()
+
+    from pulsar_timing_gibbsspec_tpu import model_general
+    from pulsar_timing_gibbsspec_tpu.data import load_directory
+    from pulsar_timing_gibbsspec_tpu.models.orf import hd
+    from pulsar_timing_gibbsspec_tpu.sampler.blocks import BlockIndex
+    from pulsar_timing_gibbsspec_tpu.sampler.gibbs import PTABlockGibbs
+
+    psrs = load_directory(
+        REFDATA, inject=dict(log10_A=np.log10(2e-15), gamma=13.0 / 3.0))
+    psrs = psrs[:args.npsr]
+    print(f"{len(psrs)} pulsars; HD correlation range over pairs: "
+          f"[{min(hd(a.pos, b.pos) for i, a in enumerate(psrs) for b in psrs[i+1:]):.2f}, "
+          f"{max(hd(a.pos, b.pos) for i, a in enumerate(psrs) for b in psrs[i+1:]):.2f}]")
+
+    pta = model_general(psrs, tm_svd=True, red_var=False, white_vary=False,
+                        common_psd="spectrum", common_components=args.nbins,
+                        orf="hd")
+    gibbs = PTABlockGibbs(pta, backend=args.backend, seed=0)
+    x0 = gibbs.initial_sample(np.random.default_rng(0))
+    chain = gibbs.sample(x0, outdir="./chains_hd_demo", niter=args.niter)
+
+    burn = args.niter // 5
+    idx = BlockIndex.build(pta.param_names)
+    print(f"\nHD common free spectrum ({args.niter - burn} post-burn "
+          f"samples):")
+    print(f"{'bin':>4s} {'median':>9s} {'16%':>9s} {'84%':>9s}")
+    for j, k in enumerate(idx.rho):
+        q16, q50, q84 = np.quantile(chain[burn:, k], [0.16, 0.5, 0.84])
+        print(f"{j:4d} {q50:9.2f} {q16:9.2f} {q84:9.2f}")
+    print("\nchain files in ./chains_hd_demo/")
+
+
+if __name__ == "__main__":
+    main()
