@@ -5,7 +5,7 @@ processes (``model_definition.py:198-216``) but its experimental PTA
 sampler only ever handles the uncorrelated-CRN case
 (``pta_gibbs.py:533`` assumes a block-diagonal phi).  This framework
 samples the correlated model exactly: a joint cross-pulsar b-draw (dense
-for small arrays, sequential pulsar-wise past 1024 coefficients) and the
+for small arrays, sequential pulsar-wise past HD_DENSE_MAX (64) total coefficients) and the
 quadratic-form rho_k conditional ``p(rho | a) ~ rho^-P exp(-taut/rho)``
 with ``taut = 0.5 sum_phase a^T G^-1 a``.
 

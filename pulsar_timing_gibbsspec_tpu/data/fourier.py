@@ -17,7 +17,8 @@ DAY = 86400.0
 
 
 def fourier_basis(toas_mjd: np.ndarray, nmodes: int, Tspan: float,
-                  modes: np.ndarray | None = None):
+                  modes: np.ndarray | None = None,
+                  pshift_phases: np.ndarray | None = None):
     """Return ``(F, f)``: basis (n, 2*nmodes) and per-column frequencies.
 
     Parameters
@@ -26,6 +27,11 @@ def fourier_basis(toas_mjd: np.ndarray, nmodes: int, Tspan: float,
     nmodes : number of frequencies
     Tspan : span in seconds defining the fundamental ``1/Tspan``
     modes : optional explicit frequency list [Hz], overrides the linear grid
+    pshift_phases : optional per-frequency phase offsets [rad] added inside
+        the sin/cos arguments — the ``pshift`` random-phase-shift option of
+        the reference's ``model_general`` (``model_definition.py`` kwarg
+        ``pshift``, enterprise ``createfourierdesignmatrix_red``) used for
+        false-alarm / sky-scramble studies
     """
     t = toas_mjd * DAY
     if modes is None:
@@ -35,6 +41,8 @@ def fourier_basis(toas_mjd: np.ndarray, nmodes: int, Tspan: float,
         nmodes = len(f)
     F = np.zeros((len(t), 2 * nmodes))
     arg = 2.0 * np.pi * t[:, None] * f[None, :]
+    if pshift_phases is not None:
+        arg = arg + np.asarray(pshift_phases, dtype=np.float64)[None, :]
     F[:, ::2] = np.sin(arg)
     F[:, 1::2] = np.cos(arg)
     return F, np.repeat(f, 2)

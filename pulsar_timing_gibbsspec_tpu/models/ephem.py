@@ -1,0 +1,179 @@
+"""BayesEphem: solar-system-ephemeris error model as a marginalized basis.
+
+The reference's ``model_general(bayesephem=True, be_type=...)`` attaches
+enterprise's physical ephemeris model (``model_definition.py`` kwargs
+``bayesephem``/``be_type``): 11 sampled global parameters — a frame drift
+rate about the ecliptic pole, four outer-planet mass corrections, and six
+Jupiter orbital-element perturbations — whose induced Roemer-delay
+signatures are computed from JPL ephemeris partials shipped as data files.
+
+This framework re-derives the same delay subspace analytically from
+first-order celestial mechanics (no ephemeris files, which the build
+environment cannot fetch), and — instead of sampling the 11 amplitudes —
+**marginalizes** them as Gaussian basis coefficients in the b-draw, with
+prior scales matched to enterprise's priors (IAU mass uncertainties;
+uniform priors moment-matched to Gaussians of equal variance).
+
+Numerical form: every column is stored *sigma-scaled* — the delay partial
+multiplied by its prior standard deviation, so each marginalized
+coefficient has unit prior variance.  This is a pure reparameterization
+(the marginal covariance contribution ``T' T'^T = sum_k sigma_k^2 t_k
+t_k^T`` is identical) that keeps the b-draw's preconditioned system
+O(1)-conditioned: the raw parameterization spans ~22 decades between
+column norms and prior precisions, pushing the smallest preconditioned
+eigenvalue below float32 entry-rounding noise.
+
+Approximations, stated plainly:
+
+- Planet orbits are circular and coplanar (J2000 mean elements).  The
+  neglected eccentricities are <= 0.056 (Saturn); the induced basis-shape
+  error is at the few-percent level, far inside the prior width.
+- Jupiter orbital-element perturbations are represented by the six
+  first-order Keplerian patterns (radial offset, along-track offset,
+  along-track drift, two cross-track sinusoids, and the eccentricity
+  doublet) instead of the reference's numerically-tabulated setIII
+  partials; both span the same physical delay subspace.  Enterprise's
+  element parameters are expressed in the units of its partials tables
+  (prior +-0.05); here each pattern's prior is set to the ~100 ns induced
+  Roemer-delay scale — the DE421-vs-DE43x disagreement BayesEphem was
+  designed to span (Arzoumanian et al. 2018, arXiv:1801.02617 §4).
+- The 11 amplitudes are marginalized per pulsar rather than shared
+  across the array.  For single-pulsar analyses this is exact (and
+  Rao-Blackwellized vs the reference's sampling).  For multi-pulsar
+  models it is conservative — each pulsar may absorb its own ephemeris
+  error, an upper bound on the freedom the shared model allows.
+
+Delay sign convention: a solar-system-barycenter position error
+``dr`` displaces the Earth-to-SSB vector, changing the Roemer delay by
+``-(dr . n) / c`` with ``n`` the pulsar direction.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .signals import BasisSignal
+
+AU_SEC = 499.00478384  # 1 AU light-travel time [s]
+DAY = 86400.0
+YEAR = 365.25 * DAY
+MJD_J2000 = 51544.5
+OBLIQUITY = np.deg2rad(23.439291111)
+
+#: circular-orbit J2000 mean elements: semi-major axis [AU], sidereal
+#: period [days], mean longitude at J2000 [deg]  (JPL approximate elements)
+PLANETS = {
+    "jupiter": (5.20288700, 4332.589, 34.39644),
+    "saturn": (9.53667594, 10759.22, 49.95424),
+    "uranus": (19.18916464, 30685.4, 313.23810),
+    "neptune": (30.06992276, 60189.0, -55.12003),
+}
+EARTH = (1.00000261, 365.256, 100.46457)
+
+#: IAU mass-parameter uncertainties [solar masses] — the Normal prior
+#: sigmas enterprise's physical ephemeris model uses for d_*_mass
+MASS_SIGMA = {
+    "jupiter": 1.54976690e-11,
+    "saturn": 8.17306184e-12,
+    "uranus": 5.71923361e-11,
+    "neptune": 7.96103855e-11,
+}
+
+#: enterprise frame_drift_rate prior half-width [rad/yr], moment-matched
+#: to a Gaussian of variance w^2/3
+FRAME_DRIFT_HALFWIDTH = 1e-9
+
+#: 1-sigma induced Roemer delay per Jupiter orbital-element pattern [s]
+#: (inter-ephemeris disagreement scale, see module docstring)
+ORB_ELEMENT_DELAY_SIGMA = 1e-7
+
+BE_TYPES = ("orbel", "orbel-v2", "setIII", "setIII_1980")
+
+
+def _ecl_to_eq(v):
+    """Rotate ecliptic-frame vectors (..., 3) to the equatorial frame."""
+    ce, se = np.cos(OBLIQUITY), np.sin(OBLIQUITY)
+    x, y, z = v[..., 0], v[..., 1], v[..., 2]
+    return np.stack([x, ce * y - se * z, se * y + ce * z], axis=-1)
+
+
+def _orbit(toas_sec, elements):
+    """Circular-orbit position [AU, equatorial] and mean longitude vs time."""
+    a, period_d, L0_deg = elements
+    t_days = toas_sec / DAY - MJD_J2000
+    L = np.deg2rad(L0_deg) + 2.0 * np.pi * t_days / period_d
+    r_ecl = np.stack([a * np.cos(L), a * np.sin(L), np.zeros_like(L)], axis=-1)
+    return _ecl_to_eq(r_ecl), L
+
+
+class BayesEphemSignal(BasisSignal):
+    """Marginalized physical ephemeris-error basis (11 columns).
+
+    Columns are sigma-scaled Roemer-delay partials [s] (unit prior
+    variance per coefficient); ``get_phi`` returns ones.  See the module
+    docstring for the scaling rationale and approximations.
+    """
+
+    name = "bayesephem"
+
+    def __init__(self, toas_sec: np.ndarray, pos: np.ndarray,
+                 be_type: str = "setIII_1980"):
+        if be_type not in BE_TYPES:
+            raise ValueError(f"be_type={be_type!r}; known: {BE_TYPES}")
+        if not np.isfinite(pos).all() or np.linalg.norm(pos) < 0.5:
+            raise ValueError(
+                "bayesephem requires a usable pulsar sky position (par file "
+                "lacked ELONG/ELAT and RAJ/DECJ)")
+        self.be_type = be_type
+        self.params = []
+        n = np.asarray(pos, dtype=np.float64)
+        t_yr = (toas_sec / DAY - MJD_J2000) * DAY / YEAR
+
+        cols = []
+
+        # frame drift: rotation of the frame about the ecliptic pole at
+        # rate w [rad/yr]; Earth position error w*t * (z_ecl x r_E)
+        r_earth, _ = _orbit(toas_sec, EARTH)
+        z_ecl = _ecl_to_eq(np.array([0.0, 0.0, 1.0]))
+        zxr = np.cross(np.broadcast_to(z_ecl, r_earth.shape), r_earth)
+        frame_sigma = FRAME_DRIFT_HALFWIDTH / np.sqrt(3.0)
+        cols.append(-(zxr @ n) * t_yr * AU_SEC * frame_sigma)
+
+        # outer-planet mass errors: dm shifts the SSB by dm * r_p, so the
+        # Earth-to-SSB vector changes by -dm * r_p
+        for planet in ("jupiter", "saturn", "uranus", "neptune"):
+            r_p, _ = _orbit(toas_sec, PLANETS[planet])
+            cols.append((r_p @ n) * AU_SEC * MASS_SIGMA[planet])
+
+        # Jupiter orbital elements (all four be_type flavors carry them):
+        # first-order Keplerian perturbation patterns, each normalized to
+        # the ORB_ELEMENT_DELAY_SIGMA prior scale
+        a_J, period_d, _ = PLANETS["jupiter"]
+        r_J, L = _orbit(toas_sec, PLANETS["jupiter"])
+        rhat = r_J / a_J
+        # along-track unit vector: dr/dL normalized (equatorial)
+        that = _ecl_to_eq(np.stack([-np.sin(L), np.cos(L),
+                                    np.zeros_like(L)], axis=-1))
+        zhat = np.broadcast_to(_ecl_to_eq(np.array([0.0, 0.0, 1.0])),
+                               r_J.shape)
+        nt = 2.0 * np.pi * (toas_sec / DAY - MJD_J2000) / period_d
+        nt = nt - nt.mean()           # center the secular drift pattern
+        patterns = [
+            rhat,                                  # da: radial offset
+            that,                                  # dM0/domega: along
+            that * nt[:, None],                    # da: secular drift
+            zhat * np.sin(L)[:, None],             # di
+            zhat * np.cos(L)[:, None],             # dOmega (cross part)
+            (-rhat * np.cos(L)[:, None]
+             + 2.0 * that * np.sin(L)[:, None]),   # de doublet
+        ]
+        for pat in patterns:
+            cols.append((pat @ n) * ORB_ELEMENT_DELAY_SIGMA)
+
+        self._T = np.column_stack(cols)
+
+    def get_basis(self):
+        return self._T
+
+    def get_phi(self, params):
+        return np.ones(self._T.shape[1])

@@ -2,34 +2,49 @@
 
 Mirrors the configuration surface of the reference's ``model_general``
 (``model_definition.py:18-236``) — the de-facto config schema of the whole
-stack (SURVEY §5).  Supported here natively:
+stack (SURVEY §5) — with the reference's exact kwarg names.  Supported
+natively:
 
-- linear timing model with ``tm_svd`` / ``tm_norm``
+- linear timing model with ``tm_svd`` / ``tm_norm`` / ``tm_marg``
 - common red-noise block(s): ``common_psd`` in {powerlaw, spectrum,
   turnover, turnover_knee, broken_powerlaw}, multiple comma-separated ORFs
   (``orf``/``orf_names``), fixed or varied amplitude/index, custom rho
-  bounds (``common_logmin/logmax``), ``common_components``
-- per-pulsar intrinsic red noise: ``red_var``, ``red_psd`` (powerlaw or
-  spectrum), ``red_components`` — note the reference's committed
+  bounds (``common_logmin/logmax``), ``common_components``, random phase
+  shifts (``pshift``/``pseed``), custom bin weights (``wgts``)
+- per-pulsar intrinsic red noise: ``red_var``, ``red_psd`` (powerlaw,
+  spectrum, or infinitepower), ``red_components``, band/backend-split red
+  processes (``red_select``), flattened high-frequency spectrum
+  (``red_breakflat``/``red_breakflat_fq``) — note the reference's committed
   ``model_general`` accepts these kwargs but never adds the block (its
   notebooks hand-build it); here the advertised behavior is implemented
 - white noise: ``white_vary``, per-backend EFAC/EQUAD via
   ``select='backend'``, fixed values via ``noisedict``, global EQUAD via
-  ``gequad``
+  ``gequad``; ``is_wideband`` excludes ECORR exactly as the reference does
 - chromatic GPs: ``dm_var`` (nu^-2 dispersion-measure GP) and ``dm_chrom``
-  (nu^-chrom_idx scattering GP), powerlaw PSDs, own basis columns;
+  (nu^-dmchrom_idx scattering GP), powerlaw PSDs, own basis columns;
   ``dm_annual`` as a *marginalized* linearized annual DM sinusoid (two
   nu^-2 sin/cos columns with improper prior — the same 2-d subspace the
   reference's sampled amplitude/phase parameterizes, with no extra
   sampling block)
+- ``bayesephem``/``be_type``: physical solar-system-ephemeris error model
+  as a marginalized 11-column basis (see ``models/ephem.py`` for the
+  documented approximations vs enterprise's file-based partials)
 - ECORR (basis) for pulsars carrying a NANOGrav pta flag, as in
   ``model_definition.py:221-223``
 - ``Tspan``/``modes``/``logfreq`` frequency-grid control, upper-limit
-  (LinearExp) amplitude priors
+  (LinearExp) amplitude priors per process class (``upper_limit``,
+  ``upper_limit_common/red/dm``)
 
-Unsupported reference kwargs (BayesEphem, wideband, t-process PSDs, band
-selections) raise ``NotImplementedError`` loudly rather than silently
-no-op.
+``coefficients`` and ``dense_like`` are accepted: the Gibbs scheme always
+samples the latent coefficients explicitly (``bchain``) while conditionals
+use marginalized forms, and all device factorizations are dense Cholesky —
+the flags select between representations this framework already provides
+simultaneously.  ``tm_var``/``tm_linear`` raise ``NotImplementedError``
+loudly (the reference's committed body leaves its signal model undefined
+when ``tm_var=True`` — ``model_definition.py:185-190`` only assigns ``s``
+in the ``not tm_var`` branch — so no working reference behavior exists to
+match); so do ``use_dmdata`` (requires wideband DM data this ingestion
+layer does not model) and the t-process PSDs.
 """
 
 from __future__ import annotations
@@ -37,6 +52,7 @@ from __future__ import annotations
 import numpy as np
 
 from ..data.dataset import get_tspan
+from .ephem import BayesEphemSignal
 from .priors import Constant, LinearExp, Uniform
 from .selections import SELECTIONS
 from .pta import PTA, SignalModel
@@ -50,19 +66,15 @@ _PSD_HYPERS = {
     "broken_powerlaw": ("log10_A", "gamma", "delta", "log10_fb", "kappa"),
 }
 
-
-def _reject_unsupported(kw: dict):
-    unsupported = {
-        "tm_var": False, "tm_linear": False, "tmparam_list": None,
-        "bayesephem": False, "is_wideband": False, "use_dmdata": False,
-        "coefficients": False, "red_select": None,
-        "red_breakflat": False, "pshift": False,
-    }
-    for key, default in unsupported.items():
-        if kw.pop(key, default) not in (default, None):
-            raise NotImplementedError(
-                f"model_general option '{key}' is not implemented in the TPU "
-                f"framework yet (reference model_definition.py accepts it)")
+#: red_select band edges [MHz].  The reference delegates to enterprise
+#: selections keyed on observing-system flags; the simulated datasets carry
+#: none, so bands are cut on radio frequency — the physical quantity the
+#: flag encodes ('band': below/above 1 GHz; 'band+': adds an L/S split).
+_BANDS = {
+    "band": (("low", 0.0, 1000.0), ("high", 1000.0, np.inf)),
+    "band+": (("low", 0.0, 1000.0), ("mid", 1000.0, 2000.0),
+              ("high", 2000.0, np.inf)),
+}
 
 
 def _log_grid(nmodes_lin, nmodes_log, Tspan):
@@ -74,34 +86,75 @@ def _log_grid(nmodes_lin, nmodes_log, Tspan):
     return np.concatenate([flog, flin])
 
 
-def model_general(psrs, tm_svd=False, tm_norm=True, noisedict=None,
-                  white_vary=False, Tspan=None, modes=None, logfreq=False,
-                  nmodes_log=10,
+def model_general(psrs, tm_var=False, tm_linear=False, tmparam_list=None,
+                  tm_svd=False, tm_norm=True, noisedict=None,
+                  white_vary=False, Tspan=None, modes=None, wgts=None,
+                  logfreq=False, nmodes_log=10,
                   common_psd="powerlaw", common_components=30,
                   log10_A_common=None, gamma_common=None,
                   common_logmin=None, common_logmax=None,
-                  orf="crn", orf_names=None,
+                  orf="crn", orf_names=None, orf_ifreq=0, leg_lmax=5,
                   upper_limit_common=None, upper_limit=False,
                   red_var=True, red_psd="powerlaw", red_components=30,
-                  upper_limit_red=None,
-                  dm_var=False, dm_psd="powerlaw", dm_components=30,
+                  upper_limit_red=None, red_select=None,
+                  red_breakflat=False, red_breakflat_fq=None,
+                  bayesephem=False, be_type="setIII_1980",
+                  is_wideband=False, use_dmdata=False,
+                  dm_var=False, dm_type="gp", dm_psd="powerlaw",
+                  dm_components=30, upper_limit_dm=None,
                   dm_annual=False,
-                  dm_chrom=False, chrom_psd="powerlaw", chrom_components=30,
-                  chrom_idx=4.0, gequad=False,
-                  select="backend", **extra) -> PTA:
+                  dm_chrom=False, dmchrom_psd="powerlaw", dmchrom_idx=4,
+                  gequad=False, coefficients=False, pshift=False, pseed=None,
+                  select="backend", tm_marg=False, dense_like=False,
+                  **extra) -> PTA:
     """Build a PTA model over ``data.Pulsar`` objects.  See module docstring
-    for the supported subset; returns a :class:`~..models.pta.PTA`."""
-    _reject_unsupported(extra)
+    for the supported surface; returns a :class:`~..models.pta.PTA`."""
     if extra:
         raise TypeError(f"unknown model_general option(s): {sorted(extra)}")
+    if tm_var or tm_linear or tmparam_list is not None:
+        raise NotImplementedError(
+            "tm_var/tm_linear: the reference's committed model_general "
+            "never assigns a timing-model signal when tm_var=True "
+            "(model_definition.py:185-190, NameError at PTA assembly), so "
+            "there is no working behavior to match; the linear timing "
+            "model here is always marginalized exactly in the b-draw")
+    if use_dmdata:
+        raise NotImplementedError(
+            "use_dmdata requires wideband DM measurements "
+            "(WidebandTimingModel); the par/tim ingestion layer models "
+            "narrowband TOAs only")
+    if dm_type != "gp":
+        raise NotImplementedError(
+            f"dm_type={dm_type!r}: only the Gaussian-process DM model is "
+            "implemented (the reference's other choices route through "
+            "additional enterprise options it never exercises)")
+    if red_psd in ("tprocess", "tprocess_adapt"):
+        raise NotImplementedError(
+            f"red_psd={red_psd!r}: t-process PSDs are not implemented yet")
+    if red_breakflat and red_breakflat_fq is None:
+        raise ValueError("red_breakflat=True requires red_breakflat_fq [Hz]")
+    # coefficients / dense_like / tm_marg: accepted — see module docstring
+    # (the Gibbs sampler explicitly samples coefficients AND uses dense
+    # Cholesky factorizations regardless; the timing model is always
+    # analytically marginalized, which is what tm_marg selects)
+    del coefficients, dense_like, tm_marg
 
     psrs = list(psrs)
     if Tspan is None:
         Tspan = get_tspan(psrs)
 
+    # reference semantics (model_definition.py:173-181): with no per-class
+    # flag set every class follows the global upper_limit; once ANY
+    # per-class flag is given, each class is uniform only under its own
+    # flag and log-uniform otherwise
     amp_prior = "uniform" if upper_limit else "log-uniform"
-    amp_prior_common = "uniform" if upper_limit_common else amp_prior
-    amp_prior_red = "uniform" if upper_limit_red else amp_prior
+    if all(v is None for v in (upper_limit_red, upper_limit_dm,
+                               upper_limit_common)):
+        amp_prior_red = amp_prior_dm = amp_prior_common = amp_prior
+    else:
+        amp_prior_common = "uniform" if upper_limit_common else "log-uniform"
+        amp_prior_red = "uniform" if upper_limit_red else "log-uniform"
+        amp_prior_dm = "uniform" if upper_limit_dm else "log-uniform"
 
     # ---- common process hyperparameters (shared across pulsars) ----------
     orf_list = orf.split(",")
@@ -140,41 +193,103 @@ def model_general(psrs, tm_svd=False, tm_norm=True, noisedict=None,
     for psr in psrs:
         sigs = [TimingModelSignal(psr.Mmat, use_svd=tm_svd, normed=tm_norm)]
 
+        # pshift: deterministic per-pulsar random phases on the shared
+        # Fourier grid (sky-scramble / false-alarm studies, the reference's
+        # pshift/pseed kwargs).  One seed per PULSAR, applied to every
+        # shared-grid signal alike: GW and intrinsic red share basis
+        # columns (the reference sampler's own convention,
+        # pulsar_gibbs.py:101-102), so a GW-only shift would be silently
+        # discarded whenever the red process donates the wider basis.
+        # Cross-pulsar decorrelation of the common process — the point of
+        # the scramble — is preserved.  crc32 (not hash()) so phases are
+        # stable across interpreter runs.
+        shift_seed = None
+        if pshift:
+            import zlib
+
+            shift_seed = zlib.crc32(repr((pseed or 0, psr.name)).encode())
+
         for orf_nm, orf_el, ps in zip(orf_name_list, orf_list, common_param_sets):
             sigs.append(FourierGPSignal(
                 psr.toas / 86400.0, common_components, Tspan,
                 psd_name=common_psd, psd_params=ps, name=f"gw_{orf_nm}",
-                modes=grid, orf_name=orf_el))
+                modes=grid, orf_name=orf_el, orf_ifreq=orf_ifreq,
+                leg_lmax=leg_lmax, pshift_seed=shift_seed, wgts=wgts))
 
         if red_var:
-            rname = f"{psr.name}_red_noise"
-            if red_psd == "spectrum":
-                rps = [Uniform(-10.0, -4.0, name=f"{rname}_log10_rho",
-                               size=red_components)]
-            elif red_psd in _PSD_HYPERS:
-                amp_cls = LinearExp if amp_prior_red == "uniform" else Uniform
-                rps = [amp_cls(-20.0, -11.0, name=f"{rname}_log10_A"),
-                       Uniform(0.0, 7.0, name=f"{rname}_gamma")]
-                for hyper in _PSD_HYPERS[red_psd][2:]:
-                    raise NotImplementedError(f"red_psd='{red_psd}'")
-            else:
+            red_name_psd = red_psd
+            red_extra_hypers = []
+            if red_breakflat:
+                if red_psd != "powerlaw":
+                    raise NotImplementedError(
+                        "red_breakflat applies to red_psd='powerlaw'")
+                red_name_psd = "powerlaw_breakflat"
+            if red_select is not None and red_psd not in _PSD_HYPERS:
+                raise NotImplementedError(
+                    "red_select requires a powerlaw-family red_psd (split "
+                    "free-spectrum blocks have no conditional sampler)")
+
+            def red_params(rname):
+                if red_psd == "spectrum":
+                    return [Uniform(-10.0, -4.0, name=f"{rname}_log10_rho",
+                                    size=red_components)]
+                if red_psd == "infinitepower":
+                    return []
+                if red_psd in _PSD_HYPERS:
+                    amp_cls = (LinearExp if amp_prior_red == "uniform"
+                               else Uniform)
+                    rps = [amp_cls(-20.0, -11.0, name=f"{rname}_log10_A"),
+                           Uniform(0.0, 7.0, name=f"{rname}_gamma")]
+                    if _PSD_HYPERS[red_psd][2:]:
+                        raise NotImplementedError(f"red_psd='{red_psd}'")
+                    if red_breakflat:
+                        rps.append(Constant(np.log10(red_breakflat_fq),
+                                            name=f"{rname}_log10_fb"))
+                    return rps
                 raise NotImplementedError(f"red_psd='{red_psd}'")
-            sigs.append(FourierGPSignal(
-                psr.toas / 86400.0, red_components, Tspan,
-                psd_name=red_psd, psd_params=rps, name=rname, modes=grid))
+
+            if red_select is None:
+                rname = f"{psr.name}_red_noise"
+                # same per-pulsar phase shift as the common process: the
+                # two share basis columns, so their shifts must agree
+                sigs.append(FourierGPSignal(
+                    psr.toas / 86400.0, red_components, Tspan,
+                    psd_name=red_name_psd, psd_params=red_params(rname),
+                    name=rname, modes=grid, wgts=wgts,
+                    pshift_seed=shift_seed))
+            else:
+                # split intrinsic red process, one GP per selection group
+                # (reference red_select: 'backend' | 'band' | 'band+');
+                # masked rows force own basis columns per group
+                if red_select in _BANDS:
+                    groups = {lab: (psr.freqs > lo) & (psr.freqs <= hi)
+                              for lab, lo, hi in _BANDS[red_select]}
+                elif red_select == "backend":
+                    groups = SELECTIONS["backend"](psr.backend_flags)
+                else:
+                    raise NotImplementedError(f"red_select={red_select!r}")
+                for lab in sorted(groups):
+                    mask = np.asarray(groups[lab], dtype=bool)
+                    if not mask.any():
+                        continue
+                    rname = f"{psr.name}_red_noise_{lab}"
+                    sigs.append(FourierGPSignal(
+                        psr.toas / 86400.0, red_components, Tspan,
+                        psd_name=red_name_psd, psd_params=red_params(rname),
+                        name=rname, modes=grid, row_mask=mask, wgts=wgts))
 
         # chromatic GPs (reference model_definition.py:19-31 via
         # enterprise's dm/chrom noise blocks; amplitudes referenced to
         # 1400 MHz): dm_var = nu^-2 dispersion measure, dm_chrom =
-        # nu^-chrom_idx scattering.  Own basis columns each.
-        def chrom_gp(suffix, psd, components, index):
+        # nu^-dmchrom_idx scattering.  Own basis columns each.
+        def chrom_gp(suffix, psd, components, index, prior):
             if psd != "powerlaw":
                 raise NotImplementedError(
                     f"{suffix} psd='{psd}': chromatic GPs currently "
                     "support the powerlaw PSD (their hypers join the "
                     "adaptive MH block)")
             cname = f"{psr.name}_{suffix}"
-            amp_cls = LinearExp if amp_prior == "uniform" else Uniform
+            amp_cls = LinearExp if prior == "uniform" else Uniform
             ps = [amp_cls(-20.0, -11.0, name=f"{cname}_log10_A"),
                   Uniform(0.0, 7.0, name=f"{cname}_gamma")]
             return FourierGPSignal(
@@ -183,12 +298,15 @@ def model_general(psrs, tm_svd=False, tm_norm=True, noisedict=None,
                 radio_freqs=psr.freqs, chrom_index=float(index))
 
         if dm_var:
-            sigs.append(chrom_gp("dm_gp", dm_psd, dm_components, 2.0))
+            sigs.append(chrom_gp("dm_gp", dm_psd, dm_components, 2.0,
+                                 amp_prior_dm))
         if dm_chrom:
-            sigs.append(chrom_gp("chrom_gp", chrom_psd, chrom_components,
-                                 chrom_idx))
+            sigs.append(chrom_gp("chrom_gp", dmchrom_psd, dm_components,
+                                 dmchrom_idx, amp_prior))
         if dm_annual:
             sigs.append(DMAnnualSignal(psr.toas, psr.freqs))
+        if bayesephem:
+            sigs.append(BayesEphemSignal(psr.toas, psr.pos, be_type=be_type))
 
         # ---- white noise -------------------------------------------------
         masks = SELECTIONS[select](psr.backend_flags)
@@ -218,9 +336,9 @@ def model_general(psrs, tm_svd=False, tm_norm=True, noisedict=None,
         white = WhiteNoiseSignal(psr.toaerrs, masks, efacs, equads,
                                  gequad=geq)
 
-        # basis ECORR only for NANOGrav-flagged pulsars, as the reference
-        # gates it (model_definition.py:221-223)
-        if "NANOGrav" in psr.flags.get("pta", ""):
+        # basis ECORR only for NANOGrav-flagged non-wideband pulsars, as
+        # the reference gates it (model_definition.py:221-228)
+        if "NANOGrav" in psr.flags.get("pta", "") and not is_wideband:
             sigs.append(EcorrBasisSignal(psr.toas, masks, ecorrs))
 
         m = SignalModel(psr, sigs, white)

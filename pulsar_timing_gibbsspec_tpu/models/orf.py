@@ -1,10 +1,26 @@
 """Overlap reduction functions (inter-pulsar correlation of common signals).
 
 The reference's ``model_general`` can build common processes with any of
-these ORFs (``model_definition.py:198-216``), though its experimental PTA
-sampler only ever exploits the block-diagonal CRN case (SURVEY §3.6).  Here
-the ORFs are first-class so the PTA phi matrix can be dense when a correlated
-common process is requested.
+the enterprise_extensions ORFs (``model_definition.py:198-216``), though its
+experimental PTA sampler only ever exploits the block-diagonal CRN case
+(SURVEY §3.6).  Here the ORFs are first-class so the PTA phi matrix can be
+dense when a correlated common process is requested — and, unlike the
+reference, the dense-phi Gibbs path actually samples them (positive-definite
+fixed ORFs; see ``sampler/compiled.py``).
+
+Menu parity with ``blocks.common_red_noise_block``:
+
+- fixed two-point ORFs: ``crn``, ``hd``, ``dipole``, ``monopole``,
+  ``gw_monopole``, ``gw_dipole``, ``st`` (scalar transverse), and their
+  ``zero_diag_*`` variants (cross-correlations only — buildable for
+  detection-style studies, but not positive definite, so the sampler
+  rejects them just as the reference's sampler handles no ORF at all)
+- ``param_hd``, ``bin_orf``, ``legendre_orf``: ORFs with *sampled* shape
+  parameters — buildable rejection with a loud error (the reference can
+  construct them via enterprise but its Gibbs sampler cannot sample any
+  correlated model either)
+- ``freq_hd``: HD correlation applied only from frequency bin
+  ``orf_ifreq`` upward (CRN below) — per-frequency ORF matrices
 """
 
 from __future__ import annotations
@@ -12,14 +28,18 @@ from __future__ import annotations
 import numpy as np
 
 
+def _same(pos_a, pos_b):
+    return pos_a is pos_b or np.allclose(pos_a, pos_b)
+
+
 def crn(pos_a, pos_b):
     """Common-spectrum uncorrelated process: identity correlation."""
-    return 1.0 if pos_a is pos_b or np.allclose(pos_a, pos_b) else 0.0
+    return 1.0 if _same(pos_a, pos_b) else 0.0
 
 
 def hd(pos_a, pos_b):
     """Hellings-Downs quadrupolar correlation."""
-    if pos_a is pos_b or np.allclose(pos_a, pos_b):
+    if _same(pos_a, pos_b):
         return 1.0
     x = (1.0 - np.dot(pos_a, pos_b)) / 2.0
     x = np.clip(x, 1e-15, None)
@@ -27,7 +47,7 @@ def hd(pos_a, pos_b):
 
 
 def dipole(pos_a, pos_b):
-    if pos_a is pos_b or np.allclose(pos_a, pos_b):
+    if _same(pos_a, pos_b):
         return 1.0
     return float(np.dot(pos_a, pos_b))
 
@@ -36,11 +56,56 @@ def monopole(pos_a, pos_b):
     return 1.0
 
 
-ORFS = {"crn": crn, "hd": hd, "dipole": dipole, "monopole": monopole}
+def gw_monopole(pos_a, pos_b):
+    """Breathing-mode (monopolar GW) correlation: 1/2 off-diagonal
+    (enterprise_extensions ``gw_monopole_orf``)."""
+    return 1.0 if _same(pos_a, pos_b) else 0.5
+
+
+def gw_dipole(pos_a, pos_b):
+    """Dipolar-GW correlation: cos(zeta)/2 off-diagonal
+    (enterprise_extensions ``gw_dipole_orf``)."""
+    if _same(pos_a, pos_b):
+        return 1.0
+    return 0.5 * float(np.dot(pos_a, pos_b))
+
+
+def st(pos_a, pos_b):
+    """Scalar-transverse correlation: 1/8 (3 + cos zeta) off-diagonal,
+    3/8 normalization on the diagonal relative convention of
+    enterprise_extensions ``st_orf`` (unit diagonal here)."""
+    if _same(pos_a, pos_b):
+        return 1.0
+    return (3.0 + float(np.dot(pos_a, pos_b))) / 8.0
+
+
+ORFS = {"crn": crn, "hd": hd, "dipole": dipole, "monopole": monopole,
+        "gw_monopole": gw_monopole, "gw_dipole": gw_dipole, "st": st}
+
+#: ORFs whose shape is itself sampled (enterprise_extensions
+#: ``param_hd_orf`` / ``bin_orf`` / ``legendre_orf``); the model layer
+#: names them so requests fail with a precise message
+PARAMETERIZED_ORFS = ("param_hd", "param_multiple", "bin_orf", "legendre_orf",
+                      "zero_diag_bin_orf", "zero_diag_legendre_orf")
 
 
 def orf_matrix(name: str, positions) -> np.ndarray:
-    """(P, P) correlation matrix over pulsars for the named ORF."""
+    """(P, P) correlation matrix over pulsars for the named ORF.
+
+    ``zero_diag_<orf>`` zeroes the diagonal (cross-correlation-only
+    detection statistic variants); the result is then not positive
+    definite and cannot serve as a sampling prior — callers that need a
+    PD phi must reject it.
+    """
+    zero_diag = False
+    if name.startswith("zero_diag_"):
+        zero_diag = True
+        name = name[len("zero_diag_"):]
+    if name in PARAMETERIZED_ORFS:
+        raise NotImplementedError(
+            f"orf='{name}' has sampled shape parameters; sampling "
+            "parameterized ORFs is not implemented (the reference's Gibbs "
+            "sampler supports no correlated ORF at all)")
     fn = ORFS[name]
     P = len(positions)
     for ii, p in enumerate(positions):
@@ -52,4 +117,40 @@ def orf_matrix(name: str, positions) -> np.ndarray:
     for a in range(P):
         for b in range(a + 1, P):
             G[a, b] = G[b, a] = fn(positions[a], positions[b])
+    if zero_diag:
+        G = G - np.eye(P)
     return G
+
+
+def orf_matrix_per_freq(name: str, positions, K: int,
+                        orf_ifreq: int = 0) -> np.ndarray:
+    """(K, P, P) per-frequency ORF stack.
+
+    ``freq_hd`` (reference ``orf_ifreq`` kwarg): CRN below frequency bin
+    ``orf_ifreq``, Hellings-Downs from that bin upward.  Any fixed ORF
+    name yields a constant stack.
+    """
+    if name == "freq_hd":
+        low = orf_matrix("crn", positions)
+        high = orf_matrix("hd", positions)
+        return np.stack([high if k >= orf_ifreq else low for k in range(K)])
+    G = orf_matrix(name, positions)
+    return np.broadcast_to(G, (K,) + G.shape).copy()
+
+
+def orf_ginv_stack(name: str, positions, K: int,
+                   orf_ifreq: int = 0) -> np.ndarray:
+    """(K, P, P) inverse ORF stack for the correlated-phi samplers.
+
+    Verifies positive definiteness first: the ``zero_diag_*`` variants are
+    cross-correlation-only detection statistics, not valid sampling priors,
+    and fail here with a precise message.
+    """
+    Gk = orf_matrix_per_freq(name, positions, K, orf_ifreq=orf_ifreq)
+    wmin = float(np.linalg.eigvalsh(Gk).min())
+    if wmin <= 1e-10:
+        raise NotImplementedError(
+            f"orf='{name}' is not positive definite (min eigenvalue "
+            f"{wmin:.2e}); zero-diag/cross-correlation-only ORFs are "
+            "detection-statistic constructions, not samplable priors")
+    return np.linalg.inv(Gk)

@@ -60,6 +60,16 @@ def turnover_knee(f, df, log10_A, gamma, lfb=-8.5, lfk=-8.0, kappa=10.0 / 3.0, d
     return hcf**2 / (12.0 * np.pi**2) / f**3 * df
 
 
+def powerlaw_breakflat(f, df, log10_A, gamma, log10_fb):
+    """Powerlaw whose PSD flattens (P(f) = P(fb)) above the break frequency
+    ``fb`` — the reference ``model_general`` kwargs ``red_breakflat`` /
+    ``red_breakflat_fq`` (``model_definition.py:115-118``)."""
+    fb = 10.0 ** log10_fb
+    feff = np.minimum(f, fb)
+    A = 10.0 ** log10_A
+    return (A**2 / (12.0 * np.pi**2)) * FYR ** (gamma - 3.0) * feff ** (-gamma) * df
+
+
 def infinitepower(f, df):
     """Effectively-unconstrained prior variance for marginalized bases
     (timing model); kept in log space device-side to stay f32-safe."""

@@ -14,7 +14,7 @@ from __future__ import annotations
 import numpy as np
 
 from .priors import Constant
-from .signals import BasisSignal, WhiteNoiseSignal
+from .signals import BasisSignal, FourierGPSignal, WhiteNoiseSignal
 
 
 class SignalModel:
@@ -33,16 +33,19 @@ class SignalModel:
         self.pulsar = pulsar
         self.white = white
 
-        def chrom(s):
-            return getattr(s, "chromatic", False)
-
-        self._timing = [s for s in basis_signals
-                        if not getattr(s, "shares_fourier", False)
-                        and not chrom(s) and s.name != "basis_ecorr"]
+        # classification: Fourier GPs either share the common grid columns
+        # (_fourier) or keep their own (_chrom: chromatic / row-masked /
+        # band-split processes — any GP whose phi depends on sampled
+        # hypers); remaining basis signals are static marginalized blocks
+        # (_timing: timing model, dm_annual, BayesEphem — constant phi)
         self._fourier = [s for s in basis_signals
                          if getattr(s, "shares_fourier", False)]
-        self._chrom = [s for s in basis_signals if chrom(s)]
+        self._chrom = [s for s in basis_signals
+                       if isinstance(s, FourierGPSignal)
+                       and not getattr(s, "shares_fourier", False)]
         self._ecorr = [s for s in basis_signals if s.name == "basis_ecorr"]
+        taken = set(map(id, self._fourier + self._chrom + self._ecorr))
+        self._timing = [s for s in basis_signals if id(s) not in taken]
         self.signals = self._timing + self._fourier + self._chrom + self._ecorr
 
         blocks, self._slices = [], {}

@@ -87,28 +87,54 @@ class FourierGPSignal(BasisSignal):
     ``model_definition.py:19-31``).  Amplitudes are thus referenced to
     1400 MHz.  Chromatic signals keep their own basis columns — they
     cannot share the achromatic Fourier block.
+
+    ``row_mask`` restricts the process to a subset of TOAs (rows outside
+    the mask are zeroed) — the mechanism behind ``red_select``
+    band/backend-split intrinsic red noise (reference ``model_general``
+    kwarg ``red_select``).  Masked signals keep their own columns too.
+
+    ``pshift_seed`` adds a deterministic random phase to every Fourier
+    mode (``model_general(pshift=True)``, used for false-alarm studies);
+    ``wgts`` overrides the per-bin summation weights (``sqrt(df)``), the
+    ``wgts`` kwarg of ``model_general``.
     """
 
     def __init__(self, toas_mjd, nmodes: int, Tspan: float, psd_name: str,
                  psd_params: list, name: str, modes=None, orf_name: str = "crn",
-                 radio_freqs=None, chrom_index: float | None = None):
+                 radio_freqs=None, chrom_index: float | None = None,
+                 row_mask=None, pshift_seed=None, wgts=None,
+                 orf_ifreq: int = 0, leg_lmax: int = 5):
         self.name = name
         self.params = list(psd_params)
         self.psd_name = psd_name
         self.orf_name = orf_name
+        # ORF-shape options (consumed by models/orf.py for the freq_hd and
+        # legendre_orf families; inert for other ORFs, as in the reference)
+        self.orf_ifreq = int(orf_ifreq)
+        self.leg_lmax = int(leg_lmax)
         self.nmodes = nmodes
         self.Tspan = Tspan
         self.chromatic = chrom_index is not None
-        self.shares_fourier = not self.chromatic
-        self._F, self._f = fourier_basis(toas_mjd, nmodes, Tspan, modes=modes)
+        self.shares_fourier = not self.chromatic and row_mask is None
+        phases = None
+        if pshift_seed is not None:
+            nm = nmodes if modes is None else len(modes)
+            phases = np.random.default_rng(pshift_seed).uniform(
+                0.0, 2.0 * np.pi, nm)
+        self._F, self._f = fourier_basis(toas_mjd, nmodes, Tspan, modes=modes,
+                                         pshift_phases=phases)
         if self.chromatic:
             scale = (1400.0 / np.asarray(radio_freqs)) ** float(chrom_index)
             self._F = self._F * scale[:, None]
+        if row_mask is not None:
+            self._F = self._F * np.asarray(row_mask, dtype=float)[:, None]
         # per-column bin width: spacing between consecutive unique
         # frequencies, first bin measured from 0 (uniform 1/Tspan on the
         # default grid; essential for logfreq/custom grids)
         funique = np.unique(self._f)
         self._df = np.repeat(np.diff(np.concatenate([[0.0], funique])), 2)
+        if wgts is not None:
+            self._df = np.repeat(np.asarray(wgts, dtype=np.float64) ** 2, 2)
         if psd_name == "spectrum":            # model_general's name for it
             psd_name = "free_spectrum"
             self.psd_name = psd_name

@@ -105,11 +105,20 @@ def _lnphi_turnover_knee(f, df, log10_A, gamma, lfb=-8.5, lfk=-8.0,
     return 2.0 * lnhc - _LN12PI2 - 3.0 * lnf + jnp.log(df)
 
 
+def _lnphi_powerlaw_breakflat(f, df, log10_A, gamma, log10_fb):
+    import jax.numpy as jnp
+
+    lnf = jnp.minimum(jnp.log(f), _LN10 * log10_fb)
+    return (2.0 * _LN10 * log10_A - _LN12PI2 + (gamma - 3.0) * _LNFYR
+            - gamma * lnf + jnp.log(df))
+
+
 _LNPSD_FNS = {
     "powerlaw": _lnphi_powerlaw,
     "turnover": _lnphi_turnover,
     "turnover_knee": _lnphi_turnover_knee,
     "broken_powerlaw": _lnphi_broken_powerlaw,
+    "powerlaw_breakflat": _lnphi_powerlaw_breakflat,
 }
 
 
@@ -199,7 +208,9 @@ class CompiledPTA:
     #: anything else (hd/dipole/monopole) activates the joint cross-pulsar
     #: b-draw and the quadratic-form rho conditional
     orf_name: str = "crn"
-    orf_Ginv: object = None    # (P, P) inverse ORF matrix (identity pads)
+    orf_Ginv: object = None    # (K, P, P) per-frequency inverse ORF stack
+                               # (identity pads; constant over K for fixed
+                               # ORFs, varying for freq_hd)
     #: (P, Bmax) 1.0 on Fourier/chromatic GP columns — the coefficient
     #: set whose N(0, phi(x)) prior is the generic b-conditional
     #: likelihood of the powerlaw-family hyper MH block
@@ -253,6 +264,8 @@ class CompiledPTA:
         for c in comps:
             if c.kind in ("free_spectrum", "ecorr"):
                 vals = 10.0 ** (2.0 * xev[c.rho_ix])
+            elif c.kind == "infinitepower":
+                vals = jnp.full(c.cols.shape, BIG_PHI["f32"], dtype)
             else:
                 fn = _LNPSD_FNS[c.kind]
                 args = [xev[c.hyp_ix[:, h]][:, None]
@@ -382,6 +395,10 @@ class CompiledPTA:
         k = jnp.arange(self.K)
         if self.red_kind == "":
             return jnp.full((self.P, self.K), PHI_FLOOR, dtype=self.cdtype)
+        if self.red_kind == "infinitepower":
+            out = jnp.where(jnp.arange(self.K)[None, :] < self.Kr,
+                            BIG_PHI["f32"], PHI_FLOOR)
+            return jnp.where(self.red_valid[:, None] > 0, out, PHI_FLOOR)
         if self.red_kind == "free_spectrum":
             Kr = self.red_rho_ix.shape[1]
             vals = 10.0 ** (2.0 * xev[self.red_rho_ix])  # (P, Kr)
@@ -476,10 +493,13 @@ def compile_pta(pta, pad_pulsars: int | None = None) -> CompiledPTA:
                     equad_ix[ii, where] = ref(m.white._equad[lab])
             if m.white._gequad is not None:
                 gequad_ix[ii, :n] = ref(m.white._gequad)
-        # timing-model columns: effectively-infinite prior variance
+        # static marginalized bases: constant prior variance per column —
+        # effectively-infinite for timing-model/dm_annual columns, finite
+        # Gaussian prior variances for BayesEphem-style physical bases
+        # (clipped into the TPU-safe exponent range either way)
         for s in m._timing:
             sl_ = m._slices[s.name]
-            phi_base[ii, sl_] = big_phi
+            phi_base[ii, sl_] = np.clip(s.get_phi({}), PHI_FLOOR, big_phi)
         # GP columns start at 0 and accumulate component contributions
         for s in m._fourier + m._chrom + m._ecorr:
             sl_ = m._slices[s.name]
@@ -718,8 +738,6 @@ def compile_pta(pta, pad_pulsars: int | None = None) -> CompiledPTA:
     gw_orfs = {s.orf_name for m in models for s in m._fourier
                if "gw" in s.name}
     if gw_orfs - {"crn"}:
-        from ..models.orf import orf_matrix
-
         if len(gw_orfs) > 1:
             raise NotImplementedError(f"mixed common-process ORFs {gw_orfs}")
         orf_name = gw_orfs.pop()
@@ -745,11 +763,18 @@ def compile_pta(pta, pad_pulsars: int | None = None) -> CompiledPTA:
         # no size gate: up to HD_DENSE_MAX total coefficients the sweep
         # uses the dense joint draw; larger arrays switch to the
         # sequential pulsar-wise conditional sweep (jax_backend.
-        # draw_b_hd_sequential), whose program size is O(Bmax^2)
-        G = np.eye(P)
-        G[:P_real, :P_real] = orf_matrix(
-            orf_name, [m.pulsar.pos for m in models])
-        orf_Ginv = np.linalg.inv(G).astype(np.float64)
+        # draw_b_hd_sequential), whose program size is O(Bmax^2).
+        # The stack is per-frequency (K, P, P) so freq_hd (HD above bin
+        # orf_ifreq, CRN below) rides the same machinery as fixed ORFs.
+        from ..models.orf import orf_ginv_stack
+
+        sig0 = next(s for s in (fsig(m, "gw") for m in models)
+                    if s is not None)
+        ginv_real = orf_ginv_stack(
+            orf_name, [m.pulsar.pos for m in models], K,
+            orf_ifreq=getattr(sig0, "orf_ifreq", 0))      # (K, Pr, Pr)
+        orf_Ginv = np.tile(np.eye(P), (K, 1, 1))
+        orf_Ginv[:, :P_real, :P_real] = ginv_real
 
     zeros_pk = np.zeros((P, max(K, 1)), np_dtype)
     return CompiledPTA(

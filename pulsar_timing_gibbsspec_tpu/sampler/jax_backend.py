@@ -189,7 +189,7 @@ def draw_b_fn(cm: CompiledPTA, x, key, b=None):
 def draw_b_hd_sequential(cm: CompiledPTA, x, b, key):
     """Correlated-ORF b-draw as a sequential pulsar-wise Gibbs sweep —
     the scalable alternative to :func:`draw_b_joint` (whose dense
-    ``(P Bmax)^2`` program is capped at 1024 coefficients).
+    ``(P Bmax)^2`` program is capped at ``HD_DENSE_MAX`` coefficients).
 
     The joint prior of the GW coefficients per (frequency, phase) group
     is ``N(0, rho_k G)`` over pulsars; pulsar ``p``'s conditional given
@@ -220,7 +220,7 @@ def draw_b_hd_sequential(cm: CompiledPTA, x, b, key):
     gw_cols = jnp.concatenate([cm.gw_sin_ix, cm.gw_cos_ix], axis=1)
     pinv = pinv.at[rows_p, gw_cols].set(0.0, mode="drop")
     rho = 10.0 ** (2.0 * jnp.asarray(x, cdt)[cm.rho_ix_x])       # (K,)
-    Ginv = jnp.asarray(cm.orf_Ginv, cdt)           # (P, P)
+    Ginv = jnp.asarray(cm.orf_Ginv, cdt)           # (K, P, P)
     keys = jr.split(key, P)
     eye = jnp.eye(B, dtype=cdt)
     gsin = jnp.asarray(cm.gw_sin_ix)
@@ -235,12 +235,12 @@ def draw_b_hd_sequential(cm: CompiledPTA, x, b, key):
 
     def step(b, p):
         a = gather_a(b) * live_mask[:, None, None]
-        g_row = Ginv[p]                            # (P,)
-        gpp = g_row[p]
+        g_row = Ginv[:, p, :]                      # (K, P)
+        gpp = Ginv[:, p, p]                        # (K,)
         # conditional prior precision on p's gw cols and its linear term
         prior_prec = gpp / rho                     # (K,)
-        cross = (jnp.einsum("q,qkf->kf", g_row, a)
-                 - gpp * a[p]) / rho[:, None]      # (K, 2)
+        cross = (jnp.einsum("kq,qkf->kf", g_row, a)
+                 - gpp[:, None] * a[p]) / rho[:, None]   # (K, 2)
         pin_p = pinv[p]
         pin_p = pin_p.at[gsin[p]].set(prior_prec, mode="drop")
         pin_p = pin_p.at[gcos[p]].set(prior_prec, mode="drop")
@@ -290,11 +290,12 @@ def draw_b_joint(cm: CompiledPTA, x, key):
     Sigma = Sigma.at[rows[:, :, None], rows[:, None, :]].set(TNT)
     Sigma = Sigma.at[jnp.arange(PB), jnp.arange(PB)].add(pinv.reshape(PB))
     rho = 10.0 ** (2.0 * jnp.asarray(x, cm.cdtype)[cm.rho_ix_x])   # (K,)
-    Ginv = jnp.asarray(cm.orf_Ginv, cm.cdtype)
+    Ginv = jnp.moveaxis(jnp.asarray(cm.orf_Ginv, cm.cdtype),
+                        0, -1)                                     # (P, P, K)
     for phase_ix in (cm.gw_sin_ix, cm.gw_cos_ix):
         frows = jnp.arange(P)[:, None] * B + phase_ix              # (P, K)
         Sigma = Sigma.at[frows[:, None, :], frows[None, :, :]].add(
-            Ginv[:, :, None] / rho[None, None, :])
+            Ginv / rho[None, None, :])
     dflat = d.reshape(PB)
     diag = jnp.diagonal(Sigma)
     dj = 1.0 / jnp.sqrt(diag)
@@ -694,12 +695,12 @@ def rho_update(cm: CompiledPTA, x, b, key):
         # quadratic form taut_k = 0.5 sum_phase a_k^T G^-1 a_k (reduces to
         # sum_p tau_pk at G = I)
         fdt = cm.dtype
-        Ginv = jnp.asarray(cm.orf_Ginv, cm.cdtype)
+        Ginv = jnp.asarray(cm.orf_Ginv, cm.cdtype)      # (K, P, P)
         live = jnp.asarray(cm.psr_mask, cm.cdtype)
         taut = jnp.zeros((cm.K,), cm.cdtype)
         for ix in (cm.gw_sin_ix, cm.gw_cos_ix):
             a = jnp.take_along_axis(b, ix, axis=1) * live[:, None]  # (P, K)
-            taut = taut + 0.5 * jnp.einsum("pk,pq,qk->k", a, Ginv, a)
+            taut = taut + 0.5 * jnp.einsum("pk,kpq,qk->k", a, Ginv, a)
         grid = _rho_grid(cm, cm.rhomin, cm.rhomax)
         logpdf = (-cm.P_real * jnp.log(grid)[None, :]
                   - (taut[:, None] / grid[None, :]).astype(fdt))
@@ -763,9 +764,13 @@ EXACT_EVERY = 8
 #: correlated-ORF arrays up to this many total coefficients use the
 #: dense joint b-draw (best mixing: one exact draw of everything);
 #: larger arrays use the sequential pulsar-wise conditional sweep —
-#: the dense recursive factor's XLA program grows ~O((P Bmax)^2) and
-#: was measured to break the remote-compile transport at dim 1665
-HD_DENSE_MAX = 1024
+#: the dense recursive factor's XLA program grows ~O((P Bmax)^2):
+#: measured scanned-sweep compile 242 s at dim 108 vs 47 s sequential
+#: (CPU, 4 real pulsars), and the remote-compile transport breaks
+#: outright by dim 1665.  64 keeps the dense draw for toy systems where
+#: its compile is cheap and routes real-size arrays to the sequential
+#: sweep, whose program size is O(Bmax^2) regardless of pulsar count
+HD_DENSE_MAX = 64
 #: diagonal ridge on the f32-preconditioned proposal system: larger than
 #: the f32 entry rounding of the unit-diagonal matrix so its Cholesky
 #: cannot break down, small enough to barely touch the proposal shape

@@ -65,9 +65,13 @@ class NumpyGibbs:
         except ValueError:
             self.red_rhomin, self.red_rhomax = self.rhomin, self.rhomax
 
-        self.red_sig = next((s for s in self._model.signals if "red" in s.name), None)
+        # only shared-column Fourier red signals: red_select band/backend
+        # splits live on their own masked columns and are sampled by the
+        # generic hyper-MH block, not the red conditional machinery
+        self.red_sig = next((s for s in self._model._fourier
+                             if "red" in s.name), None)
         if self.red_sig is not None:
-            rsl = self._model.basis_slice("red")
+            rsl = self._model._slices[self.red_sig.name]
             self.redid = np.arange(rsl.start, rsl.stop)
         self.gw_sig = next((s for s in self._model.signals if "gw" in s.name), None)
         if len(self.idx.rho) and len(self.idx.rho) != len(self.gwid) // 2:

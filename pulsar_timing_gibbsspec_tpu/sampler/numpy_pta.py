@@ -66,10 +66,12 @@ class NumpyPTAGibbs:
             m = pta.model(pname)
             sl_gw = m.basis_slice("gw")
             self.gwid.append(np.arange(sl_gw.start, sl_gw.stop))
-            red_sig = next((s for s in m.signals if "red" in s.name), None)
+            # shared-column Fourier red only (red_select splits are
+            # own-column signals handled by the generic hyper-MH block)
+            red_sig = next((s for s in m._fourier if "red" in s.name), None)
             self.red_sigs.append(red_sig)
             if red_sig is not None:
-                sl_red = m.basis_slice("red")
+                sl_red = m._slices[red_sig.name]
                 self.redid.append(np.arange(sl_red.start, sl_red.stop))
             else:
                 self.redid.append(None)
@@ -101,7 +103,7 @@ class NumpyPTAGibbs:
         self.orf_name = orf_names.pop() if orf_names else "crn"
         self.G = None
         if self.orf_name != "crn":
-            from ..models.orf import orf_matrix
+            from ..models.orf import orf_ginv_stack, orf_matrix
 
             if any(s is not None for s in self.red_sigs):
                 raise NotImplementedError(
@@ -114,8 +116,16 @@ class NumpyPTAGibbs:
                     "correlated ORF requires a homogeneous common mode "
                     "count across pulsars")
             pos = [pta.model(ii).pulsar.pos for ii in range(self.P)]
-            self.G = orf_matrix(self.orf_name, pos)
-            self.Ginv = np.linalg.inv(self.G)
+            K = len(self.gwid[0]) // 2
+            sig0 = next(s for s in self.gw_sigs if s is not None)
+            # per-frequency (K, P, P) stack: constant for fixed ORFs,
+            # varying for freq_hd (CRN below bin orf_ifreq, HD above)
+            self.G = orf_matrix(
+                self.orf_name if not self.orf_name.startswith("freq_")
+                else "hd", pos)
+            self.Ginv = orf_ginv_stack(
+                self.orf_name, pos, K,
+                orf_ifreq=getattr(sig0, "orf_ifreq", 0))
 
         self.b = [np.zeros(T.shape[1]) for T in self._T]
         self._TNT = None
@@ -271,7 +281,7 @@ class NumpyPTAGibbs:
             for phase in (0, 1):
                 rows = np.array([offs[ii] + self.gwid[ii][2 * k + phase]
                                  for ii in range(self.P)])
-                Sigma[np.ix_(rows, rows)] += self.Ginv / rho[k]
+                Sigma[np.ix_(rows, rows)] += self.Ginv[k] / rho[k]
         d = np.concatenate(self._d)
         cf = sl.cho_factor(Sigma, lower=True)
         mn = sl.cho_solve(cf, d)
@@ -302,7 +312,7 @@ class NumpyPTAGibbs:
             taut = np.zeros(K)
             for phase in (0, 1):
                 ap = a[:, phase::2][:, :K]              # (P, K)
-                taut += 0.5 * np.einsum("pk,pq,qk->k", ap, self.Ginv, ap)
+                taut += 0.5 * np.einsum("pk,kpq,qk->k", ap, self.Ginv, ap)
             logpdf = (-self.P * np.log(grid)[None, :]
                       - taut[:, None] / grid[None, :])
         else:

@@ -75,7 +75,7 @@ def test_chrom_and_gequad_build_and_sample(dm_psr, tmp_path):
     pta = model_general([dm_psr], tm_svd=True, red_var=False,
                         white_vary=True, common_psd="spectrum",
                         common_components=5, dm_chrom=True,
-                        chrom_components=5, gequad=True)
+                        dm_components=5, gequad=True)
     names = pta.param_names
     assert any("chrom_gp" in n for n in names)
     assert any("gequad" in n for n in names)

@@ -1,0 +1,247 @@
+"""model_general kwarg-surface parity: pshift/wgts, BayesEphem, red_select,
+red_breakflat, infinitepower, freq_hd and the fixed-ORF menu, is_wideband.
+
+The reference's ``model_general`` advertises these options
+(``model_definition.py:36-170``); its committed body exercises only a
+subset, and its samplers none of the correlated ones.  These tests pin
+that the TPU framework both *builds* the advertised models and — where a
+sampler block exists — samples them to finite, matched chains.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from pulsar_timing_gibbsspec_tpu.models.ephem import BayesEphemSignal
+from pulsar_timing_gibbsspec_tpu.models.factory import model_general
+from pulsar_timing_gibbsspec_tpu.models.orf import (orf_ginv_stack,
+                                                    orf_matrix,
+                                                    orf_matrix_per_freq)
+from pulsar_timing_gibbsspec_tpu.sampler.blocks import BlockIndex
+from pulsar_timing_gibbsspec_tpu.sampler.compiled import compile_pta
+from pulsar_timing_gibbsspec_tpu.sampler.gibbs import (PTABlockGibbs,
+                                                       PulsarBlockGibbs)
+
+BASE = dict(tm_svd=True, common_psd="spectrum", common_components=5,
+            red_var=False)
+
+
+def test_pshift_deterministic_and_distinct(psrs8):
+    """pshift randomizes the common-process Fourier phases per pulsar,
+    deterministically for a fixed pseed (reference pshift/pseed kwargs)."""
+    p1 = model_general(psrs8[:2], **BASE, pshift=True, pseed=7)
+    p2 = model_general(psrs8[:2], **BASE, pshift=True, pseed=7)
+    p3 = model_general(psrs8[:2], **BASE, pshift=True, pseed=8)
+    p0 = model_general(psrs8[:2], **BASE)
+
+    def gw_basis(pta, ii):
+        m = pta.model(ii)
+        s = next(s for s in m.signals if "gw" in s.name)
+        return s.get_basis()
+
+    np.testing.assert_array_equal(gw_basis(p1, 0), gw_basis(p2, 0))
+    assert not np.allclose(gw_basis(p1, 0), gw_basis(p3, 0))
+    assert not np.allclose(gw_basis(p1, 0), gw_basis(p0, 0))
+    # distinct shifts per pulsar
+    F0, F1 = gw_basis(p1, 0), gw_basis(p1, 1)
+    assert F0.shape[1] == F1.shape[1]
+
+    # the shift survives a wider red process donating the shared basis,
+    # and red/GW stay share-consistent (same leading phases)
+    kw = dict(tm_svd=True, common_psd="spectrum", common_components=5,
+              red_var=True, red_psd="spectrum", red_components=10)
+    ps_on = model_general(psrs8[:1], **kw, pshift=True, pseed=7)
+    ps_off = model_general(psrs8[:1], **kw)
+    m_on, m_off = ps_on.model(0), ps_off.model(0)
+    Ton, Toff = m_on.get_basis(), m_off.get_basis()
+    sl = m_on._slices[next(s.name for s in m_on.signals if "gw" in s.name)]
+    assert not np.allclose(Ton[:, sl.start:sl.stop],
+                           Toff[:, sl.start:sl.stop])
+    gw_on = next(s for s in m_on.signals if "gw" in s.name)
+    red_on = next(s for s in m_on.signals if "red" in s.name)
+    np.testing.assert_allclose(gw_on.get_basis(),
+                               red_on.get_basis()[:, :10])
+
+
+def test_wgts_overrides_bin_widths(psrs8):
+    w = np.full(5, 2e-9)
+    pta = model_general(psrs8[:1], **BASE, wgts=w)
+    s = next(s for s in pta.model(0).signals if "gw" in s.name)
+    np.testing.assert_allclose(s._df, np.repeat(w**2, 2))
+
+
+def test_bayesephem_basis_and_sampling(psrs8, tmp_path):
+    """11 delay-partial columns with enterprise-matched prior variances,
+    marginalized in the b-draw; the flagship config still samples to
+    finite chains with the extra basis."""
+    sig = BayesEphemSignal(psrs8[0].toas, psrs8[0].pos)
+    T = sig.get_basis()
+    assert T.shape == (psrs8[0].ntoa, 11)
+    # sigma-scaled columns: unit prior variance each
+    np.testing.assert_allclose(sig.get_phi({}), 1.0)
+    # jupiter-mass column bounded by a_J * AU_SEC * sigma_IAU in delay
+    assert np.all(np.abs(T[:, 1]) <= 5.21 * 499.1 * 1.55e-11)
+    assert T[:, 1].std() > 0
+    # every column is a sub-microsecond-scale delay partial at 1 sigma
+    assert np.all(np.abs(T) < 5e-6)
+
+    pta = model_general(psrs8[:1], **BASE, white_vary=True, bayesephem=True)
+    x0 = pta.initial_sample(np.random.default_rng(0))
+    g = PulsarBlockGibbs(pta, backend="jax", seed=3, progress=False)
+    chain = g.sample(x0, outdir=str(tmp_path / "be"), niter=200)
+    assert np.all(np.isfinite(chain))
+    # the ephemeris coefficients are sampled in bchain (marginalized draw)
+    m = pta.model(0)
+    sl = m._slices["bayesephem"]
+    bcols = g.bchain[50:, sl.start:sl.stop]
+    assert np.all(np.isfinite(bcols)) and bcols.std() > 0
+
+
+def test_be_type_validation(psrs8):
+    with pytest.raises(ValueError):
+        BayesEphemSignal(psrs8[0].toas, psrs8[0].pos, be_type="nope")
+    for bt in ("orbel", "orbel-v2", "setIII", "setIII_1980"):
+        model_general(psrs8[:1], **BASE, bayesephem=True, be_type=bt)
+
+
+def test_red_select_band_split_samples(psrs8, tmp_path):
+    """red_select='band' splits the intrinsic red process into masked
+    per-band GPs whose hypers ride the adaptive MH block on both
+    backends."""
+    psr = dataclasses.replace(
+        psrs8[0], freqs=np.where(
+            np.random.default_rng(0).uniform(size=psrs8[0].ntoa) < 0.5,
+            800.0, 1400.0))
+    pta = model_general([psr], tm_svd=True, common_psd="spectrum",
+                        common_components=5, red_var=True,
+                        red_select="band")
+    names = pta.param_names
+    assert any("red_noise_low_log10_A" in n for n in names)
+    assert any("red_noise_high_log10_A" in n for n in names)
+    # masked bases are orthogonal across bands
+    m = pta.model(0)
+    lo = next(s for s in m.signals if "red_noise_low" in s.name)
+    hi = next(s for s in m.signals if "red_noise_high" in s.name)
+    assert np.allclose(lo.get_basis() * hi.get_basis(), 0.0)
+
+    x0 = pta.initial_sample(np.random.default_rng(1))
+    idx = BlockIndex.build(names)
+    assert len(idx.red) >= 4          # 2 bands x (log10_A, gamma)
+    for backend in ("numpy", "jax"):
+        g = PulsarBlockGibbs(pta, backend=backend, seed=11, progress=False)
+        chain = g.sample(x0, outdir=str(tmp_path / backend), niter=150)
+        assert np.all(np.isfinite(chain))
+        assert chain[50:, idx.red].std() > 0
+
+
+def test_red_select_spectrum_rejected(psrs8):
+    with pytest.raises(NotImplementedError):
+        model_general(psrs8[:1], tm_svd=True, red_var=True,
+                      red_psd="spectrum", red_select="band",
+                      common_psd="spectrum", common_components=5)
+
+
+def test_red_breakflat_psd(psrs8):
+    """Device lnphi for powerlaw_breakflat matches the host PSD: flat
+    above the break, powerlaw below."""
+    from pulsar_timing_gibbsspec_tpu.models import psd as psdmod
+
+    f = np.array([1e-9, 3e-9, 1e-8, 3e-8])
+    df = np.full(4, 1e-9)
+    host = psdmod.powerlaw_breakflat(f, df, -14.0, 4.0, np.log10(5e-9))
+    plaw = psdmod.powerlaw(f, df, -14.0, 4.0)
+    assert np.allclose(host[:2], plaw[:2])
+    assert np.allclose(host[2:], psdmod.powerlaw(
+        np.full(2, 5e-9), df[2:], -14.0, 4.0))
+
+    pta = model_general(psrs8[:1], tm_svd=True, common_psd="spectrum",
+                        common_components=5, red_var=True,
+                        red_breakflat=True, red_breakflat_fq=5e-9)
+    cm = compile_pta(pta)
+    assert cm.red_kind == "powerlaw_breakflat"
+    x = pta.initial_sample(np.random.default_rng(0))
+    dev = np.asarray(cm.phi(x))
+    hostphi = pta.get_phi(pta.map_params(x))[0]
+    m = pta.model(0)
+    sl = m._slices[f"{pta.pulsars[0]}_red_noise"]
+    np.testing.assert_allclose(dev[0, sl.start:sl.stop],
+                               hostphi[sl.start:sl.stop], rtol=1e-5)
+
+
+def test_red_infinitepower_marginalizes(psrs8, tmp_path):
+    pta = model_general(psrs8[:1], tm_svd=True, common_psd="spectrum",
+                        common_components=5, red_var=True,
+                        red_psd="infinitepower", red_components=5)
+    cm = compile_pta(pta)
+    assert cm.red_kind == "infinitepower"
+    x = pta.initial_sample(np.random.default_rng(0))
+    # red columns get the big marginalization variance on device and host
+    dev = np.asarray(cm.phi(x))
+    assert dev.max() >= 1e29
+    g = PulsarBlockGibbs(pta, backend="jax", seed=5, progress=False)
+    chain = g.sample(x, outdir=str(tmp_path / "ip"), niter=100)
+    assert np.all(np.isfinite(chain))
+
+
+def test_orf_menu_and_zero_diag():
+    rng = np.random.default_rng(2)
+    pos = [v / np.linalg.norm(v) for v in rng.standard_normal((6, 3))]
+    for name in ("crn", "hd", "dipole", "monopole", "gw_monopole",
+                 "gw_dipole", "st"):
+        G = orf_matrix(name, pos)
+        assert np.allclose(np.diag(G), 1.0)
+        assert np.allclose(G, G.T)
+    Z = orf_matrix("zero_diag_hd", pos)
+    assert np.allclose(np.diag(Z), 0.0)
+    with pytest.raises(NotImplementedError):
+        orf_ginv_stack("zero_diag_hd", pos, 3)
+    with pytest.raises(NotImplementedError):
+        orf_matrix("bin_orf", pos)
+
+
+def test_freq_hd_stack():
+    rng = np.random.default_rng(3)
+    pos = [v / np.linalg.norm(v) for v in rng.standard_normal((4, 3))]
+    Gk = orf_matrix_per_freq("freq_hd", pos, 5, orf_ifreq=2)
+    assert Gk.shape == (5, 4, 4)
+    assert np.allclose(Gk[0], np.eye(4)) and np.allclose(Gk[1], np.eye(4))
+    np.testing.assert_allclose(Gk[2], orf_matrix("hd", pos))
+
+
+def test_freq_hd_sampling(psrs8, tmp_path):
+    """freq_hd (CRN below bin orf_ifreq, HD above) runs end-to-end on
+    both backends with matched means on the correlated bins."""
+    pta = model_general(psrs8[:3], **BASE, orf="freq_hd", orf_ifreq=2)
+    cm = compile_pta(pta)
+    G = np.asarray(cm.orf_Ginv)
+    assert G.shape[0] == cm.K
+    assert np.allclose(G[0], np.eye(cm.P))
+    assert not np.allclose(G[4], np.eye(cm.P))
+    x0 = pta.initial_sample(np.random.default_rng(4))
+    chains = {}
+    for backend, seed in [("jax", 5), ("numpy", 6)]:
+        g = PTABlockGibbs(pta, backend=backend, seed=seed, progress=False)
+        chains[backend] = g.sample(x0, outdir=str(tmp_path / backend),
+                                   niter=1500)
+    from pulsar_timing_gibbsspec_tpu.ops.acf import integrated_act
+
+    idx = BlockIndex.build(pta.param_names)
+    burn = 300
+    for k in idx.rho:
+        cj, cn = chains["jax"][burn:, k], chains["numpy"][burn:, k]
+        assert np.all(np.isfinite(cj)) and np.all(np.isfinite(cn))
+        ess_j = len(cj) / max(integrated_act(cj), 1.0)
+        ess_n = len(cn) / max(integrated_act(cn), 1.0)
+        z = abs(cj.mean() - cn.mean()) / np.sqrt(
+            cj.var() / ess_j + cn.var() / ess_n)
+        assert z < 4.0, (k, z, ess_j, ess_n)
+
+
+def test_is_wideband_excludes_ecorr(psrs8):
+    psr = dataclasses.replace(psrs8[0], flags={"pta": "NANOGrav"})
+    with_ec = model_general([psr], **BASE, white_vary=True)
+    without = model_general([psr], **BASE, white_vary=True,
+                            is_wideband=True)
+    assert any("ecorr" in n for n in with_ec.param_names)
+    assert not any("ecorr" in n for n in without.param_names)

@@ -338,7 +338,8 @@ def test_hd_identity_orf_matches_crn_conditional(psrs8):
     # overwrite the ORF with the identity: conditional == CRN
     import dataclasses
 
-    cmI = dataclasses.replace(cm, orf_Ginv=np.eye(cm.P))
+    cmI = dataclasses.replace(
+        cm, orf_Ginv=np.tile(np.eye(cm.P), (cm.K, 1, 1)))
     x = jnp.asarray(pta.initial_sample(np.random.default_rng(0)), cm.cdtype)
     b = jb.draw_b_fn(cmI, x, jr.key(0))
     tau = np.asarray(cmI.gw_tau(b))

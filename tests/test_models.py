@@ -134,7 +134,11 @@ def test_model_general_powerlaw_common_fixed_gamma(psrs8):
 
 def test_model_general_rejects_unsupported(j1713):
     with pytest.raises(NotImplementedError):
-        model_general([j1713], bayesephem=True)
+        model_general([j1713], tm_var=True)
+    with pytest.raises(NotImplementedError):
+        model_general([j1713], use_dmdata=True)
+    with pytest.raises(NotImplementedError):
+        model_general([j1713], red_psd="tprocess")
     with pytest.raises(TypeError):
         model_general([j1713], not_a_kwarg=1)
 
