@@ -39,12 +39,14 @@ natively:
 samples the latent coefficients explicitly (``bchain``) while conditionals
 use marginalized forms, and all device factorizations are dense Cholesky —
 the flags select between representations this framework already provides
-simultaneously.  ``tm_var``/``tm_linear`` raise ``NotImplementedError``
+simultaneously.  ``red_psd='tprocess'`` builds the t-process (powerlaw
+scaled by per-frequency InvGamma alphas, sampled by their exact conjugate
+conditional).  ``tm_var``/``tm_linear`` raise ``NotImplementedError``
 loudly (the reference's committed body leaves its signal model undefined
 when ``tm_var=True`` — ``model_definition.py:185-190`` only assigns ``s``
 in the ``not tm_var`` branch — so no working reference behavior exists to
 match); so do ``use_dmdata`` (requires wideband DM data this ingestion
-layer does not model) and the t-process PSDs.
+layer does not model) and ``tprocess_adapt``.
 """
 
 from __future__ import annotations
@@ -53,7 +55,7 @@ import numpy as np
 
 from ..data.dataset import get_tspan
 from .ephem import BayesEphemSignal
-from .priors import Constant, LinearExp, Uniform
+from .priors import Constant, InvGamma, LinearExp, Uniform
 from .selections import SELECTIONS
 from .pta import PTA, SignalModel
 from .signals import (DMAnnualSignal, EcorrBasisSignal, FourierGPSignal,
@@ -64,6 +66,16 @@ _PSD_HYPERS = {
     "turnover": ("log10_A", "gamma", "lf0", "kappa"),
     "turnover_knee": ("log10_A", "gamma", "lfb", "lfk", "kappa", "delta"),
     "broken_powerlaw": ("log10_A", "gamma", "delta", "log10_fb", "kappa"),
+}
+
+#: fixed values for the shape hypers beyond (log10_A, gamma) — per PSD,
+#: matching models/psd.py's own function defaults (varied only in
+#: specialised analyses, as in the reference's enterprise blocks)
+_PSD_SHAPE_DEFAULTS = {
+    "turnover": {"lf0": -8.5, "kappa": 10.0 / 3.0},
+    "turnover_knee": {"lfb": -8.5, "lfk": -8.0, "kappa": 10.0 / 3.0,
+                      "delta": 0.1},
+    "broken_powerlaw": {"delta": 0.0, "log10_fb": -8.5, "kappa": 0.1},
 }
 
 #: red_select band edges [MHz].  The reference delegates to enterprise
@@ -128,9 +140,11 @@ def model_general(psrs, tm_var=False, tm_linear=False, tmparam_list=None,
             f"dm_type={dm_type!r}: only the Gaussian-process DM model is "
             "implemented (the reference's other choices route through "
             "additional enterprise options it never exercises)")
-    if red_psd in ("tprocess", "tprocess_adapt"):
+    if red_psd == "tprocess_adapt":
         raise NotImplementedError(
-            f"red_psd={red_psd!r}: t-process PSDs are not implemented yet")
+            "red_psd='tprocess_adapt' (single adaptively-located alpha) is "
+            "not implemented; red_psd='tprocess' gives the full "
+            "per-frequency t-process with exact conjugate alpha draws")
     if red_breakflat and red_breakflat_fq is None:
         raise ValueError("red_breakflat=True requires red_breakflat_fq [Hz]")
     # coefficients / dense_like / tm_marg: accepted — see module docstring
@@ -179,10 +193,8 @@ def model_general(psrs, tm_var=False, tm_linear=False, tmparam_list=None,
                    else Uniform(0.0, 7.0, name=f"{gname}_gamma"))
             ps = [amp, gam]
             for hyper in _PSD_HYPERS[common_psd][2:]:
-                # fixed shape defaults, varied only in specialised analyses
-                ps.append(Constant({"lf0": -8.5, "kappa": 10 / 3, "lfb": -8.5,
-                                    "lfk": -8.0, "delta": 0.0, "log10_fb": -8.5,
-                                    }[hyper], name=f"{gname}_{hyper}"))
+                ps.append(Constant(_PSD_SHAPE_DEFAULTS[common_psd][hyper],
+                                   name=f"{gname}_{hyper}"))
             common_param_sets.append(ps)
         else:
             raise NotImplementedError(f"common_psd='{common_psd}'")
@@ -235,6 +247,16 @@ def model_general(psrs, tm_var=False, tm_linear=False, tmparam_list=None,
                                     size=red_components)]
                 if red_psd == "infinitepower":
                     return []
+                if red_psd == "tprocess":
+                    # per-frequency InvGamma(df/2, df/2) scale factors,
+                    # df=2 (enterprise_extensions t_process defaults);
+                    # sampled by their exact conjugate conditional
+                    amp_cls = (LinearExp if amp_prior_red == "uniform"
+                               else Uniform)
+                    return [amp_cls(-20.0, -11.0, name=f"{rname}_log10_A"),
+                            Uniform(0.0, 7.0, name=f"{rname}_gamma"),
+                            InvGamma(1.0, 1.0, name=f"{rname}_alphas",
+                                     size=red_components)]
                 if red_psd in _PSD_HYPERS:
                     amp_cls = (LinearExp if amp_prior_red == "uniform"
                                else Uniform)
@@ -283,15 +305,19 @@ def model_general(psrs, tm_var=False, tm_linear=False, tmparam_list=None,
         # 1400 MHz): dm_var = nu^-2 dispersion measure, dm_chrom =
         # nu^-dmchrom_idx scattering.  Own basis columns each.
         def chrom_gp(suffix, psd, components, index, prior):
-            if psd != "powerlaw":
+            if psd not in _PSD_HYPERS:
                 raise NotImplementedError(
-                    f"{suffix} psd='{psd}': chromatic GPs currently "
-                    "support the powerlaw PSD (their hypers join the "
-                    "adaptive MH block)")
+                    f"{suffix} psd='{psd}': chromatic GPs support the "
+                    "powerlaw-family PSDs (their amplitude/index hypers "
+                    "join the adaptive MH block; a free-spectrum chromatic "
+                    "block has no conditional sampler)")
             cname = f"{psr.name}_{suffix}"
             amp_cls = LinearExp if prior == "uniform" else Uniform
             ps = [amp_cls(-20.0, -11.0, name=f"{cname}_log10_A"),
                   Uniform(0.0, 7.0, name=f"{cname}_gamma")]
+            for hyper in _PSD_HYPERS[psd][2:]:
+                ps.append(Constant(_PSD_SHAPE_DEFAULTS[psd][hyper],
+                                   name=f"{cname}_{hyper}"))
             return FourierGPSignal(
                 psr.toas / 86400.0, components, Tspan, psd_name=psd,
                 psd_params=ps, name=cname, modes=grid,

@@ -12,9 +12,11 @@ Menu parity with ``blocks.common_red_noise_block``:
 
 - fixed two-point ORFs: ``crn``, ``hd``, ``dipole``, ``monopole``,
   ``gw_monopole``, ``gw_dipole``, ``st`` (scalar transverse), and their
-  ``zero_diag_*`` variants (cross-correlations only — buildable for
-  detection-style studies, but not positive definite, so the sampler
-  rejects them just as the reference's sampler handles no ORF at all)
+  ``zero_diag_*`` variants.  All are buildable; only positive-definite
+  ones are *samplable* (hd, freq_hd, st, gw_monopole, gw_dipole).
+  ``monopole``/``dipole`` (exactly rank-1 / rank-<=3) and the zero-diag
+  detection variants yield degenerate priors and are rejected with a
+  precise error — the reference's sampler handles no ORF at all
 - ``param_hd``, ``bin_orf``, ``legendre_orf``: ORFs with *sampled* shape
   parameters — buildable rejection with a loud error (the reference can
   construct them via enterprise but its Gibbs sampler cannot sample any
@@ -149,8 +151,16 @@ def orf_ginv_stack(name: str, positions, K: int,
     Gk = orf_matrix_per_freq(name, positions, K, orf_ifreq=orf_ifreq)
     wmin = float(np.linalg.eigvalsh(Gk).min())
     if wmin <= 1e-10:
+        reason = (
+            "zero-diag/cross-correlation-only ORFs are detection-statistic "
+            "constructions" if name.startswith("zero_diag_") else
+            "this correlation matrix is rank-deficient (monopole is rank 1, "
+            "dipole rank <= 3: the common process collapses onto a "
+            "lower-dimensional subspace), so the coefficient prior is "
+            "degenerate")
         raise NotImplementedError(
-            f"orf='{name}' is not positive definite (min eigenvalue "
-            f"{wmin:.2e}); zero-diag/cross-correlation-only ORFs are "
-            "detection-statistic constructions, not samplable priors")
+            f"orf='{name}' cannot serve as a Gibbs sampling prior: {reason} "
+            f"(min eigenvalue {wmin:.2e}).  The reference cannot sample any "
+            "correlated ORF either; positive-definite choices here: hd, "
+            "freq_hd, st, gw_monopole, gw_dipole")
     return np.linalg.inv(Gk)

@@ -108,6 +108,38 @@ class LinearExp(Parameter):
         return f"{self.name}:LinearExp(pmin={self.pmin}, pmax={self.pmax})"
 
 
+class InvGamma(Parameter):
+    """Inverse-gamma prior, ``x ~ InvGamma(shape, rate)``: density
+    ``rate^shape / Gamma(shape) x^-(shape+1) exp(-rate/x)``.
+
+    Used for the per-frequency scale factors of the t-process red PSD
+    (enterprise_extensions ``t_process`` draws ``alphas ~ InvGamma(df/2,
+    df/2)``, default df=2); conjugate to the Gaussian coefficient
+    likelihood, so the Gibbs alpha-block is an exact draw."""
+
+    def __init__(self, shape: float = 1.0, rate: float = 1.0, name: str = "",
+                 size: int | None = None):
+        super().__init__(name, size)
+        self.shape, self.rate = float(shape), float(rate)
+
+    def _sample1(self, rng, shape):
+        return self.rate / rng.gamma(self.shape, size=shape)
+
+    def _logpdf(self, x):
+        from scipy.special import gammaln
+
+        with np.errstate(divide="ignore", invalid="ignore"):
+            lp = (self.shape * np.log(self.rate) - gammaln(self.shape)
+                  - (self.shape + 1.0) * np.log(x) - self.rate / x)
+        return np.where(x > 0, lp, -np.inf)
+
+    def _scalar(self, name):
+        return InvGamma(self.shape, self.rate, name=name)
+
+    def __repr__(self):
+        return f"{self.name}:InvGamma(shape={self.shape}, rate={self.rate})"
+
+
 class Constant(Parameter):
     """Fixed value; excluded from ``PTA.params`` (and hence the chain)."""
 

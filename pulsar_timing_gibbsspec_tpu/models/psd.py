@@ -74,3 +74,16 @@ def infinitepower(f, df):
     """Effectively-unconstrained prior variance for marginalized bases
     (timing model); kept in log space device-side to stay f32-safe."""
     return np.full_like(np.asarray(f, dtype=np.float64), 1e40)
+
+
+def tprocess(f, df, log10_A, gamma, alphas):
+    """t-process: powerlaw scaled per frequency by inverse-gamma-distributed
+    ``alphas`` (enterprise_extensions ``t_process``; the reference advertises
+    it in the ``red_psd`` menu, ``model_definition.py:103-105``).  Each
+    frequency's marginal coefficient prior becomes Student-t, robustifying
+    the powerlaw against single-bin outliers.  ``alphas`` has one entry per
+    frequency, repeated over the sin/cos pair."""
+    xp = np
+    if not isinstance(alphas, np.ndarray):
+        import jax.numpy as xp  # noqa: F811 — traced path
+    return powerlaw(f, df, log10_A, gamma) * xp.repeat(xp.asarray(alphas), 2)

@@ -33,7 +33,7 @@ import numpy as np
 
 from ..config import settings
 from ..models import psd as psdmod
-from ..models.priors import Constant, LinearExp, Normal, Uniform
+from ..models.priors import Constant, InvGamma, LinearExp, Normal, Uniform
 from .blocks import BlockIndex, rho_bounds
 
 #: prior-variance stand-in for "infinite" (marginalized timing-model
@@ -205,8 +205,10 @@ class CompiledPTA:
     red_rhomin: float
     red_rhomax: float
     #: common-process ORF: 'crn' keeps the per-pulsar block-diagonal path;
-    #: anything else (hd/dipole/monopole) activates the joint cross-pulsar
-    #: b-draw and the quadratic-form rho conditional
+    #: any other positive-definite ORF (hd/freq_hd/st/gw_monopole/
+    #: gw_dipole) activates the joint cross-pulsar b-draw and the
+    #: quadratic-form rho conditional (rank-deficient ORFs are rejected
+    #: in orf_ginv_stack)
     orf_name: str = "crn"
     orf_Ginv: object = None    # (K, P, P) per-frequency inverse ORF stack
                                # (identity pads; constant over K for fixed
@@ -215,6 +217,8 @@ class CompiledPTA:
     #: set whose N(0, phi(x)) prior is the generic b-conditional
     #: likelihood of the powerlaw-family hyper MH block
     gp_mask: object = None
+    red_f: object = None       # (P, Kr) red-grid frequencies (tprocess)
+    red_df: object = None      # (P, Kr) red-grid bin widths
 
     # =======================================================================
     # device-side pure functions (jit/vmap-safe; arrays close over as consts)
@@ -266,6 +270,13 @@ class CompiledPTA:
                 vals = 10.0 ** (2.0 * xev[c.rho_ix])
             elif c.kind == "infinitepower":
                 vals = jnp.full(c.cols.shape, BIG_PHI["f32"], dtype)
+            elif c.kind == "tprocess":
+                # powerlaw scaled by per-frequency InvGamma alphas
+                # (rho_ix carries the alpha gathers, one per column)
+                args = [xev[c.hyp_ix[:, h]][:, None]
+                        for h in range(c.hyp_ix.shape[1])]
+                vals = jnp.exp(_lnphi_powerlaw(c.f, c.df, *args)) \
+                    * xev[c.rho_ix]
             else:
                 fn = _LNPSD_FNS[c.kind]
                 args = [xev[c.hyp_ix[:, h]][:, None]
@@ -317,8 +328,16 @@ class CompiledPTA:
         dens = (np.log(10.0) * 10.0 ** x
                 / (10.0 ** self.pb - 10.0 ** self.pa))
         lp_l = jnp.where(inside, jnp.log(dens), ninf)
+        from jax.scipy.special import gammaln
+
+        xp = jnp.maximum(x, 1e-30)
+        lp_g = jnp.where(
+            x > 0,
+            self.pa * jnp.log(self.pb) - gammaln(self.pa)
+            - (self.pa + 1.0) * jnp.log(xp) - self.pb / xp, ninf)
         per = jnp.where(self.pkind == 0, lp_u,
-                        jnp.where(self.pkind == 1, lp_n, lp_l))
+                        jnp.where(self.pkind == 1, lp_n,
+                                  jnp.where(self.pkind == 2, lp_l, lp_g)))
         return jnp.sum(per)
 
     def coord_logpdf(self, j, v):
@@ -339,7 +358,14 @@ class CompiledPTA:
                 - jnp.log(b_ * np.sqrt(2.0 * np.pi)))
         dens = np.log(10.0) * 10.0 ** v / (10.0 ** b_ - 10.0 ** a)
         lp_l = jnp.where(inside, jnp.log(dens), ninf)
-        return jnp.where(kind == 0, lp_u, jnp.where(kind == 1, lp_n, lp_l))
+        from jax.scipy.special import gammaln
+
+        vp = jnp.maximum(v, 1e-30)
+        lp_g = jnp.where(v > 0, a * jnp.log(b_) - gammaln(a)
+                         - (a + 1.0) * jnp.log(vp) - b_ / vp, ninf)
+        return jnp.where(kind == 0, lp_u,
+                         jnp.where(kind == 1, lp_n,
+                                   jnp.where(kind == 2, lp_l, lp_g)))
 
     def gw_tau(self, b):
         """(P, K) per-frequency ``(b_sin^2 + b_cos^2)/2``
@@ -405,6 +431,13 @@ class CompiledPTA:
             out = jnp.full((self.P, self.K), PHI_FLOOR, dtype=self.cdtype)
             n = min(self.K, Kr)
             out = out.at[:, :n].set(vals[:, :n])
+        elif self.red_kind == "tprocess":
+            args = [xev[self.red_hyp_ix[:, h]][:, None] for h in range(2)]
+            vals = (jnp.exp(_lnphi_powerlaw(self.red_f, self.red_df, *args))
+                    * xev[self.red_rho_ix])              # (P, Kr)
+            out = jnp.full((self.P, self.K), PHI_FLOOR, dtype=self.cdtype)
+            n = min(self.K, self.red_rho_ix.shape[1])
+            out = out.at[:, :n].set(jnp.maximum(vals[:, :n], PHI_FLOOR))
         else:
             fn = _LNPSD_FNS[self.red_kind]
             args = [xev[self.red_hyp_ix[:, h]][:, None]
@@ -529,6 +562,10 @@ def compile_pta(pta, pad_pulsars: int | None = None) -> CompiledPTA:
             if kind == "free_spectrum":
                 p = s.params[0]
                 rho = [ref(p, elem=j // 2) for j in range(len(cols))]
+            elif kind == "tprocess":
+                hyp = [ref(p) for p in s.params[:2]]       # log10_A, gamma
+                alphas = s.params[2]
+                rho = [ref(alphas, elem=j // 2) for j in range(len(cols))]
             else:
                 hyp = [ref(p) for p in s.params]
             rows.append((cols, f, df, hyp, rho))
@@ -635,11 +672,13 @@ def compile_pta(pta, pad_pulsars: int | None = None) -> CompiledPTA:
             if not isinstance(p, Constant):
                 rho_ix_x = _as_i32([pos[f"{p.name}_{k}"] for k in range(K)])
 
+    red_f = red_df = None
     if any(fsig(m, "red") for m in models):
         sigs = [fsig(m, "red") for m in models]
         red_kind = next(s.psd_name for s in sigs if s is not None)
         Kr = max(len(s.freqs) // 2 for s in sigs if s is not None)
-        Hr = max((len(s.params) for s in sigs
+        Hr = max((2 if s.psd_name == "tprocess" else len(s.params)
+                  for s in sigs
                   if s is not None and s.psd_name != "free_spectrum"),
                  default=0)
         red_hyp = np.full((P, max(Hr, 1)), sentinel, np.int32)
@@ -647,6 +686,8 @@ def compile_pta(pta, pad_pulsars: int | None = None) -> CompiledPTA:
         red_rho_x = np.full((P, Kr), nx, np.int32)  # pad -> dropped scatter
         red_sin = np.zeros((P, Kr), np.int32)
         red_cos = np.zeros((P, Kr), np.int32)
+        red_f = np.ones((P, Kr), np_dtype)
+        red_df = np.zeros((P, Kr), np_dtype)
         for ii, (m, s) in enumerate(zip(models, sigs)):
             if s is None:
                 continue
@@ -655,12 +696,24 @@ def compile_pta(pta, pad_pulsars: int | None = None) -> CompiledPTA:
             cols = np.arange(sl_.start, sl_.stop)
             red_sin[ii, :len(cols) // 2] = cols[::2]
             red_cos[ii, :len(cols) // 2] = cols[1::2]
+            red_f[ii, :len(cols) // 2] = s.freqs[::2]
+            red_df[ii, :len(cols) // 2] = s._df[::2]
             if red_kind == "free_spectrum":
                 p = s.params[0]
                 kp = min(Kr, p.size or 1)
                 red_rho[ii, :kp] = [ref(p, elem=k) for k in range(kp)]
                 if not isinstance(p, Constant):
                     red_rho_x[ii, :kp] = [pos[f"{p.name}_{k}"]
+                                          for k in range(kp)]
+            elif red_kind == "tprocess":
+                # hypers = (log10_A, gamma); alpha gathers ride red_rho
+                # and the conjugate draw writes back through red_rho_ix_x
+                red_hyp[ii, :2] = [ref(p) for p in s.params[:2]]
+                alphas = s.params[2]
+                kp = min(Kr, alphas.size or 1)
+                red_rho[ii, :kp] = [ref(alphas, elem=k) for k in range(kp)]
+                if not isinstance(alphas, Constant):
+                    red_rho_x[ii, :kp] = [pos[f"{alphas.name}_{k}"]
                                           for k in range(kp)]
             else:
                 red_hyp[ii, :len(s.params)] = [ref(p) for p in s.params]
@@ -707,6 +760,8 @@ def compile_pta(pta, pad_pulsars: int | None = None) -> CompiledPTA:
             kind, a, b_ = 1, p.mu, p.sigma
         elif isinstance(p, LinearExp):
             kind, a, b_ = 2, p.pmin, p.pmax
+        elif isinstance(p, InvGamma):
+            kind, a, b_ = 3, p.shape, p.rate
         else:
             raise NotImplementedError(
                 f"prior {type(p).__name__} not supported on device")
@@ -721,7 +776,10 @@ def compile_pta(pta, pad_pulsars: int | None = None) -> CompiledPTA:
     # scale-mixture's upper end), unlike the reference's dimension-scaled
     # sigma = 0.05 * blockdim (pulsar_gibbs.py:346) which under-steps small
     # per-pulsar blocks started far from the mode
-    prop_scale = np.where(pkind == 1, pb, 0.1 * np.abs(pb - pa))
+    # (kind 3 = InvGamma alphas: never MH-proposed — conjugate draws —
+    # but give them a nonzero scale anyway so no block can freeze)
+    prop_scale = np.where((pkind == 1) | (pkind == 3), pb,
+                          0.1 * np.abs(pb - pa))
 
     try:
         rhomin, rhomax = rho_bounds(pta, "gw")
@@ -810,6 +868,10 @@ def compile_pta(pta, pad_pulsars: int | None = None) -> CompiledPTA:
                            else np.zeros((P, max(Kr, 1)))),
         red_cos_ix=_as_i32(red_cos if red_cos is not None
                            else np.zeros((P, max(Kr, 1)))),
+        red_f=(red_f if red_f is not None
+               else np.ones((P, max(Kr, 1)), np_dtype)),
+        red_df=(red_df if red_df is not None
+                else np.zeros((P, max(Kr, 1)), np_dtype)),
         ec_cols=ec_cols, ec_ix=ec_ix,
         white_par_ix=white_par_ix, white_nper=white_nper,
         ecorr_par_ix=ecorr_par_ix, ecorr_nper=ecorr_nper,

@@ -757,6 +757,57 @@ def red_conditional_update(cm: CompiledPTA, x, b, key):
         (0.5 * jnp.log10(rhonew)).astype(x.dtype), mode="drop")
 
 
+#: log10 bounds and size of the alpha grid for the t-process conditional;
+#: the InvGamma(1, 1) prior holds ~all its mass in [1e-4, 1e4] and the
+#: likelihood tail decays as alpha^-2 past tau/plaw, so the grid brackets
+#: every non-negligible posterior
+TP_ALPHA_LOG10_MIN = -4.0
+TP_ALPHA_LOG10_MAX = 10.0
+TP_ALPHA_GRID = 1000
+
+
+def tprocess_alpha_update(cm: CompiledPTA, x, b, key):
+    """Per-frequency draw of the t-process scale factors.
+
+    The shared Fourier columns carry ``phi_j = rho_gw,j + alpha_j
+    plaw_j`` (common + intrinsic contributions are additive there), so
+    the alpha conditional under the ``InvGamma(1, 1)`` prior
+    (enterprise_extensions ``t_process``, df=2) is
+
+        p(alpha | b) ~ alpha^-2 e^(-1/alpha)
+                       (o_j + alpha plaw_j)^-1 exp(-tau_j/(o_j + alpha plaw_j))
+
+    with ``o_j`` the common-process variance aligned to the red grid and
+    ``tau_j = (b_sin^2 + b_cos^2)/2``.  Sampled by Gumbel-max on a
+    log-uniform grid — the same mechanism as the rho conditionals (it
+    reduces to the exact conjugate InvGamma(2, 1 + tau/plaw) draw as
+    ``o -> 0``).  A Gibbs block the reference never had (its t-process
+    models could only be sampled by generic MH through enterprise)."""
+    import jax.numpy as jnp
+    import jax.random as jr
+
+    from .compiled import _lnphi_powerlaw
+
+    fdt = cm.dtype
+    xev = cm.xe(x)
+    tau = cm.red_tau(b)                                   # (P, Kr)
+    args = [xev[cm.red_hyp_ix[:, h]][:, None] for h in range(2)]
+    lnplaw = _lnphi_powerlaw(cm.red_f, cm.red_df, *args)  # (P, Kr)
+    other = cm.gw_phi_at_red(x)                           # (P, Kr)
+    grid = 10.0 ** jnp.linspace(TP_ALPHA_LOG10_MIN, TP_ALPHA_LOG10_MAX,
+                                TP_ALPHA_GRID, dtype=cm.cdtype)
+    # log phi on the grid, computed in log space to stay range-safe
+    lnvar = jnp.logaddexp(jnp.log(other)[:, :, None],
+                          lnplaw[:, :, None] + jnp.log(grid)[None, None, :])
+    # point mass on the log-spaced grid = density(alpha) * alpha (Jacobian):
+    # prior alpha^-2 e^(-1/alpha) contributes -2 ln a + ln a = -ln a
+    logpdf = (-jnp.log(grid)[None, None, :] - 1.0 / grid[None, None, :]
+              - lnvar - tau[:, :, None] * jnp.exp(-lnvar)).astype(fdt)
+    gum = jr.gumbel(key, logpdf.shape, dtype=fdt)
+    alpha = grid[jnp.argmax(logpdf + gum, axis=-1)]       # (P, Kr)
+    return x.at[cm.red_rho_ix_x].set(alpha.astype(x.dtype), mode="drop")
+
+
 #: every EXACT_EVERY-th sweep uses the exact f64 b-draw instead of the
 #: Metropolised f32-proposal draw, bounding how long an occasional
 #: ill-conditioned proposal can leave a pulsar's coefficients unmoved
@@ -946,9 +997,14 @@ class JaxGibbsDriver:
         # block activation follows the compiled model structure (mirrors the
         # oracle sweeps): a red free-spectrum block gets the per-pulsar grid
         # draw, any powerlaw-family hypers (per-pulsar red and/or a varied
-        # common process) get the adaptive MH block — independently
-        self.do_red_conditional = bool(np.any(np.asarray(cm.red_rho_ix_x)
-                                              < cm.nx))
+        # common process) get the adaptive MH block, t-process alphas get
+        # their exact conjugate draw — independently
+        self.do_tprocess = (cm.red_kind == "tprocess"
+                            and bool(np.any(np.asarray(cm.red_rho_ix_x)
+                                            < cm.nx)))
+        self.do_red_conditional = (not self.do_tprocess
+                                   and bool(np.any(np.asarray(cm.red_rho_ix_x)
+                                                   < cm.nx)))
         self.do_red_mh = len(cm.idx.red) > 0
 
         # flat (pulsar, col) gather that turns padded (P, Bmax) b arrays
@@ -1123,6 +1179,11 @@ class JaxGibbsDriver:
             x = jax.jit(jax.vmap(
                 lambda x, b, k: red_conditional_update(cm, x, b, k)))(
                     x, b, self._chain_keys(k))
+        if self.do_tprocess:
+            self.key, k = jr.split(self.key)
+            x = jax.jit(jax.vmap(
+                lambda x, b, k: tprocess_alpha_update(cm, x, b, k)))(
+                    x, b, self._chain_keys(k))
         if self.do_red_mh:
             # covariance adaptation on the marginalized likelihood
             # (replaces the reference's scratch PTMCMCSampler,
@@ -1234,7 +1295,7 @@ class JaxGibbsDriver:
             (chol_w, mode_w, asq_w, chol_e, mode_e, asq_e,
              red_U, red_S) = aux
             out = (x, b)
-            k = jr.split(key, 6)
+            k = jr.split(key, 7)
             if len(cm.idx.white) and nw:
                 # the cached u = T b makes the white residual free
                 r = jnp.asarray(cm.y) - u
@@ -1250,6 +1311,8 @@ class JaxGibbsDriver:
                     mode=mode_e, asqrt=asq_e)
             if self.do_red_conditional:
                 x = red_conditional_update(cm, x, b, k[2])
+            if self.do_tprocess:
+                x = tprocess_alpha_update(cm, x, b, k[6])
             if self.do_red_mh:
                 x = red_mh_block(cm, x, b, k[5], red_U, red_S,
                                  self.red_steps)
@@ -1284,7 +1347,7 @@ class JaxGibbsDriver:
         def body(carry, key, aux, t):
             x, b, u = carry
             out = (x, b)
-            k = jr.split(key, 6)
+            k = jr.split(key, 7)
             if len(cm.idx.white):
                 # Laplace proposal square roots recomputed at the current
                 # state each warmup sweep (W HVPs + a batched WxW eigh,
@@ -1308,6 +1371,8 @@ class JaxGibbsDriver:
                     cm.ecorr_nper, chol, nw, record=False)
             if self.do_red_conditional:
                 x = red_conditional_update(cm, x, b, k[2])
+            if self.do_tprocess:
+                x = tprocess_alpha_update(cm, x, b, k[6])
             if self.do_red_mh:
                 _, phi_dyn = cm.phi_hyper_split(x)
                 x, _ = mh_scan(cm, x, k[5],

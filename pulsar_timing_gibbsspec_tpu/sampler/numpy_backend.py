@@ -70,9 +70,16 @@ class NumpyGibbs:
         # generic hyper-MH block, not the red conditional machinery
         self.red_sig = next((s for s in self._model._fourier
                              if "red" in s.name), None)
+        self._alpha_idx = None
         if self.red_sig is not None:
             rsl = self._model._slices[self.red_sig.name]
             self.redid = np.arange(rsl.start, rsl.stop)
+            if self.red_sig.psd_name == "tprocess":
+                alphas = self.red_sig.params[2]
+                names = pta.param_names
+                self._alpha_idx = np.array(
+                    [names.index(f"{alphas.name}_{k}")
+                     for k in range(alphas.size)])
         self.gw_sig = next((s for s in self._model.signals if "gw" in s.name), None)
         if len(self.idx.rho) and len(self.idx.rho) != len(self.gwid) // 2:
             raise ValueError(
@@ -325,6 +332,36 @@ class NumpyGibbs:
             self.rng, rho_log_pdf_grid(tau, gw, grid), grid))
         return xnew
 
+    def update_tprocess_alpha(self, xs):
+        """Grid draw of the t-process scale factors from their conditional
+        including the shared common-process variance: ``p(alpha | b) ~
+        alpha^-2 e^(-1/alpha) (o + alpha plaw)^-1 e^(-tau/(o + alpha
+        plaw))`` (see ``jax_backend.tprocess_alpha_update``; reduces to
+        the conjugate ``InvGamma(2, 1 + tau/plaw)`` as ``o -> 0``)."""
+        from ..models import psd as psdmod
+        from .jax_backend import (TP_ALPHA_GRID, TP_ALPHA_LOG10_MAX,
+                                  TP_ALPHA_LOG10_MIN)
+
+        xnew = xs.copy()
+        params = self.map_params(xnew)
+        bb = self.b[self.redid] ** 2
+        tau = 0.5 * (bb[::2] + bb[1::2])
+        A = params[self.red_sig.params[0].name]
+        gam = params[self.red_sig.params[1].name]
+        plaw = psdmod.powerlaw(self.red_sig.freqs[::2],
+                               self.red_sig._df[::2], A, gam)
+        other = (align_phi(np.asarray(self.gw_sig.get_phi(params))[::2],
+                           len(tau))
+                 if self.gw_sig is not None else np.full(len(tau), 1e-30))
+        grid = 10.0 ** np.linspace(TP_ALPHA_LOG10_MIN, TP_ALPHA_LOG10_MAX,
+                                   TP_ALPHA_GRID)
+        var = other[:, None] + plaw[:, None] * grid[None, :]
+        # log-grid point mass = density * alpha (Jacobian): -2 ln a + ln a
+        logpdf = (-np.log(grid)[None, :] - 1.0 / grid[None, :]
+                  - np.log(var) - tau[:, None] / var)
+        xnew[self._alpha_idx] = gumbel_grid_draw(self.rng, logpdf, grid)
+        return xnew
+
     def update_ecorr(self, xs, adapt=False):
         """ECORR block via MH on the b-conditional likelihood — the update
         the reference disables as broken (``pulsar_gibbs.py:409-486,676-683``)
@@ -356,6 +393,8 @@ class NumpyGibbs:
             x = self.update_ecorr(x, adapt=first)
         if len(self.idx.red_rho):
             x = self.update_red_rho(x)
+        if self._alpha_idx is not None:
+            x = self.update_tprocess_alpha(x)
         if len(self.idx.red):
             x = self.update_red(x, adapt=first)
         if len(self.idx.rho):

@@ -61,6 +61,7 @@ class NumpyPTAGibbs:
         #: free-spectrum parameters — located by NAME, not model order, since
         #: pta.param_names is name-sorted while pulsars keep insertion order
         self.red_rho_idx = []
+        self.alpha_idx = []          # t-process per-frequency scale factors
         names = pta.param_names
         for pname in pta.pulsars:
             m = pta.model(pname)
@@ -86,6 +87,10 @@ class NumpyPTAGibbs:
             self.red_rho_idx.append(np.array(
                 [ii for ii, nm in enumerate(names)
                  if nm.startswith(f"{pname}_red_noise_log10_rho")], dtype=np.int64))
+            self.alpha_idx.append(np.array(
+                [ii for ii, nm in enumerate(names)
+                 if nm.startswith(f"{pname}_red_noise_alphas")],
+                dtype=np.int64))
         if len(self.idx.rho) and len(self.idx.rho) != len(self.gwid[0]) // 2:
             raise ValueError(
                 "the common conditional rho draw requires exactly one "
@@ -352,6 +357,37 @@ class NumpyPTAGibbs:
             return xnew
         return xs.copy()
 
+    def update_tprocess_alpha(self, xs):
+        """Per-pulsar grid draw of t-process scale factors from the
+        conditional including the shared common-process variance
+        (see ``numpy_backend.NumpyGibbs.update_tprocess_alpha``)."""
+        from ..models import psd as psdmod
+        from .jax_backend import (TP_ALPHA_GRID, TP_ALPHA_LOG10_MAX,
+                                  TP_ALPHA_LOG10_MIN)
+
+        xnew = xs.copy()
+        params = self.map_params(xnew)
+        grid = 10.0 ** np.linspace(TP_ALPHA_LOG10_MIN, TP_ALPHA_LOG10_MAX,
+                                   TP_ALPHA_GRID)
+        for ii in range(self.P):
+            sig = self.red_sigs[ii]
+            if sig is None or not len(self.alpha_idx[ii]):
+                continue
+            bb = self.b[ii][self.redid[ii]] ** 2
+            tau = 0.5 * (bb[::2] + bb[1::2])
+            A = params[sig.params[0].name]
+            gam = params[sig.params[1].name]
+            plaw = psdmod.powerlaw(sig.freqs[::2], sig._df[::2], A, gam)
+            other = align_phi(
+                np.asarray(self.gw_sigs[ii].get_phi(params))[::2], len(tau))
+            var = other[:, None] + plaw[:, None] * grid[None, :]
+            # log-grid point mass = density * alpha: -2 ln a + ln a
+            logpdf = (-np.log(grid)[None, :] - 1.0 / grid[None, :]
+                      - np.log(var) - tau[:, None] / var)
+            xnew[self.alpha_idx[ii]] = gumbel_grid_draw(self.rng, logpdf,
+                                                        grid)
+        return xnew
+
     def update_red_mh(self, xs, adapt=False):
         """Powerlaw-family hyper block (per-pulsar red and/or a varied
         common process): adaptive MH as in the single-pulsar sampler."""
@@ -447,6 +483,8 @@ class NumpyPTAGibbs:
             x = self.update_ecorr(x, adapt=first)
         if len(self.idx.red_rho):
             x = self.update_red(x, adapt=first)
+        if any(len(a) for a in self.alpha_idx):
+            x = self.update_tprocess_alpha(x)
         if len(self.idx.red):
             x = self.update_red_mh(x, adapt=first)
         if len(self.idx.rho):

@@ -69,6 +69,21 @@ def test_dm_hypers_join_mh_block_and_compile(dm_psr):
                                   gp_cols)
 
 
+def test_dm_turnover_psd_builds_and_samples(dm_psr, tmp_path):
+    """Chromatic GPs accept the full powerlaw-family PSD menu (reference
+    dm_psd includes 'turnover'); extra shape hypers are fixed Constants."""
+    pta = model_general([dm_psr], tm_svd=True, red_var=False,
+                        white_vary=False, common_psd="spectrum",
+                        common_components=5, dm_var=True,
+                        dm_psd="turnover", dm_components=5)
+    assert any("dm_gp_log10_A" in n for n in pta.param_names)
+    assert not any("lf0" in n for n in pta.param_names)   # Constant shape
+    g = PulsarBlockGibbs(pta, backend="jax", seed=9, progress=False)
+    c = g.sample(pta.initial_sample(np.random.default_rng(3)),
+                 outdir=str(tmp_path / "t"), niter=80)
+    assert np.all(np.isfinite(c))
+
+
 def test_chrom_and_gequad_build_and_sample(dm_psr, tmp_path):
     """dm_chrom (nu^-4 scattering GP) and gequad (global EQUAD) reach the
     right blocks on both backends and produce matched finite chains."""

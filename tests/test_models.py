@@ -138,7 +138,7 @@ def test_model_general_rejects_unsupported(j1713):
     with pytest.raises(NotImplementedError):
         model_general([j1713], use_dmdata=True)
     with pytest.raises(NotImplementedError):
-        model_general([j1713], red_psd="tprocess")
+        model_general([j1713], red_psd="tprocess_adapt")
     with pytest.raises(TypeError):
         model_general([j1713], not_a_kwarg=1)
 
