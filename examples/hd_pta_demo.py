@@ -29,6 +29,9 @@ def main():
     ap.add_argument("--npsr", type=int, default=6)
     ap.add_argument("--nbins", type=int, default=5)
     ap.add_argument("--backend", default="jax", choices=["jax", "numpy"])
+    ap.add_argument("--red", action="store_true",
+                    help="add per-pulsar intrinsic red free spectra "
+                    "(correlated gw keeps its own basis columns)")
     args = ap.parse_args()
 
     from pulsar_timing_gibbsspec_tpu import model_general
@@ -44,7 +47,9 @@ def main():
           f"[{min(hd(a.pos, b.pos) for i, a in enumerate(psrs) for b in psrs[i+1:]):.2f}, "
           f"{max(hd(a.pos, b.pos) for i, a in enumerate(psrs) for b in psrs[i+1:]):.2f}]")
 
-    pta = model_general(psrs, tm_svd=True, red_var=False, white_vary=False,
+    pta = model_general(psrs, tm_svd=True, red_var=args.red,
+                        red_psd="spectrum", red_components=args.nbins,
+                        white_vary=False,
                         common_psd="spectrum", common_components=args.nbins,
                         orf="hd")
     gibbs = PTABlockGibbs(pta, backend=args.backend, seed=0)

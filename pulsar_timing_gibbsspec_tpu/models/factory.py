@@ -222,11 +222,17 @@ def model_general(psrs, tm_var=False, tm_linear=False, tmparam_list=None,
             shift_seed = zlib.crc32(repr((pseed or 0, psr.name)).encode())
 
         for orf_nm, orf_el, ps in zip(orf_name_list, orf_list, common_param_sets):
+            # correlated processes keep their own basis columns (disjoint
+            # from intrinsic red) so the cross-pulsar prior on them is
+            # purely rho_k G — exact HD + red sampling; CRN processes
+            # share the red grid, the reference sampler's own convention
             sigs.append(FourierGPSignal(
                 psr.toas / 86400.0, common_components, Tspan,
                 psd_name=common_psd, psd_params=ps, name=f"gw_{orf_nm}",
                 modes=grid, orf_name=orf_el, orf_ifreq=orf_ifreq,
-                leg_lmax=leg_lmax, pshift_seed=shift_seed, wgts=wgts))
+                leg_lmax=leg_lmax, pshift_seed=shift_seed, wgts=wgts,
+                share_group=("fourier" if orf_el == "crn"
+                             else f"gw_{orf_nm}")))
 
         if red_var:
             red_name_psd = red_psd

@@ -55,12 +55,20 @@ class SignalModel:
             blocks.append(B)
             self._slices[s.name] = slice(off, off + B.shape[1])
             off += B.shape[1]
-        if self._fourier:
-            widths = [s.get_basis().shape[1] for s in self._fourier]
+        # shared-grid Fourier signals share columns within their
+        # share_group (one block per group, donor = widest member); a
+        # correlated common process carries its own group so its columns
+        # stay disjoint from intrinsic red (see FourierGPSignal)
+        groups: dict = {}
+        for s in self._fourier:
+            groups.setdefault(getattr(s, "share_group", "fourier"),
+                              []).append(s)
+        for members in groups.values():
+            widths = [s.get_basis().shape[1] for s in members]
             wmax = max(widths)
-            donor = self._fourier[int(np.argmax(widths))]
+            donor = members[int(np.argmax(widths))]
             blocks.append(donor.get_basis())
-            for s in self._fourier:
+            for s in members:
                 self._slices[s.name] = slice(off, off + s.get_basis().shape[1])
             off += wmax
         for s in self._chrom + self._ecorr:

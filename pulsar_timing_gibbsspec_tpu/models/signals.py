@@ -103,7 +103,8 @@ class FourierGPSignal(BasisSignal):
                  psd_params: list, name: str, modes=None, orf_name: str = "crn",
                  radio_freqs=None, chrom_index: float | None = None,
                  row_mask=None, pshift_seed=None, wgts=None,
-                 orf_ifreq: int = 0, leg_lmax: int = 5):
+                 orf_ifreq: int = 0, leg_lmax: int = 5,
+                 share_group: str = "fourier"):
         self.name = name
         self.params = list(psd_params)
         self.psd_name = psd_name
@@ -112,6 +113,14 @@ class FourierGPSignal(BasisSignal):
         # legendre_orf families; inert for other ORFs, as in the reference)
         self.orf_ifreq = int(orf_ifreq)
         self.leg_lmax = int(leg_lmax)
+        #: achromatic signals in the same share_group share basis columns
+        #: (phi adds there — marginally identical to separate columns with
+        #: separate phis).  A correlated common process gets its own group
+        #: so its columns stay disjoint from intrinsic red: the joint
+        #: cross-pulsar prior is then purely rho_k G on those columns
+        #: while red keeps a per-pulsar diagonal — what makes HD + red
+        #: sampling exact with the existing machinery.
+        self.share_group = share_group
         self.nmodes = nmodes
         self.Tspan = Tspan
         self.chromatic = chrom_index is not None

@@ -137,6 +137,18 @@ def rho_log_pdf_grid(tau, other, grid):
     return logratio - np.exp(logratio)
 
 
+def tprocess_alpha_log_pdf_grid(tau, plaw, other, grid):
+    """log point-mass of the t-process scale factors on a log-spaced alpha
+    grid: InvGamma(1,1) prior times the 2-coefficient Gaussian likelihood
+    with variance ``other + alpha * plaw``, including the log-grid
+    Jacobian (point mass = density * alpha: -2 ln a + ln a = -ln a).
+    Shared by both NumPy oracles and mirrored by
+    ``jax_backend.tprocess_alpha_update``."""
+    var = other[:, None] + plaw[:, None] * grid[None, :]
+    return (-np.log(grid)[None, :] - 1.0 / grid[None, :]
+            - np.log(var) - tau[:, None] / var)
+
+
 def gumbel_grid_draw(rng, logpdf, grid):
     """Sample one grid point per row via the Gumbel-max trick (== inverse
     CDF on the discrete pdf, reference ``pulsar_gibbs.py:233-234``)."""

@@ -219,6 +219,10 @@ class CompiledPTA:
     gp_mask: object = None
     red_f: object = None       # (P, Kr) red-grid frequencies (tprocess)
     red_df: object = None      # (P, Kr) red-grid bin widths
+    #: True when intrinsic red and the common process share basis columns
+    #: (the CRN layout); False for correlated ORFs, whose processes keep
+    #: their own columns — then the red conditionals see no gw 'other'
+    red_shares_gw: bool = True
 
     # =======================================================================
     # device-side pure functions (jit/vmap-safe; arrays close over as consts)
@@ -399,14 +403,17 @@ class CompiledPTA:
         return 0.5 * (bs * bs + bc * bc)
 
     def gw_phi_at_red(self, x):
-        """(P, Kr) common-process phi aligned to the red frequency grid,
-        floored at PHI_FLOOR beyond the common mode count (the mirror image
-        of :meth:`red_phi`)."""
+        """(P, Kr) common-process phi aligned to the red frequency grid —
+        the 'other' variance on the red signal's columns.  Floored at
+        PHI_FLOOR beyond the common mode count (the mirror image of
+        :meth:`red_phi`), and floored EVERYWHERE when the common process
+        lives on its own columns (correlated ORFs): disjoint columns carry
+        no shared variance."""
         import jax.numpy as jnp
 
         Kr = self.red_rho_ix_x.shape[1]
         out = jnp.full((self.P, Kr), PHI_FLOOR, dtype=self.cdtype)
-        if self.K:
+        if self.K and self.red_shares_gw:
             n = min(self.K, Kr)
             out = out.at[:, :n].set(self.gw_phi(x)[:, :n])
         return out
@@ -419,7 +426,9 @@ class CompiledPTA:
 
         xev = self.xe(x)
         k = jnp.arange(self.K)
-        if self.red_kind == "":
+        if self.red_kind == "" or not self.red_shares_gw:
+            # no red at all, or red on disjoint columns (correlated
+            # common process): the gw columns carry no red variance
             return jnp.full((self.P, self.K), PHI_FLOOR, dtype=self.cdtype)
         if self.red_kind == "infinitepower":
             out = jnp.where(jnp.arange(self.K)[None, :] < self.Kr,
@@ -718,6 +727,20 @@ def compile_pta(pta, pad_pulsars: int | None = None) -> CompiledPTA:
             else:
                 red_hyp[ii, :len(s.params)] = [ref(p) for p in s.params]
 
+    # do red and gw share basis columns?  (True in the CRN layout; False
+    # when the factory gives a correlated common process its own group)
+    red_shares_gw = True
+    if red_kind:
+        overlaps = []
+        for m in models:
+            rs, gs = fsig(m, "red"), fsig(m, "gw")
+            if rs is None or gs is None:
+                continue
+            a_sl, g_sl = m._slices[rs.name], m._slices[gs.name]
+            overlaps.append(a_sl.start < g_sl.stop
+                            and g_sl.start < a_sl.stop)
+        red_shares_gw = any(overlaps) if overlaps else True
+
     # ---- ECORR b-columns (for the ECORR conditional likelihood) ------------
     We = max((len(r[0]) for r in ec_rows), default=0)
     ec_cols = _as_i32(pad2([r[0] for r in ec_rows], Bmax, We)
@@ -799,11 +822,17 @@ def compile_pta(pta, pad_pulsars: int | None = None) -> CompiledPTA:
         if len(gw_orfs) > 1:
             raise NotImplementedError(f"mixed common-process ORFs {gw_orfs}")
         orf_name = gw_orfs.pop()
-        if red_kind:
+        # intrinsic red is supported alongside a correlated common
+        # process only on DISJOINT columns (the factory gives correlated
+        # processes their own share_group): the joint cross-pulsar prior
+        # on the gw columns is then purely rho_k G while red keeps its
+        # per-pulsar diagonal
+        if red_kind and red_shares_gw:
             raise NotImplementedError(
-                "correlated ORF with intrinsic red noise on the shared "
-                "Fourier columns is not implemented; build with "
-                "red_var=False")
+                "correlated ORF with intrinsic red noise sharing the "
+                "common process's basis columns is not implemented (build "
+                "with model_general, which gives correlated processes "
+                "their own columns)")
         if any(fsig(m, "gw") is None for m in models):
             raise NotImplementedError(
                 "correlated ORF requires every pulsar to carry the common "
@@ -878,4 +907,5 @@ def compile_pta(pta, pad_pulsars: int | None = None) -> CompiledPTA:
         rhomin=float(rhomin), rhomax=float(rhomax),
         red_rhomin=float(red_rhomin), red_rhomax=float(red_rhomax),
         orf_name=orf_name, orf_Ginv=orf_Ginv, gp_mask=gp_mask,
+        red_shares_gw=red_shares_gw,
     )

@@ -29,7 +29,8 @@ import scipy.linalg as sl
 from ..ops.acf import integrated_act
 from .blocks import (BlockIndex, align_phi, gumbel_grid_draw,
                      proposal_step, rho_bounds, rho_grid,
-                     rho_log_pdf_grid, validate_sampling_flags)
+                     rho_log_pdf_grid, tprocess_alpha_log_pdf_grid,
+                     validate_sampling_flags)
 
 
 class NumpyGibbs:
@@ -81,6 +82,11 @@ class NumpyGibbs:
                     [names.index(f"{alphas.name}_{k}")
                      for k in range(alphas.size)])
         self.gw_sig = next((s for s in self._model.signals if "gw" in s.name), None)
+        # do red and gw share basis columns?  (CRN layout: yes; a
+        # correlated own-column common process: no)
+        self._red_shares_gw = (
+            self.red_sig is not None and self.gw_sig is not None
+            and len(np.intersect1d(self.redid, self.gwid)) > 0)
         if len(self.idx.rho) and len(self.idx.rho) != len(self.gwid) // 2:
             raise ValueError(
                 f"found {len(self.idx.rho)} free-spectrum rho parameters but "
@@ -239,7 +245,11 @@ class NumpyGibbs:
             eta = self.rng.uniform(0.0, hi)
             rhonew = tau / (tau / self.rhomax - np.log1p(-eta))
         else:
-            irn = self._red_phi_at_gw_freqs(self.map_params(xnew))
+            # the red 'other' applies only on shared columns; a
+            # correlated (own-column) common process carries none
+            irn = (self._red_phi_at_gw_freqs(self.map_params(xnew))
+                   if self._red_shares_gw
+                   else np.full(len(tau), 1e-30))
             grid = rho_grid(self.rhomin, self.rhomax)
             rhonew = gumbel_grid_draw(self.rng,
                                       rho_log_pdf_grid(tau, irn, grid), grid)
@@ -326,7 +336,8 @@ class NumpyGibbs:
         tau = 0.5 * (bb[::2] + bb[1::2])
         K = len(self.idx.red_rho)
         tau = tau[:K]
-        gw = align_phi(np.asarray(self.gw_sig.get_phi(params))[::2], K)
+        gw = (align_phi(np.asarray(self.gw_sig.get_phi(params))[::2], K)
+              if self._red_shares_gw else np.full(K, 1e-30))
         grid = rho_grid(self.red_rhomin, self.red_rhomax)
         xnew[self.idx.red_rho] = 0.5 * np.log10(gumbel_grid_draw(
             self.rng, rho_log_pdf_grid(tau, gw, grid), grid))
@@ -352,13 +363,11 @@ class NumpyGibbs:
                                self.red_sig._df[::2], A, gam)
         other = (align_phi(np.asarray(self.gw_sig.get_phi(params))[::2],
                            len(tau))
-                 if self.gw_sig is not None else np.full(len(tau), 1e-30))
+                 if self.gw_sig is not None and self._red_shares_gw
+                 else np.full(len(tau), 1e-30))
         grid = 10.0 ** np.linspace(TP_ALPHA_LOG10_MIN, TP_ALPHA_LOG10_MAX,
                                    TP_ALPHA_GRID)
-        var = other[:, None] + plaw[:, None] * grid[None, :]
-        # log-grid point mass = density * alpha (Jacobian): -2 ln a + ln a
-        logpdf = (-np.log(grid)[None, :] - 1.0 / grid[None, :]
-                  - np.log(var) - tau[:, None] / var)
+        logpdf = tprocess_alpha_log_pdf_grid(tau, plaw, other, grid)
         xnew[self._alpha_idx] = gumbel_grid_draw(self.rng, logpdf, grid)
         return xnew
 

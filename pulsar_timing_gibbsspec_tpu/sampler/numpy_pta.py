@@ -26,7 +26,8 @@ import scipy.linalg as sl
 from ..ops.acf import integrated_act
 from .blocks import (BlockIndex, align_phi, gumbel_grid_draw,
                      proposal_step, rho_bounds, rho_grid,
-                     rho_log_pdf_grid, validate_sampling_flags)
+                     rho_log_pdf_grid, tprocess_alpha_log_pdf_grid,
+                     validate_sampling_flags)
 
 
 class NumpyPTAGibbs:
@@ -96,6 +97,14 @@ class NumpyPTAGibbs:
                 "the common conditional rho draw requires exactly one "
                 "'spectrum' common process matching the GW mode count")
 
+        #: per-pulsar: do red and gw share basis columns?  (CRN layout:
+        #: yes; correlated own-column common process: no) — static, so
+        #: computed once here rather than per sweep
+        self._red_shares_gw = [
+            self.redid[ii] is not None
+            and len(np.intersect1d(self.redid[ii], self.gwid[ii])) > 0
+            for ii in range(self.P)]
+
         # ---- correlated common process (Hellings-Downs etc.) --------------
         # The reference's experimental PTA sampler only ever handles the
         # block-diagonal CRN case (pta_gibbs.py:533, SURVEY §3.6) though its
@@ -110,11 +119,15 @@ class NumpyPTAGibbs:
         if self.orf_name != "crn":
             from ..models.orf import orf_ginv_stack, orf_matrix
 
-            if any(s is not None for s in self.red_sigs):
-                raise NotImplementedError(
-                    "a correlated common process (orf != 'crn') with "
-                    "intrinsic red noise on the shared Fourier columns is "
-                    "not implemented; build with red_var=False")
+            for ii in range(self.P):
+                if self.redid[ii] is None:
+                    continue
+                if len(np.intersect1d(self.redid[ii], self.gwid[ii])):
+                    raise NotImplementedError(
+                        "a correlated common process sharing basis columns "
+                        "with intrinsic red noise is not implemented; "
+                        "model_general gives correlated processes their "
+                        "own columns")
             kset = {len(g) for g in self.gwid}
             if len(kset) > 1:
                 raise NotImplementedError(
@@ -324,9 +337,9 @@ class NumpyPTAGibbs:
             logpdf = np.zeros((K, len(grid)))
             for ii in range(self.P):
                 tau = self._gw_tau(ii)[:K]
-                if self.red_sigs[ii] is not None:
-                    other = np.asarray(
-                        self.red_sigs[ii].get_phi(params))[::2][:K]
+                if self.red_sigs[ii] is not None and self._red_shares_gw[ii]:
+                    other = align_phi(np.asarray(
+                        self.red_sigs[ii].get_phi(params))[::2], K)
                 else:
                     other = np.full(K, 1e-30)
                 logpdf += self._rho_log_pdf_grid(tau, other, grid)
@@ -348,8 +361,14 @@ class NumpyPTAGibbs:
                     continue
                 K = len(self.red_rho_idx[ii])
                 tau = self._red_tau(ii)[:K]
-                gw = align_phi(
-                    np.asarray(self.gw_sigs[ii].get_phi(params))[::2], K)
+                # the gw 'other' variance applies only on SHARED columns
+                # (CRN layout); a correlated common process lives on its
+                # own columns, which carry no common variance
+                if self._red_shares_gw[ii]:
+                    gw = align_phi(
+                        np.asarray(self.gw_sigs[ii].get_phi(params))[::2], K)
+                else:
+                    gw = np.full(K, 1e-30)
                 logpdf = rho_log_pdf_grid(tau, gw, grid)
                 # assignment keyed by this pulsar's own chain columns
                 xnew[self.red_rho_idx[ii]] = 0.5 * np.log10(
@@ -378,12 +397,13 @@ class NumpyPTAGibbs:
             A = params[sig.params[0].name]
             gam = params[sig.params[1].name]
             plaw = psdmod.powerlaw(sig.freqs[::2], sig._df[::2], A, gam)
-            other = align_phi(
-                np.asarray(self.gw_sigs[ii].get_phi(params))[::2], len(tau))
-            var = other[:, None] + plaw[:, None] * grid[None, :]
-            # log-grid point mass = density * alpha: -2 ln a + ln a
-            logpdf = (-np.log(grid)[None, :] - 1.0 / grid[None, :]
-                      - np.log(var) - tau[:, None] / var)
+            if self._red_shares_gw[ii]:
+                other = align_phi(
+                    np.asarray(self.gw_sigs[ii].get_phi(params))[::2],
+                    len(tau))
+            else:
+                other = np.full(len(tau), 1e-30)
+            logpdf = tprocess_alpha_log_pdf_grid(tau, plaw, other, grid)
             xnew[self.alpha_idx[ii]] = gumbel_grid_draw(self.rng, logpdf,
                                                         grid)
         return xnew
