@@ -1,8 +1,8 @@
-from .priors import Uniform, Normal, LinearExp, Constant
+from .priors import Uniform, Normal, LinearExp, InvGamma, Constant
 from .pta import PTA, SignalModel
 from .factory import model_general
 
 __all__ = [
-    "Uniform", "Normal", "LinearExp", "Constant",
+    "Uniform", "Normal", "LinearExp", "InvGamma", "Constant",
     "PTA", "SignalModel", "model_general",
 ]

@@ -223,6 +223,37 @@ def test_jax_resume_bitwise(j1713, tmp_path):
     np.testing.assert_array_equal(resumed, full)
 
 
+def test_resume_bitwise_hd_red_and_tprocess(psrs8, j1713, tmp_path):
+    """Bitwise resume holds for the round-2 blocks too: the correlated-ORF
+    sweep with intrinsic red (carried b enters the sequential conditional)
+    and the t-process alpha draw (alphas live in x)."""
+    cases = {
+        "hdred": (PTABlockGibbs, model_general(
+            psrs8[:3], tm_svd=True, red_var=True, red_psd="spectrum",
+            red_components=4, white_vary=False, common_psd="spectrum",
+            common_components=4, orf="hd")),
+        "tproc": (PulsarBlockGibbs, model_general(
+            [j1713], tm_svd=True, red_var=True, red_psd="tprocess",
+            red_components=4, white_vary=True, common_psd="spectrum",
+            common_components=4)),
+    }
+    for lab, (cls, pta) in cases.items():
+        x0 = pta.initial_sample(np.random.default_rng(6))
+        kw = dict(backend="jax", seed=10, progress=False,
+                  white_adapt_iters=100, chunk_size=20)
+        full = cls(pta, **kw).sample(
+            x0, outdir=str(tmp_path / f"{lab}_full"), niter=100,
+            save_every=20)
+        cls(pta, **kw).sample(
+            x0, outdir=str(tmp_path / f"{lab}_split"), niter=60,
+            save_every=20)
+        resumed = cls(pta, **kw).sample(
+            x0, outdir=str(tmp_path / f"{lab}_split"), niter=100,
+            resume=True, save_every=20)
+        assert np.all(np.isfinite(full)), lab
+        np.testing.assert_array_equal(resumed, full, err_msg=lab)
+
+
 # ---------------------------------------------------------------------------
 # reference-API kernel-selector flags: honored or loud, never ignored
 # ---------------------------------------------------------------------------
