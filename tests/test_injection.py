@@ -1,0 +1,54 @@
+"""Injection recovery — the reference's tier-2 validation (SURVEY §4):
+simulated data with a known GWB, free-spectrum posterior compared against
+the injection (``singlepulsar_sim_A2e-15_gamma4.333.ipynb`` cells 13-16).
+
+Unlike the reference's by-eye violin plots, this compares the posterior
+per-bin against the *realized* injected coefficient power (the injection
+is deterministic, so the exact Fourier coefficients are reconstructable),
+which removes realization scatter from the assertion.  Everything is
+seed-pinned, so the thresholds are exact-reproducibility margins, not
+statistical ones.
+"""
+
+import numpy as np
+
+from pulsar_timing_gibbsspec_tpu.data import load_pulsar
+from pulsar_timing_gibbsspec_tpu.data.fourier import fourier_basis
+from pulsar_timing_gibbsspec_tpu.data.simulate import inject_residuals
+from pulsar_timing_gibbsspec_tpu.models.factory import model_general
+from pulsar_timing_gibbsspec_tpu.sampler.gibbs import PulsarBlockGibbs
+
+REFDATA = "/root/reference/simulated_data"
+INJ = dict(log10_A=np.log10(2e-15), gamma=13.0 / 3.0, nmodes=10, seed=42)
+
+
+def test_free_spectrum_recovers_injection(tmp_path):
+    psr = load_pulsar(f"{REFDATA}/J1713+0747.par",
+                      f"{REFDATA}/J1713+0747.tim", inject=dict(INJ))
+
+    # reconstruct the exact injected coefficients (deterministic seed)
+    Tspan = psr.toas.max() - psr.toas.min()
+    F, f = fourier_basis(psr.toas / 86400.0, INJ["nmodes"], Tspan)
+    r, a = inject_residuals(psr.name, F, f, Tspan, psr.toaerrs, psr.Mmat,
+                            log10_A=INJ["log10_A"], gamma=INJ["gamma"],
+                            seed=INJ["seed"])
+    np.testing.assert_allclose(r, psr.residuals)
+    realized = 0.5 * np.log10(0.5 * (a[::2] ** 2 + a[1::2] ** 2))
+
+    pta = model_general([psr], tm_svd=True, red_var=False, white_vary=False,
+                        common_psd="spectrum", common_components=10)
+    g = PulsarBlockGibbs(pta, backend="jax", seed=1, progress=False)
+    chain = g.sample(pta.initial_sample(np.random.default_rng(0)),
+                     outdir=str(tmp_path / "inj"), niter=1500)
+    med = np.median(chain[300:], axis=0)
+
+    # strong bins recover the realized power tightly (bin 0 excluded: the
+    # lowest frequency is largely absorbed by the spindown fit — the
+    # post-fit projection removes that power from the data by design)
+    for k in (1, 2, 3):
+        assert abs(med[k] - realized[k]) < 0.6, (k, med[k], realized[k])
+    # across all bins but the projected one, typical agreement stays tight
+    deltas = np.abs(med[1:10] - realized[1:10])
+    assert np.median(deltas) < 0.5, deltas
+    # weak high-frequency bins sit below the strong low-frequency signal
+    assert np.all(med[4:10] < med[1] + 0.3), med
