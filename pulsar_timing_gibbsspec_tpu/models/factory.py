@@ -174,6 +174,32 @@ def model_general(psrs, tm_var=False, tm_linear=False, tmparam_list=None,
     orf_list = orf.split(",")
     orf_name_list = (orf_names or orf).split(",")
     common_param_sets = []
+    orf_param_sets = []
+    for orf_nm, orf_el in zip(orf_name_list, orf_list):
+        gname = f"gw_{orf_nm}"
+        # parameterized ORFs (bin_orf / legendre_orf): the inter-pulsar
+        # correlation weights are sampled, one global set per process.
+        # G(theta) = I + sum_j theta_j B_j must stay positive definite;
+        # their ``init=0`` pins initial_sample at G = I (a prior draw of
+        # the weights is non-PD with high probability, and the sampler
+        # rejects non-PD proposals but cannot start from a non-PD state)
+        def orf_weight(nm):
+            p = Uniform(-1.0, 1.0, name=nm)
+            p.init = 0.0
+            return p
+
+        if orf_el == "bin_orf":
+            from .orf import BIN_ORF_EDGES
+
+            orf_param_sets.append([
+                orf_weight(f"{gname}_orfw_bin_{j}")
+                for j in range(len(BIN_ORF_EDGES) - 1)])
+        elif orf_el == "legendre_orf":
+            orf_param_sets.append([
+                orf_weight(f"{gname}_orfw_leg_{l}")
+                for l in range(leg_lmax + 1)])
+        else:
+            orf_param_sets.append([])
     for orf_nm in orf_name_list:
         gname = f"gw_{orf_nm}"
         if common_psd == "spectrum":
@@ -221,7 +247,8 @@ def model_general(psrs, tm_var=False, tm_linear=False, tmparam_list=None,
 
             shift_seed = zlib.crc32(repr((pseed or 0, psr.name)).encode())
 
-        for orf_nm, orf_el, ps in zip(orf_name_list, orf_list, common_param_sets):
+        for orf_nm, orf_el, ps, ops in zip(orf_name_list, orf_list,
+                                           common_param_sets, orf_param_sets):
             # correlated processes keep their own basis columns (disjoint
             # from intrinsic red) so the cross-pulsar prior on them is
             # purely rho_k G — exact HD + red sampling; CRN processes
@@ -232,7 +259,8 @@ def model_general(psrs, tm_var=False, tm_linear=False, tmparam_list=None,
                 modes=grid, orf_name=orf_el, orf_ifreq=orf_ifreq,
                 leg_lmax=leg_lmax, pshift_seed=shift_seed, wgts=wgts,
                 share_group=("fourier" if orf_el == "crn"
-                             else f"gw_{orf_nm}")))
+                             else f"gw_{orf_nm}"),
+                orf_params=ops))
 
         if red_var:
             red_name_psd = red_psd

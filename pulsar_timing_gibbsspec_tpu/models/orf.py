@@ -17,10 +17,12 @@ Menu parity with ``blocks.common_red_noise_block``:
   ``monopole``/``dipole`` (exactly rank-1 / rank-<=3) and the zero-diag
   detection variants yield degenerate priors and are rejected with a
   precise error — the reference's sampler handles no ORF at all
-- ``param_hd``, ``bin_orf``, ``legendre_orf``: ORFs with *sampled* shape
-  parameters — buildable rejection with a loud error (the reference can
-  construct them via enterprise but its Gibbs sampler cannot sample any
-  correlated model either)
+- ``bin_orf``, ``legendre_orf``: ORFs with *sampled* correlation weights
+  ``G(theta) = I + sum_j theta_j B_j`` (:func:`orf_param_basis`), drawn by
+  an MH block on the coefficient-conditional correlated likelihood —
+  working here, unreachable in the reference (its sampler handles no
+  correlated model).  ``param_hd``/``param_multiple`` (nonlinearly
+  parameterized shapes) still reject loudly.
 - ``freq_hd``: HD correlation applied only from frequency bin
   ``orf_ifreq`` upward (CRN below) — per-frequency ORF matrices
 """
@@ -84,9 +86,10 @@ def st(pos_a, pos_b):
 ORFS = {"crn": crn, "hd": hd, "dipole": dipole, "monopole": monopole,
         "gw_monopole": gw_monopole, "gw_dipole": gw_dipole, "st": st}
 
-#: ORFs whose shape is itself sampled (enterprise_extensions
-#: ``param_hd_orf`` / ``bin_orf`` / ``legendre_orf``); the model layer
-#: names them so requests fail with a precise message
+#: ORFs whose shape is itself sampled.  bin_orf/legendre_orf are handled
+#: by :func:`orf_param_basis` (linear weight bases); the rest — and their
+#: zero-diag detection variants — fail with a precise message when asked
+#: for a fixed matrix
 PARAMETERIZED_ORFS = ("param_hd", "param_multiple", "bin_orf", "legendre_orf",
                       "zero_diag_bin_orf", "zero_diag_legendre_orf")
 
@@ -138,6 +141,48 @@ def orf_matrix_per_freq(name: str, positions, K: int,
         return np.stack([high if k >= orf_ifreq else low for k in range(K)])
     G = orf_matrix(name, positions)
     return np.broadcast_to(G, (K,) + G.shape).copy()
+
+
+#: angular-separation bin edges [deg] for the binned ORF (the standard
+#: 7-bin layout enterprise_extensions' bin_orf uses)
+BIN_ORF_EDGES = (0.0, 30.0, 50.0, 80.0, 100.0, 120.0, 150.0, 180.0)
+
+
+def orf_param_basis(name: str, positions, leg_lmax: int = 5):
+    """Basis stack for a *parameterized* ORF: ``G(theta) = I + sum_j
+    theta_j B_j`` with the diagonal pinned at 1 (the process variance is
+    carried by rho_k; the sampled parameters are the inter-pulsar
+    correlations).
+
+    - ``bin_orf``: one parameter per angular-separation bin
+      (``BIN_ORF_EDGES``); ``B_j`` masks the pairs in bin ``j``
+    - ``legendre_orf``: parameters are Legendre coefficients ``c_l``,
+      ``l = 0..leg_lmax``; ``B_l[a,b] = P_l(cos zeta_ab)`` off-diagonal
+
+    Returns ``(B, labels)`` with ``B`` of shape (J, P, P), zero diagonal.
+    """
+    P = len(positions)
+    cosz = np.eye(P)
+    for a in range(P):
+        for b in range(a + 1, P):
+            cosz[a, b] = cosz[b, a] = float(
+                np.clip(np.dot(positions[a], positions[b]), -1.0, 1.0))
+    off = 1.0 - np.eye(P)
+    if name == "bin_orf":
+        zeta = np.degrees(np.arccos(np.clip(cosz, -1.0, 1.0)))
+        Bs, labels = [], []
+        for j in range(len(BIN_ORF_EDGES) - 1):
+            lo, hi = BIN_ORF_EDGES[j], BIN_ORF_EDGES[j + 1]
+            mask = ((zeta > lo) if j else (zeta >= lo)) & (zeta <= hi)
+            Bs.append(mask.astype(float) * off)
+            labels.append(f"bin_{j}")
+        return np.stack(Bs), labels
+    if name == "legendre_orf":
+        from scipy.special import eval_legendre
+
+        Bs = [eval_legendre(l, cosz) * off for l in range(leg_lmax + 1)]
+        return np.stack(Bs), [f"leg_{l}" for l in range(leg_lmax + 1)]
+    raise NotImplementedError(f"parameterized orf '{name}'")
 
 
 def orf_ginv_stack(name: str, positions, K: int,

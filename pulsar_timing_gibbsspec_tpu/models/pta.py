@@ -158,8 +158,20 @@ class PTA:
         return ret
 
     def initial_sample(self, rng=None):
+        """Prior draw of every free parameter — except parameters carrying
+        an explicit ``init`` attribute, which start there instead (the
+        factory pins sampled ORF weights at 0 = identity correlation: a
+        prior draw is non-positive-definite with high probability and no
+        sampler could start from it)."""
         rng = np.random.default_rng() if rng is None else rng
-        return np.concatenate([np.atleast_1d(p.sample(rng)) for p in self.params])
+        out = []
+        for p in self.params:
+            init = getattr(p, "init", None)
+            if init is not None:
+                out.append(np.full(p.size or 1, float(init)))
+            else:
+                out.append(np.atleast_1d(p.sample(rng)))
+        return np.concatenate(out)
 
     # -- per-pulsar accessors (lists, one entry per pulsar) ------------------
 

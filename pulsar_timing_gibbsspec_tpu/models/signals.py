@@ -104,9 +104,13 @@ class FourierGPSignal(BasisSignal):
                  radio_freqs=None, chrom_index: float | None = None,
                  row_mask=None, pshift_seed=None, wgts=None,
                  orf_ifreq: int = 0, leg_lmax: int = 5,
-                 share_group: str = "fourier"):
+                 share_group: str = "fourier", orf_params: list = ()):
         self.name = name
-        self.params = list(psd_params)
+        #: sampled ORF shape weights (bin_orf/legendre_orf) ride along for
+        #: parameter collection but are not PSD arguments
+        self.psd_params = list(psd_params)
+        self.orf_params = list(orf_params)
+        self.params = self.psd_params + self.orf_params
         self.psd_name = psd_name
         self.orf_name = orf_name
         # ORF-shape options (consumed by models/orf.py for the freq_hd and
@@ -159,7 +163,7 @@ class FourierGPSignal(BasisSignal):
 
     def get_phi(self, params: dict):
         vals = self._mapped(params)
-        args = [vals[p.name] for p in self.params]
+        args = [vals[p.name] for p in self.psd_params]
         if self.psd_name == "free_spectrum":
             return psdmod.free_spectrum(self._f, self._df, *args)
         return self._psd_fn(self._f, self._df, *args)

@@ -24,10 +24,11 @@ class BlockIndex:
     red_rho: np.ndarray      # per-pulsar free-spectrum entries ('red' + 'rho')
     white: np.ndarray        # efac / equad entries
     ecorr: np.ndarray        # ecorr entries
+    orf: np.ndarray          # sampled ORF weights ("_orfw_" fragment)
 
     @classmethod
     def build(cls, param_names: list) -> "BlockIndex":
-        rho, red, red_rho, white, ecorr = [], [], [], [], []
+        rho, red, red_rho, white, ecorr, orf = [], [], [], [], [], []
         for ii, nm in enumerate(param_names):
             if "rho" in nm and "gw" in nm:
                 rho.append(ii)
@@ -42,9 +43,11 @@ class BlockIndex:
                 white.append(ii)
             if "ecorr" in nm:
                 ecorr.append(ii)
+            if "_orfw_" in nm:
+                orf.append(ii)
         arr = lambda v: np.asarray(v, dtype=np.int64)
         return cls(list(param_names), arr(rho), arr(red), arr(red_rho),
-                   arr(white), arr(ecorr))
+                   arr(white), arr(ecorr), arr(orf))
 
 
 def validate_sampling_flags(pta, hypersample=None, ecorrsample=None,

@@ -219,6 +219,11 @@ class CompiledPTA:
     gp_mask: object = None
     red_f: object = None       # (P, Kr) red-grid frequencies (tprocess)
     red_df: object = None      # (P, Kr) red-grid bin widths
+    #: parameterized ORF (bin_orf / legendre_orf): linear basis stack
+    #: G(theta) = I + sum_j theta_j B_j (identity pads) and the gather of
+    #: theta out of x.  None for fixed ORFs (orf_Ginv is static then).
+    orf_B: object = None       # (J, P, P)
+    orf_par_ix: object = None  # (J,) -> x
     #: True when intrinsic red and the common process share basis columns
     #: (the CRN layout); False for correlated ORFs, whose processes keep
     #: their own columns — then the red conditionals see no gw 'other'
@@ -370,6 +375,34 @@ class CompiledPTA:
         return jnp.where(kind == 0, lp_u,
                          jnp.where(kind == 1, lp_n,
                                    jnp.where(kind == 2, lp_l, lp_g)))
+
+    def orf_G(self, x):
+        """(P, P) ORF correlation matrix at the current state (sampled
+        weights); only valid for parameterized ORFs."""
+        import jax.numpy as jnp
+
+        th = jnp.asarray(x, self.cdtype)[self.orf_par_ix]
+        return (jnp.eye(self.P, dtype=self.cdtype)
+                + jnp.einsum("j,jpq->pq", th,
+                             jnp.asarray(self.orf_B, self.cdtype)))
+
+    def orf_ginv_k(self, x):
+        """(K, P, P) inverse ORF stack at the current state: the stored
+        static stack for fixed ORFs, rebuilt from the sampled weights for
+        parameterized ones (the sampler keeps theta inside the PD region,
+        so the inverse is well-defined at chain states).
+
+        Via the blocked Cholesky inverse, not ``jnp.linalg.inv``: TPU's
+        XLA has no f64 LuDecomposition lowering, and G is SPD anyway."""
+        import jax.numpy as jnp
+
+        if self.orf_B is None:
+            return jnp.asarray(self.orf_Ginv, self.cdtype)
+        from ..ops.linalg import blocked_chol_inv
+
+        _, Li = blocked_chol_inv(self.orf_G(x))
+        Gi = Li.T @ Li                      # (L L^T)^-1 = L^-T L^-1
+        return jnp.broadcast_to(Gi, (max(self.K, 1), self.P, self.P))
 
     def gw_tau(self, b):
         """(P, K) per-frequency ``(b_sin^2 + b_cos^2)/2``
@@ -567,16 +600,17 @@ def compile_pta(pta, pad_pulsars: int | None = None) -> CompiledPTA:
             sl_ = m._slices[s.name]
             cols = np.arange(sl_.start, sl_.stop)
             f, df = s.freqs, s._df
+            psd_ps = getattr(s, "psd_params", s.params)
             hyp, rho = [], []
             if kind == "free_spectrum":
-                p = s.params[0]
+                p = psd_ps[0]
                 rho = [ref(p, elem=j // 2) for j in range(len(cols))]
             elif kind == "tprocess":
-                hyp = [ref(p) for p in s.params[:2]]       # log10_A, gamma
-                alphas = s.params[2]
+                hyp = [ref(p) for p in psd_ps[:2]]         # log10_A, gamma
+                alphas = psd_ps[2]
                 rho = [ref(alphas, elem=j // 2) for j in range(len(cols))]
             else:
-                hyp = [ref(p) for p in s.params]
+                hyp = [ref(p) for p in psd_ps]
             rows.append((cols, f, df, hyp, rho))
         comp_specs.append((kind, rows))
     # chromatic GPs (DM, scattering): own columns, same component machinery
@@ -656,7 +690,7 @@ def compile_pta(pta, pad_pulsars: int | None = None) -> CompiledPTA:
         gw_f = np.ones((P, K), np_dtype)
         gw_df = np.zeros((P, K), np_dtype)
         gw_kind = next(s.psd_name for s in sigs if s is not None)
-        Hg = max((len(s.params) for s in sigs
+        Hg = max((len(getattr(s, "psd_params", s.params)) for s in sigs
                   if s is not None and s.psd_name != "free_spectrum"),
                  default=0)
         gw_hyp = np.full((P, max(Hg, 1)), sentinel, np.int32)
@@ -670,14 +704,16 @@ def compile_pta(pta, pad_pulsars: int | None = None) -> CompiledPTA:
             gw_cos[ii, :len(cols) // 2] = cols[1::2]
             gw_f[ii, :len(cols) // 2] = s.freqs[::2]
             gw_df[ii, :len(cols) // 2] = s._df[::2]
+            psd_ps = getattr(s, "psd_params", s.params)
             if gw_kind == "free_spectrum":
-                p = s.params[0]
+                p = psd_ps[0]
                 kp = min(K, p.size or 1)
                 gw_rho[ii, :kp] = [ref(p, elem=k) for k in range(kp)]
             else:
-                gw_hyp[ii, :len(s.params)] = [ref(p) for p in s.params]
+                gw_hyp[ii, :len(psd_ps)] = [ref(p) for p in psd_ps]
         if gw_kind == "free_spectrum":
-            p = next(s.params[0] for s in sigs if s is not None)
+            p = next(getattr(s, "psd_params", s.params)[0]
+                     for s in sigs if s is not None)
             if not isinstance(p, Constant):
                 rho_ix_x = _as_i32([pos[f"{p.name}_{k}"] for k in range(K)])
 
@@ -816,6 +852,8 @@ def compile_pta(pta, pad_pulsars: int | None = None) -> CompiledPTA:
     # ---- correlated common-process ORF -------------------------------------
     orf_name = "crn"
     orf_Ginv = None
+    orf_B = None
+    orf_par_ix = None
     gw_orfs = {s.orf_name for m in models for s in m._fourier
                if "gw" in s.name}
     if gw_orfs - {"crn"}:
@@ -847,21 +885,40 @@ def compile_pta(pta, pad_pulsars: int | None = None) -> CompiledPTA:
             raise NotImplementedError(
                 "correlated ORF requires a homogeneous common mode count "
                 f"across pulsars (got {sorted(ksets)})")
-        # no size gate: up to HD_DENSE_MAX total coefficients the sweep
-        # uses the dense joint draw; larger arrays switch to the
-        # sequential pulsar-wise conditional sweep (jax_backend.
-        # draw_b_hd_sequential), whose program size is O(Bmax^2).
-        # The stack is per-frequency (K, P, P) so freq_hd (HD above bin
-        # orf_ifreq, CRN below) rides the same machinery as fixed ORFs.
-        from ..models.orf import orf_ginv_stack
-
         sig0 = next(s for s in (fsig(m, "gw") for m in models)
                     if s is not None)
-        ginv_real = orf_ginv_stack(
-            orf_name, [m.pulsar.pos for m in models], K,
-            orf_ifreq=getattr(sig0, "orf_ifreq", 0))      # (K, Pr, Pr)
-        orf_Ginv = np.tile(np.eye(P), (K, 1, 1))
-        orf_Ginv[:, :P_real, :P_real] = ginv_real
+        if orf_name in ("bin_orf", "legendre_orf"):
+            # sampled correlation weights: precompute the linear basis
+            # stack G(theta) = I + sum_j theta_j B_j (zero-padded rows
+            # and columns keep pad pulsars at identity) and the gather
+            # of theta out of x; G is rebuilt on device per use
+            from ..models.orf import orf_param_basis
+
+            B_real, labels = orf_param_basis(
+                orf_name, [m.pulsar.pos for m in models],
+                leg_lmax=getattr(sig0, "leg_lmax", 5))
+            orf_B = np.zeros((len(labels), P, P))
+            orf_B[:, :P_real, :P_real] = B_real
+            op = getattr(sig0, "orf_params", [])
+            if len(op) != len(labels):
+                raise ValueError(
+                    f"orf='{orf_name}' needs {len(labels)} sampled "
+                    f"weights, model carries {len(op)} (build with "
+                    "model_general)")
+            orf_par_ix = _as_i32([pos[p.name] for p in op])
+        else:
+            # fixed ORFs: static per-frequency inverse stack.  No size
+            # gate: up to HD_DENSE_MAX total coefficients the sweep uses
+            # the dense joint draw; larger arrays the sequential
+            # pulsar-wise sweep (O(Bmax^2) program).  (K, P, P) so
+            # freq_hd rides the same machinery.
+            from ..models.orf import orf_ginv_stack
+
+            ginv_real = orf_ginv_stack(
+                orf_name, [m.pulsar.pos for m in models], K,
+                orf_ifreq=getattr(sig0, "orf_ifreq", 0))  # (K, Pr, Pr)
+            orf_Ginv = np.tile(np.eye(P), (K, 1, 1))
+            orf_Ginv[:, :P_real, :P_real] = ginv_real
 
     zeros_pk = np.zeros((P, max(K, 1)), np_dtype)
     return CompiledPTA(
@@ -908,4 +965,5 @@ def compile_pta(pta, pad_pulsars: int | None = None) -> CompiledPTA:
         red_rhomin=float(red_rhomin), red_rhomax=float(red_rhomax),
         orf_name=orf_name, orf_Ginv=orf_Ginv, gp_mask=gp_mask,
         red_shares_gw=red_shares_gw,
+        orf_B=orf_B, orf_par_ix=orf_par_ix,
     )

@@ -220,7 +220,7 @@ def draw_b_hd_sequential(cm: CompiledPTA, x, b, key):
     gw_cols = jnp.concatenate([cm.gw_sin_ix, cm.gw_cos_ix], axis=1)
     pinv = pinv.at[rows_p, gw_cols].set(0.0, mode="drop")
     rho = 10.0 ** (2.0 * jnp.asarray(x, cdt)[cm.rho_ix_x])       # (K,)
-    Ginv = jnp.asarray(cm.orf_Ginv, cdt)           # (K, P, P)
+    Ginv = cm.orf_ginv_k(x).astype(cdt)            # (K, P, P)
     keys = jr.split(key, P)
     eye = jnp.eye(B, dtype=cdt)
     gsin = jnp.asarray(cm.gw_sin_ix)
@@ -290,8 +290,7 @@ def draw_b_joint(cm: CompiledPTA, x, key):
     Sigma = Sigma.at[rows[:, :, None], rows[:, None, :]].set(TNT)
     Sigma = Sigma.at[jnp.arange(PB), jnp.arange(PB)].add(pinv.reshape(PB))
     rho = 10.0 ** (2.0 * jnp.asarray(x, cm.cdtype)[cm.rho_ix_x])   # (K,)
-    Ginv = jnp.moveaxis(jnp.asarray(cm.orf_Ginv, cm.cdtype),
-                        0, -1)                                     # (P, P, K)
+    Ginv = jnp.moveaxis(cm.orf_ginv_k(x), 0, -1)                   # (P, P, K)
     for phase_ix in (cm.gw_sin_ix, cm.gw_cos_ix):
         frows = jnp.arange(P)[:, None] * B + phase_ix              # (P, K)
         Sigma = Sigma.at[frows[:, None, :], frows[None, :, :]].add(
@@ -695,7 +694,7 @@ def rho_update(cm: CompiledPTA, x, b, key):
         # quadratic form taut_k = 0.5 sum_phase a_k^T G^-1 a_k (reduces to
         # sum_p tau_pk at G = I)
         fdt = cm.dtype
-        Ginv = jnp.asarray(cm.orf_Ginv, cm.cdtype)      # (K, P, P)
+        Ginv = cm.orf_ginv_k(x)                         # (K, P, P)
         live = jnp.asarray(cm.psr_mask, cm.cdtype)
         taut = jnp.zeros((cm.K,), cm.cdtype)
         for ix in (cm.gw_sin_ix, cm.gw_cos_ix):
@@ -806,6 +805,39 @@ def tprocess_alpha_update(cm: CompiledPTA, x, b, key):
     gum = jr.gumbel(key, logpdf.shape, dtype=fdt)
     alpha = grid[jnp.argmax(logpdf + gum, axis=-1)]       # (P, Kr)
     return x.at[cm.red_rho_ix_x].set(alpha.astype(x.dtype), mode="drop")
+
+
+def lnlike_orf_fn(cm: CompiledPTA, b):
+    """b-conditional likelihood of the sampled ORF weights (bin_orf /
+    legendre_orf): for each (frequency, phase) group the gw coefficients
+    are jointly ``N(0, rho_k G(theta))``, so up to theta-independent
+    constants
+
+        ln L(theta) = -K ln det G - 0.5 sum_{k,phase} a_k^T G^-1 a_k / rho_k
+
+    (two phases give the K, not K/2, logdet factor).  Non-PD proposals
+    produce a NaN Cholesky and are rejected by the MH accept's finite
+    guard — the chain never leaves the PD region it starts in."""
+    import jax
+    import jax.numpy as jnp
+
+    live = jnp.asarray(cm.psr_mask, cm.cdtype)
+    a_s = jnp.take_along_axis(b, jnp.asarray(cm.gw_sin_ix), axis=1)
+    a_c = jnp.take_along_axis(b, jnp.asarray(cm.gw_cos_ix), axis=1)
+    A = jnp.stack([a_s, a_c], axis=-1) * live[:, None, None]   # (P, K, 2)
+
+    def lnlike(q):
+        G = cm.orf_G(q)
+        L = jnp.linalg.cholesky(G)                # NaN if theta not PD
+        logdet = 2.0 * jnp.sum(jnp.log(jnp.diagonal(L)))
+        rho = 10.0 ** (2.0 * jnp.asarray(q, cm.cdtype)[cm.rho_ix_x])
+        w = jax.scipy.linalg.solve_triangular(
+            L, A.reshape(cm.P, -1), lower=True)   # (P, K*2)
+        quad = jnp.sum(w.reshape(cm.P, -1, 2) ** 2
+                       / rho[None, :, None])
+        return -cm.K * logdet - 0.5 * quad
+
+    return lnlike
 
 
 #: default period of the exact f64 b-draw interleaved with the
@@ -1012,6 +1044,9 @@ class JaxGibbsDriver:
                                    and bool(np.any(np.asarray(cm.red_rho_ix_x)
                                                    < cm.nx)))
         self.do_red_mh = len(cm.idx.red) > 0
+        # sampled ORF weights (bin_orf / legendre_orf): MH block on the
+        # coefficient-conditional correlated likelihood
+        self.do_orf_mh = cm.orf_B is not None and len(cm.idx.orf) > 0
 
         # flat (pulsar, col) gather that turns padded (P, Bmax) b arrays
         # into the reference's concatenated per-pulsar layout
@@ -1301,7 +1336,7 @@ class JaxGibbsDriver:
             (chol_w, mode_w, asq_w, chol_e, mode_e, asq_e,
              red_U, red_S) = aux
             out = (x, b)
-            k = jr.split(key, 7)
+            k = jr.split(key, 8)
             if len(cm.idx.white) and nw:
                 # the cached u = T b makes the white residual free
                 r = jnp.asarray(cm.y) - u
@@ -1324,6 +1359,9 @@ class JaxGibbsDriver:
                                  self.red_steps)
             if cm.K and len(cm.rho_ix_x):
                 x = rho_update(cm, x, b, k[3])
+            if self.do_orf_mh:
+                x, _ = mh_scan(cm, x, k[7], lnlike_orf_fn(cm, b),
+                               cm.idx.orf, self.red_steps)
             if cm.orf_name != "crn":
                 b = draw_b_fn(cm, x, k[4], b)    # joint or sequential HD
                 u = b_matvec(cm, b)
@@ -1353,7 +1391,7 @@ class JaxGibbsDriver:
         def body(carry, key, aux, t):
             x, b, u = carry
             out = (x, b)
-            k = jr.split(key, 7)
+            k = jr.split(key, 8)
             if len(cm.idx.white):
                 # Laplace proposal square roots recomputed at the current
                 # state each warmup sweep (W HVPs + a batched WxW eigh,
@@ -1387,6 +1425,9 @@ class JaxGibbsDriver:
                                cm.idx.red, self.red_steps)
             if cm.K and len(cm.rho_ix_x):
                 x = rho_update(cm, x, b, k[3])
+            if self.do_orf_mh:
+                x, _ = mh_scan(cm, x, k[7], lnlike_orf_fn(cm, b),
+                               cm.idx.orf, self.red_steps)
             # pass the carried b: the sequential HD path conditions each
             # pulsar on the others' CURRENT coefficients (restarting from
             # zeros would sample a shrunken, decorrelated conditional)
@@ -1520,6 +1561,20 @@ class JaxGibbsDriver:
 
         cm = self.cm
         x = jnp.asarray(self._x_in(x), dtype=cm.cdtype)   # (C, nx)
+        if cm.orf_B is not None:
+            # sampled-ORF start state must be positive definite: the MH
+            # block rejects non-PD proposals but cannot escape a non-PD
+            # start (a prior draw of the weights usually is one)
+            th = np.asarray(x)[:, np.asarray(cm.orf_par_ix)]
+            G = (np.eye(cm.P)[None]
+                 + np.einsum("cj,jpq->cpq", th, np.asarray(cm.orf_B)))
+            wmin = np.linalg.eigvalsh(G).min(axis=(-2, -1))
+            if (wmin <= 1e-10).any():
+                raise ValueError(
+                    "initial ORF weights give a non-positive-definite "
+                    f"correlation matrix (min eigenvalue {wmin.min():.2e}); "
+                    "start the *_orfw_* parameters at 0 (G = identity) — "
+                    "x0[idx.orf] = 0")
         ii = start
         if ii == 0:
             # draw b from the initial state before any conditional touches

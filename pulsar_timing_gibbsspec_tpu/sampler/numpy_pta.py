@@ -116,6 +116,7 @@ class NumpyPTAGibbs:
             raise NotImplementedError(f"mixed common-process ORFs {orf_names}")
         self.orf_name = orf_names.pop() if orf_names else "crn"
         self.G = None
+        self.orf_B = None
         if self.orf_name != "crn":
             from ..models.orf import orf_ginv_stack, orf_matrix
 
@@ -136,14 +137,35 @@ class NumpyPTAGibbs:
             pos = [pta.model(ii).pulsar.pos for ii in range(self.P)]
             K = len(self.gwid[0]) // 2
             sig0 = next(s for s in self.gw_sigs if s is not None)
-            # per-frequency (K, P, P) stack: constant for fixed ORFs,
-            # varying for freq_hd (CRN below bin orf_ifreq, HD above)
-            self.G = orf_matrix(
-                self.orf_name if not self.orf_name.startswith("freq_")
-                else "hd", pos)
-            self.Ginv = orf_ginv_stack(
-                self.orf_name, pos, K,
-                orf_ifreq=getattr(sig0, "orf_ifreq", 0))
+            self._K = K
+            if self.orf_name in ("bin_orf", "legendre_orf"):
+                # sampled correlation weights: G(theta) = I + sum theta B
+                if not len(self.idx.rho):
+                    raise NotImplementedError(
+                        "parameterized ORFs are implemented for a varied "
+                        "common free spectrum (common_psd='spectrum'); "
+                        "the update_orf likelihood needs the rho block")
+                from ..models.orf import orf_param_basis
+
+                self.orf_B, _ = orf_param_basis(
+                    self.orf_name, pos,
+                    leg_lmax=getattr(sig0, "leg_lmax", 5))
+                self.orf_idx = np.array(
+                    [names.index(p.name)
+                     for p in getattr(sig0, "orf_params", [])],
+                    dtype=np.int64)
+                self.G = np.eye(self.P)   # non-None: correlated paths on
+                self.Ginv = None          # rebuilt per state
+            else:
+                # per-frequency (K, P, P) stack: constant for fixed ORFs,
+                # varying for freq_hd (CRN below bin orf_ifreq, HD above)
+                self.orf_B = None
+                self.G = orf_matrix(
+                    self.orf_name if not self.orf_name.startswith("freq_")
+                    else "hd", pos)
+                self.Ginv = orf_ginv_stack(
+                    self.orf_name, pos, K,
+                    orf_ifreq=getattr(sig0, "orf_ifreq", 0))
 
         self.b = [np.zeros(T.shape[1]) for T in self._T]
         self._TNT = None
@@ -295,11 +317,12 @@ class NumpyPTAGibbs:
         Sigma[np.diag_indices(nb)] += phiinv_diag
         rho = np.asarray(self.gw_sigs[0].get_phi(params))[::2]
         K = len(rho)
+        Ginv = self._ginv(xs)
         for k in range(K):
             for phase in (0, 1):
                 rows = np.array([offs[ii] + self.gwid[ii][2 * k + phase]
                                  for ii in range(self.P)])
-                Sigma[np.ix_(rows, rows)] += self.Ginv[k] / rho[k]
+                Sigma[np.ix_(rows, rows)] += Ginv[k] / rho[k]
         d = np.concatenate(self._d)
         cf = sl.cho_factor(Sigma, lower=True)
         mn = sl.cho_solve(cf, d)
@@ -328,9 +351,10 @@ class NumpyPTAGibbs:
         if self.G is not None:
             a = np.stack([self.b[ii][self.gwid[ii]] for ii in range(self.P)])
             taut = np.zeros(K)
+            Ginv = self._ginv(xnew)
             for phase in (0, 1):
                 ap = a[:, phase::2][:, :K]              # (P, K)
-                taut += 0.5 * np.einsum("pk,kpq,qk->k", ap, self.Ginv, ap)
+                taut += 0.5 * np.einsum("pk,kpq,qk->k", ap, Ginv, ap)
             logpdf = (-self.P * np.log(grid)[None, :]
                       - taut[:, None] / grid[None, :])
         else:
@@ -375,6 +399,49 @@ class NumpyPTAGibbs:
                     gumbel_grid_draw(self.rng, logpdf, grid))
             return xnew
         return xs.copy()
+
+    def _orf_G(self, xs):
+        """(P, P) correlation matrix at the current sampled weights."""
+        return np.eye(self.P) + np.einsum("j,jpq->pq", xs[self.orf_idx],
+                                          self.orf_B)
+
+    def _ginv(self, xs):
+        """(K, P, P) inverse ORF stack at the current state."""
+        if self.orf_B is None:
+            return self.Ginv
+        Gi = np.linalg.inv(self._orf_G(xs))
+        return np.broadcast_to(Gi, (self._K, self.P, self.P))
+
+    def update_orf(self, xs):
+        """MH block for the sampled ORF weights (bin_orf / legendre_orf):
+        single-site scale-mixture proposals on the coefficient-conditional
+        correlated likelihood ``-K ln det G - 0.5 sum a^T G^-1 a / rho``;
+        non-PD proposals are rejected (Cholesky failure -> -inf)."""
+        if self.orf_B is None or not len(self.idx.orf):
+            return xs.copy()
+
+        a = np.stack([self.b[ii][self.gwid[ii]] for ii in range(self.P)])
+        K = self._K
+
+        def lnlike(q):
+            G = self._orf_G(q)
+            try:
+                cf = sl.cho_factor(G, lower=True)
+            except np.linalg.LinAlgError:
+                return -np.inf
+            except ValueError:
+                return -np.inf
+            logdet = 2.0 * np.sum(np.log(np.diag(cf[0])))
+            rho = 10.0 ** (2.0 * q[self.idx.rho])
+            quad = 0.0
+            for phase in (0, 1):
+                ap = a[:, phase::2][:, :K]              # (P, K)
+                w = sl.cho_solve(cf, ap)
+                quad += np.sum(ap * w / rho[None, :])
+            return -K * logdet - 0.5 * quad
+
+        return self._mh_loop(xs, self.idx.orf, lnlike, self.red_steps,
+                             0.05 * len(self.idx.orf))
 
     def update_tprocess_alpha(self, xs):
         """Per-pulsar grid draw of t-process scale factors from the
@@ -494,6 +561,13 @@ class NumpyPTAGibbs:
     def sweep(self, xs, first=False):
         """Reference sweep order (``pta_gibbs.py:664-704``)."""
         x = np.asarray(xs, dtype=np.float64).copy()
+        if first and self.orf_B is not None:
+            wmin = float(np.linalg.eigvalsh(self._orf_G(x)).min())
+            if wmin <= 1e-10:
+                raise ValueError(
+                    "initial ORF weights give a non-positive-definite "
+                    f"correlation matrix (min eigenvalue {wmin:.2e}); "
+                    "start the *_orfw_* parameters at 0 (G = identity)")
         if first:
             self.draw_b(x)
         self.invalidate_cache()
@@ -509,6 +583,8 @@ class NumpyPTAGibbs:
             x = self.update_red_mh(x, adapt=first)
         if len(self.idx.rho):
             x = self.update_rho(x)
+        if self.orf_B is not None and len(self.idx.orf):
+            x = self.update_orf(x)
         self.draw_b(x)
         return x
 
