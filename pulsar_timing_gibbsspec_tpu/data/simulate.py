@@ -49,9 +49,66 @@ def inject_residuals(name, F, f, Tspan, toaerrs, Mmat,
     phi = powerlaw_psd(f, log10_A, gamma, 1.0 / Tspan)
     a = rng.normal(size=F.shape[1]) * np.sqrt(phi)
     noise = rng.normal(size=F.shape[0]) * toaerrs * efac
-    r = F @ a + noise
-    # post-fit projection: subtract the least-squares timing-model fit.
-    # Project with an orthonormalized column basis — raw timing partials
-    # span ~18 decades and make a direct lstsq numerically lossy.
+    return _postfit_project(Mmat, F @ a + noise), a
+
+
+def _postfit_project(Mmat, r):
+    """Subtract the least-squares timing-model fit.  Projects with an
+    orthonormalized column basis — raw timing partials span ~18 decades
+    and make a direct lstsq numerically lossy."""
     Q, _ = np.linalg.qr(Mmat / np.linalg.norm(Mmat, axis=0))
-    return r - Q @ (Q.T @ r), a
+    return r - Q @ (Q.T @ r)
+
+
+def inject_correlated(psrs, orf="hd", log10_A=np.log10(2e-15),
+                      gamma=13.0 / 3.0, nmodes=10, seed=0, efac=1.0):
+    """Replace every pulsar's residuals with a *jointly drawn* correlated
+    common process plus white noise (post-fit projected).
+
+    The per-pulsar injector above draws independent coefficient sets — it
+    can validate spectra but carries no inter-pulsar correlation.  Here
+    the Fourier coefficients of all pulsars are drawn jointly on the
+    common ``Tspan`` grid with per-frequency covariance
+    ``phi_j * G`` (``G`` the named ORF over the pulsar positions), the
+    signature the correlated-ORF samplers exist to recover.  The
+    reference can only produce such datasets through libstempo/toasim
+    (``singlepulsar_sim...ipynb``); this is dependency-free and
+    deterministic in ``seed``.
+
+    Returns ``(new_psrs, a)`` — pulsars with replaced residuals (same
+    order) and the injected coefficients ``a`` of shape (P, 2*nmodes).
+    """
+    import dataclasses
+
+    from ..models.orf import orf_matrix
+    from .dataset import get_tspan
+    from .fourier import fourier_basis
+
+    psrs = list(psrs)
+    P = len(psrs)
+    Tspan = get_tspan(psrs)
+    from ..models.orf import ORFS
+
+    if orf not in ORFS:
+        raise NotImplementedError(
+            f"inject_correlated supports the fixed two-point ORFs "
+            f"{sorted(ORFS)}; got '{orf}'")
+    G = orf_matrix(orf, [p.pos for p in psrs])
+    # eigh square root, not Cholesky: monopole/dipole are PSD but
+    # rank-deficient, and injection from a degenerate G is well-defined
+    w, V = np.linalg.eigh(G)
+    Lg = V * np.sqrt(np.clip(w, 0.0, None))[None, :]
+    rng = np.random.default_rng(_stable_seed("correlated", seed))
+    # joint draw: cov over pulsars = phi_j * G per coefficient column
+    f = np.repeat(np.arange(1, nmodes + 1) / Tspan, 2)
+    phi = powerlaw_psd(f, log10_A, gamma, 1.0 / Tspan)
+    a = (Lg @ rng.normal(size=(P, 2 * nmodes))) * np.sqrt(phi)[None, :]
+
+    out = []
+    for ii, p in enumerate(psrs):
+        F, _ = fourier_basis(p.toas / DAY, nmodes, Tspan)
+        noise_rng = np.random.default_rng(_stable_seed(p.name, seed + 1))
+        r = F @ a[ii] + noise_rng.normal(size=p.ntoa) * p.toaerrs * efac
+        out.append(dataclasses.replace(p, residuals=_postfit_project(
+            p.Mmat, r)))
+    return out, a

@@ -1,0 +1,112 @@
+"""Correlated-GWB injection + recovery of inter-pulsar correlations.
+
+The per-pulsar injector validates spectra; ``inject_correlated`` draws all
+pulsars' Fourier coefficients jointly with per-frequency covariance
+``phi_j G`` so the correlated-ORF samplers can be validated against a
+known *correlation* truth — something the reference could only set up
+through libstempo/toasim.
+"""
+
+import os
+
+import numpy as np
+
+from pulsar_timing_gibbsspec_tpu.data import load_directory
+from pulsar_timing_gibbsspec_tpu.data.simulate import inject_correlated
+from pulsar_timing_gibbsspec_tpu.models.factory import model_general
+from pulsar_timing_gibbsspec_tpu.models.orf import orf_matrix
+from pulsar_timing_gibbsspec_tpu.sampler.blocks import BlockIndex
+from pulsar_timing_gibbsspec_tpu.sampler.compiled import compile_pta
+from pulsar_timing_gibbsspec_tpu.sampler.gibbs import PTABlockGibbs
+
+REFDATA = os.environ.get("PTGIBBS_REFDATA",
+                         "/root/reference/simulated_data")
+
+
+def test_injected_coefficient_covariance():
+    """Across seeds, the empirical cross-pulsar correlation of the injected
+    coefficients converges to the requested ORF matrix."""
+    psrs = load_directory(REFDATA)[:3]
+    draws = np.stack([
+        inject_correlated(psrs, orf="hd", nmodes=4, seed=s)[1]
+        for s in range(300)])                        # (S, P, 2K)
+    G = orf_matrix("hd", [p.pos for p in psrs])
+    flat = draws.transpose(1, 0, 2).reshape(3, -1)   # (P, S*2K)
+    # normalize out the per-column phi scale: correlation, not covariance
+    emp = np.corrcoef(flat)
+    np.testing.assert_allclose(emp, G, atol=0.08)
+
+
+def test_orf_likelihood_locates_quadrupole():
+    """Freeze the gw coefficients at an HD-injected truth and scan the
+    legendre quadrupole weight: the coefficient-conditional ORF
+    likelihood must peak at positive theta_2 (HD is quadrupole-
+    dominated), and at ~0 for an uncorrelated injection."""
+    import jax.numpy as jnp
+
+    from pulsar_timing_gibbsspec_tpu.sampler import jax_backend as jb
+
+    psrs = load_directory(REFDATA)[:8]
+    # 2K coefficient vectors inform the P-dim correlation; keep 2K >> P
+    # or the frozen-b scan hits the degenerate-MLE spike at singular G
+    # (the sampler itself is immune: prior-bounded theta, b redrawn)
+    K = 10
+    peaks = {}
+    for orf_inj in ("hd", "crn"):
+        inj, a = inject_correlated(psrs, orf=orf_inj, nmodes=K, seed=3,
+                                   log10_A=np.log10(5e-14))
+        pta = model_general(inj, tm_svd=True, red_var=False,
+                            white_vary=False, common_psd="spectrum",
+                            common_components=K, orf="legendre_orf",
+                            leg_lmax=2)
+        cm = compile_pta(pta)
+        names = list(pta.param_names)
+        # state: true per-bin power in rho, theta at 0; coefficients at
+        # the injected truth
+        x = np.zeros(cm.nx)
+        rho_names = [n for n in names if "rho" in n]
+        tau = 0.5 * (a[:, ::2] ** 2 + a[:, 1::2] ** 2).mean(axis=0)
+        for k, nm in enumerate(sorted(rho_names)):
+            x[names.index(nm)] = 0.5 * np.log10(tau[k])
+        b = np.zeros((cm.P, cm.Bmax))
+        np.put_along_axis(b, np.asarray(cm.gw_sin_ix), a[:, ::2], axis=1)
+        np.put_along_axis(b, np.asarray(cm.gw_cos_ix), a[:, 1::2], axis=1)
+        lnlike = jb.lnlike_orf_fn(cm, jnp.asarray(b, cm.cdtype))
+        j2 = names.index("gw_legendre_orf_orfw_leg_2")
+        grid = np.linspace(-0.45, 0.45, 61)
+        vals = []
+        for t in grid:
+            q = x.copy()
+            q[j2] = t
+            vals.append(float(lnlike(jnp.asarray(q, cm.cdtype))))
+        # grid points where G(theta) leaves the PD cone evaluate to NaN
+        peaks[orf_inj] = grid[int(np.nanargmax(vals))]
+    assert peaks["hd"] > 0.12, peaks
+    assert abs(peaks["crn"]) < peaks["hd"] / 2, peaks
+
+
+def test_end_to_end_correlation_recovery(tmp_path):
+    """Sample a legendre-ORF model on strongly HD-correlated data: the
+    posterior-mean correlation curve must carry the HD signature —
+    positive at small separations, lower near 90 degrees."""
+    from scipy.special import eval_legendre
+
+    psrs = load_directory(REFDATA)[:8]
+    inj, _ = inject_correlated(psrs, orf="hd", nmodes=4, seed=5,
+                               log10_A=np.log10(5e-14))
+    pta = model_general(inj, tm_svd=True, red_var=False, white_vary=False,
+                        common_psd="spectrum", common_components=4,
+                        orf="legendre_orf", leg_lmax=2)
+    idx = BlockIndex.build(pta.param_names)
+    g = PTABlockGibbs(pta, backend="jax", seed=6, progress=False)
+    chain = g.sample(pta.initial_sample(np.random.default_rng(0)),
+                     outdir=str(tmp_path / "rec"), niter=1200)
+    assert np.all(np.isfinite(chain))
+    th = chain[300:, idx.orf].mean(axis=0)           # (3,) legendre weights
+
+    def curve(cosz):
+        return sum(th[l] * eval_legendre(l, cosz) for l in range(3))
+
+    # HD: +0.5 at zeta -> 0, ~-0.09 at 90 degrees
+    assert curve(0.999) > curve(0.0) + 0.1, (th, curve(0.999), curve(0.0))
+    assert curve(0.999) > 0.05, th
