@@ -32,6 +32,10 @@ def main():
     ap.add_argument("--red", action="store_true",
                     help="add per-pulsar intrinsic red free spectra "
                     "(correlated gw keeps its own basis columns)")
+    ap.add_argument("--orf", default="hd",
+                    help="hd | freq_hd | st | gw_dipole | gw_monopole, or "
+                    "the parameterized bin_orf / legendre_orf (sampled "
+                    "correlation weights)")
     args = ap.parse_args()
 
     from pulsar_timing_gibbsspec_tpu import model_general
@@ -51,7 +55,7 @@ def main():
                         red_psd="spectrum", red_components=args.nbins,
                         white_vary=False,
                         common_psd="spectrum", common_components=args.nbins,
-                        orf="hd")
+                        orf=args.orf)
     gibbs = PTABlockGibbs(pta, backend=args.backend, seed=0)
     x0 = gibbs.initial_sample(np.random.default_rng(0))
     chain = gibbs.sample(x0, outdir="./chains_hd_demo", niter=args.niter)
@@ -64,6 +68,12 @@ def main():
     for j, k in enumerate(idx.rho):
         q16, q50, q84 = np.quantile(chain[burn:, k], [0.16, 0.5, 0.84])
         print(f"{j:4d} {q50:9.2f} {q16:9.2f} {q84:9.2f}")
+    if len(idx.orf):
+        print("\nsampled ORF weights (median [16%, 84%]):")
+        for k in idx.orf:
+            q16, q50, q84 = np.quantile(chain[burn:, k], [0.16, 0.5, 0.84])
+            print(f"  {pta.param_names[k]:36s} {q50:6.2f} "
+                  f"[{q16:6.2f}, {q84:6.2f}]")
     print("\nchain files in ./chains_hd_demo/")
 
 
