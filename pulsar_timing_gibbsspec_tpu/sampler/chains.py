@@ -54,6 +54,35 @@ class ChainStore:
         with open(self.outdir / "metrics.jsonl", "a") as fh:
             fh.write(json.dumps(record) + "\n")
 
+    def export_hdf5(self, chain, bchain, upto, extra_attrs=None):
+        """Write ``chain.h5`` — the HDF5 chain container the reference
+        leaves as a TODO ("definitely need to make hdf5 files... and
+        la_forge core readers", ``pulsar_gibbs.py:707-708``).  Layout is
+        la-forge-Core friendly: a ``chain`` dataset with the parameter
+        names in ``params`` (plus the coefficient chain and its names),
+        attributes carrying the row count.  Requires ``h5py``; raises a
+        clear error when it is missing."""
+        try:
+            import h5py
+        except ImportError as exc:       # pragma: no cover
+            raise RuntimeError(
+                "HDF5 export requires h5py (chain.npy/bchain.npy remain "
+                "the canonical outputs)") from exc
+
+        tmp = self.outdir / "chain.h5.tmp"
+        with h5py.File(tmp, "w") as fh:
+            fh.create_dataset("chain", data=np.asarray(chain[:upto]))
+            fh.create_dataset("bchain", data=np.asarray(bchain[:upto]))
+            st = h5py.string_dtype()
+            fh.create_dataset("params", data=np.asarray(self.param_names,
+                                                        dtype=st))
+            fh.create_dataset("b_params", data=np.asarray(self.b_param_names,
+                                                          dtype=st))
+            fh.attrs["niter"] = int(upto)
+            for k, v in (extra_attrs or {}).items():
+                fh.attrs[k] = v
+        os.replace(tmp, self.outdir / "chain.h5")
+
     def load_resume(self):
         """Return (chain, bchain, start_iter, adapt_state) or None if there
         is nothing to resume from."""

@@ -72,14 +72,18 @@ class _GibbsBase:
     # -- main loop -----------------------------------------------------------
 
     def sample(self, xs, outdir="./chains", niter=10000, resume=False,
-               save_every=100):
+               save_every=100, hdf5=False):
         """Run ``niter`` Gibbs sweeps, persisting chains to ``outdir``
         (reference ``sample`` at ``pulsar_gibbs.py:620-710``, with resume
         reading what was saved and adaptation state checkpointed).
 
         With ``nchains=C > 1`` (jax backend) the chain files gain a chains
         axis — ``chain.npy`` is (niter, C, npar) — and ``xs`` may be either
-        one start point (tiled) or per-chain (C, npar) starts."""
+        one start point (tiled) or per-chain (C, npar) starts.
+
+        ``hdf5=True`` additionally writes ``chain.h5`` at the end (the
+        la-forge-friendly container the reference leaves as a TODO at
+        ``pulsar_gibbs.py:707-708``)."""
         xs = np.atleast_1d(np.asarray(xs, dtype=np.float64))
         npar = len(self.param_names)
         C = getattr(self._backend, "C", 1)
@@ -153,6 +157,9 @@ class _GibbsBase:
                           f"({rate:.1f}/s)", end="", flush=True)
         if self.progress:
             print()
+        if hdf5:
+            store.export_hdf5(chain, bchain, niter,
+                              extra_attrs={"backend": self.backend_name})
         self.chain = chain
         self.bchain = bchain
         return chain

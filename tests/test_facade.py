@@ -99,3 +99,25 @@ def test_pta_common_rho_couples_pulsars(psrs8):
     g.b[1][g.gwid[1]] = 3e-6
     draws_big = np.array([g.update_rho(x)[g.idx.rho] for _ in range(400)])
     assert draws_big.mean() > draws_small.mean() + 0.2
+
+
+def test_hdf5_export_roundtrip(j1713, tmp_path):
+    """sample(hdf5=True) writes the la-forge-friendly chain.h5 the
+    reference leaves as a TODO (pulsar_gibbs.py:707-708); contents match
+    the canonical npy chains."""
+    h5py = pytest.importorskip("h5py")
+    from pulsar_timing_gibbsspec_tpu.models.factory import model_general
+    from pulsar_timing_gibbsspec_tpu.sampler.gibbs import PulsarBlockGibbs
+
+    pta = model_general([j1713], tm_svd=True, red_var=False,
+                        white_vary=False, common_psd="spectrum",
+                        common_components=5)
+    g = PulsarBlockGibbs(pta, backend="numpy", seed=1, progress=False)
+    chain = g.sample(pta.initial_sample(np.random.default_rng(0)),
+                     outdir=str(tmp_path / "h5"), niter=40, hdf5=True)
+    with h5py.File(tmp_path / "h5" / "chain.h5") as fh:
+        np.testing.assert_array_equal(fh["chain"][...], chain)
+        assert fh["bchain"].shape[0] == 40
+        assert [s.decode() for s in fh["params"][...]] == pta.param_names
+        assert fh.attrs["niter"] == 40
+        assert fh.attrs["backend"] == "numpy"
