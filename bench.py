@@ -111,8 +111,24 @@ def main(argv=None):
     pta = build_pta(n_psr=n_psr)
     x0 = pta.initial_sample(np.random.default_rng(0))
 
-    jax_rate, C = bench_jax(pta, x0, niter, adapt, nchains,
-                            profile=args.profile)
+    # the tunneled TPU's remote-compile endpoint drops transiently
+    # ("read body: response body closed..."); retry with a fresh driver
+    # rather than failing the whole benchmark on a transport hiccup
+    last = None
+    for attempt in range(3):
+        try:
+            jax_rate, C = bench_jax(pta, x0, niter, adapt, nchains,
+                                    profile=args.profile)
+            break
+        except Exception as exc:
+            if "remote_compile" not in str(exc):
+                raise
+            last = exc
+            print(f"# remote-compile transport dropped "
+                  f"(attempt {attempt + 1}/3); retrying", file=sys.stderr)
+            time.sleep(20)
+    else:
+        raise last
     np_rate = bench_numpy(pta, np.asarray(x0, np.float64), np_iters, adapt)
 
     # the headline is total posterior samples/sec of one chip (C vmapped
