@@ -236,6 +236,10 @@ def test_resume_bitwise_hd_red_and_tprocess(psrs8, j1713, tmp_path):
             [j1713], tm_svd=True, red_var=True, red_psd="tprocess",
             red_components=4, white_vary=True, common_psd="spectrum",
             common_components=4)),
+        "paramorf": (PTABlockGibbs, model_general(
+            psrs8[:3], tm_svd=True, red_var=False, white_vary=False,
+            common_psd="spectrum", common_components=4,
+            orf="legendre_orf", leg_lmax=1)),
     }
     for lab, (cls, pta) in cases.items():
         x0 = pta.initial_sample(np.random.default_rng(6))

@@ -77,6 +77,21 @@ def test_non_pd_start_rejected(psrs8, tmp_path):
             g.sample(x0, outdir=str(tmp_path / backend), niter=10)
 
 
+def test_param_orf_nchains(psrs8, tmp_path):
+    """The ORF-weight MH block composes with the vmapped chains axis."""
+    pta = model_general(psrs8[:3], tm_svd=True, red_var=False,
+                        white_vary=False, common_psd="spectrum",
+                        common_components=4, orf="legendre_orf", leg_lmax=1)
+    idx = BlockIndex.build(pta.param_names)
+    g = PTABlockGibbs(pta, backend="jax", seed=7, progress=False, nchains=3)
+    chain = g.sample(pta.initial_sample(np.random.default_rng(1)),
+                     outdir=str(tmp_path / "c3"), niter=120)
+    assert chain.shape[1] == 3 and np.all(np.isfinite(chain))
+    # chains evolve independently: their theta trajectories differ
+    th = chain[60:, :, idx.orf]
+    assert not np.allclose(th[:, 0], th[:, 1])
+
+
 def test_param_orf_jax_vs_numpy_equivalence(psrs8, tmp_path):
     """Backend statistical equivalence on the sampled weights and the
     common spectrum (ESS-aware z-tests); theta starts at 0 (G = I)."""
