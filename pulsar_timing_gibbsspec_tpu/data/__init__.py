@@ -1,4 +1,5 @@
-from .dataset import Pulsar, load_pulsar, load_directory, get_tspan
+from .dataset import (Pulsar, load_pulsar, load_directory, get_tspan,
+                      from_enterprise)
 from .partim import parse_par, parse_tim
 from .fourier import fourier_basis
 from .design import design_matrix

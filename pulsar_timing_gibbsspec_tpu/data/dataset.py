@@ -146,15 +146,43 @@ def get_tspan(psrs) -> float:
 
 
 def from_enterprise(epsr) -> Pulsar:
-    """Adapter from an ``enterprise.Pulsar`` (optional dependency)."""
+    """Adapter from an ``enterprise.Pulsar`` to the host-side container.
+
+    Duck-typed on the enterprise Pulsar attribute surface (``name``,
+    ``toas`` [s], ``toaerrs`` [s], ``residuals`` [s], ``freqs`` [MHz],
+    ``backend_flags``, ``Mmat``, ``fitpars``, ``flags``, ``pos``) rather
+    than an import, so it needs no enterprise at definition time and any
+    object exposing those attributes converts.  This is the reference's
+    real-data path (``clean_demo.ipynb`` cells 3-5: a NANOGrav 9-yr pulsar
+    with its full tempo2 timing solution): the enterprise-built design
+    matrix and post-fit residuals flow in at full fidelity, replacing this
+    package's leading-order ``design_matrix`` for real datasets.
+    """
+    toas = np.asarray(epsr.toas, dtype=np.float64)
+    Mmat = np.asarray(epsr.Mmat, dtype=np.float64)
+    if Mmat.ndim != 2 or Mmat.shape[0] != toas.shape[0]:
+        raise ValueError(
+            f"{epsr.name}: Mmat shape {Mmat.shape} does not match "
+            f"{toas.shape[0]} TOAs")
+    # enterprise flags are per-TOA arrays keyed by flag name; keep them,
+    # but normalize 'pta' to a scalar label (the partim-loader convention
+    # consumed by the factory's ECORR gate, reference
+    # model_definition.py:221 "'NANOGrav' in p.flags['pta']")
+    flags = {}
+    for key, val in dict(getattr(epsr, "flags", {}) or {}).items():
+        arr = np.asarray(val)
+        flags[key] = str(arr.flat[0]) if key == "pta" and arr.size else arr
+    flags.setdefault("pta", "")
+    pos = np.asarray(getattr(epsr, "pos", np.zeros(3)), dtype=np.float64)
     return Pulsar(
-        name=epsr.name,
-        toas=np.asarray(epsr.toas, dtype=np.float64),
+        name=str(epsr.name),
+        toas=toas,
         toaerrs=np.asarray(epsr.toaerrs, dtype=np.float64),
         residuals=np.asarray(epsr.residuals, dtype=np.float64),
         freqs=np.asarray(epsr.freqs, dtype=np.float64),
         backend_flags=np.asarray(epsr.backend_flags, dtype=object),
-        Mmat=np.asarray(epsr.Mmat, dtype=np.float64),
+        Mmat=Mmat,
         fitpars=list(epsr.fitpars),
-        flags={"pta": epsr.flags["pta"][0] if "pta" in epsr.flags else ""},
+        flags=flags,
+        pos=pos,
     )

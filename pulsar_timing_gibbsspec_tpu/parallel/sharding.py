@@ -44,13 +44,24 @@ _REPLICATED_FIELDS = ("const_pool", "pkind", "pa", "pb", "prop_scale",
 
 def make_mesh(n_devices: int | None = None, axis: str = "pulsar"):
     """A 1-d device mesh over the first ``n_devices`` devices (all by
-    default).  Multi-host extension: pass the global device list order so
+    default).  Raises if fewer than ``n_devices`` devices exist — an
+    under-provisioned mesh would silently drop the sharding it is supposed
+    to exercise.  Multi-host extension: pass the global device list order so
     the pulsar axis rides ICI within each slice before spanning DCN."""
     import jax
     from jax.sharding import Mesh
 
     devs = jax.devices()
     if n_devices is not None:
+        if len(devs) < n_devices:
+            raise RuntimeError(
+                f"make_mesh({n_devices}) but only {len(devs)} "
+                f"{devs[0].platform if devs else '?'} device(s) are "
+                "available; refusing to build a truncated mesh. For a "
+                "hardware-free run force the CPU backend with "
+                "jax.config.update('jax_platforms', 'cpu') and "
+                "XLA_FLAGS=--xla_force_host_platform_device_count=N "
+                "before backend init.")
         devs = devs[:n_devices]
     return Mesh(np.asarray(devs), (axis,))
 

@@ -171,12 +171,15 @@ def profile_blocks(driver, x, repeats=5, inner=50):
         ns = driver.red_steps
         U = jnp.asarray(driver.red_U)
         S = jnp.asarray(driver.red_S)
+        # time the production kernel incl. the DE history gather
+        hist = (None if driver.red_hist is None
+                else jnp.asarray(driver.red_hist, cm.cdtype))
 
-        def red1(x, b, k, U, S):
-            return jb.red_mh_block(cm, x, b, k, U, S, ns), b
+        def red1(x, b, k, U, S, h):
+            return jb.red_mh_block(cm, x, b, k, U, S, ns, hist=h), b
 
         def redmh(x, b, k):
-            return jax.vmap(red1)(x, b, jr.split(k, C), U, S)
+            return jax.vmap(red1)(x, b, jr.split(k, C), U, S, hist)
 
         out[f"red_mh[{ns}]"] = _scan_time(redmh, x, b, inner, repeats)
 

@@ -291,7 +291,10 @@ class CompiledPTA:
                 args = [xev[c.hyp_ix[:, h]][:, None]
                         for h in range(c.hyp_ix.shape[1])]
                 vals = jnp.exp(fn(c.f, c.df, *args))
-            phi = phi.at[rows, c.cols].add(vals, mode="drop")
+            # c.f/c.df are stored f64, so powerlaw-family vals promote to
+            # f64; cast before the scatter (f64->f32 scatter is a
+            # FutureWarning today and a hard error in future JAX)
+            phi = phi.at[rows, c.cols].add(vals.astype(dtype), mode="drop")
         return phi
 
     def phi(self, x, dtype=None):

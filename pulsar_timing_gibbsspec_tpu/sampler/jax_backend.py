@@ -46,6 +46,18 @@ from .compiled import CompiledPTA, compile_pta
 
 _SCALES = np.array([0.1, 0.5, 1.0, 3.0, 10.0])
 _SCALE_P = np.array([0.1, 0.15, 0.5, 0.15, 0.1])
+#: rows in the per-chain DE history buffer (past red-block states)
+DE_HIST_LEN = 64
+#: DE history refresh period and chain-row delay, in *absolute iteration*
+#: units.  The buffer for iterations [m*DE_Q, (m+1)*DE_Q) is always the
+#: chain rows [m*DE_Q - DE_DELAY - H, m*DE_Q - DE_DELAY): a pure function
+#: of the iteration index, never of the chunk/dispatch grid — resume
+#: restarts chunks at checkpoint rows that are off the original grid, so
+#: any grid-dependent refresh would break bitwise resume.  DE_DELAY >=
+#: DE_Q + chunk_size guarantees the rows are already written (or
+#: preloaded) at dispatch time under the double-buffered chunk loop.
+DE_Q = 128
+DE_DELAY = 256
 
 
 # ===========================================================================
@@ -623,35 +635,64 @@ def ecorr_ll_rel(cm: CompiledPTA, x0, b):
     return ll_rel
 
 
-def red_mh_block(cm: CompiledPTA, x, b, key, U, S, nsteps):
+def red_mh_block(cm: CompiledPTA, x, b, key, U, S, nsteps, hist=None):
     """Per-sweep power-law hyper block (intrinsic red, varied common
-    process, chromatic DM): `nsteps` MH steps mixing adapted-
-    eigendirection (SCAM, reference PTMCMC's workhorse jump) and the
+    process, chromatic DM): `nsteps` MH steps mixing differential-
+    evolution (pair differences from a past-sample history buffer, the
+    reference PTMCMC's highest-weighted jump: DE=50 vs SCAM=30/AM=15 at
+    ``pulsar_gibbs.py:294``), adapted-eigendirection (SCAM) and the
     single-site scale-mixture proposal, on the cheap b-conditional
-    likelihood (reference ``pulsar_gibbs.py:300-327``)."""
+    likelihood (reference ``pulsar_gibbs.py:300-327``).
+
+    ``hist`` is a frozen (H, d) buffer of past red-block states
+    (ter Braak & Vrugt 2008 "DE-MC with sampling from the past": a
+    periodically-refreshed history keeps the chain ergodic while every
+    proposal stays symmetric, so the plain Metropolis accept is exact);
+    ``None`` compiles the SCAM/single-site-only variant.  The caller
+    selects the buffer for the current DE period (see ``DE_Q``) before
+    passing it in."""
     import jax
     import jax.numpy as jnp
     import jax.random as jr
 
     rind = jnp.asarray(cm.idx.red)
-    sigma = 0.05 * len(cm.idx.red)
+    d = len(cm.idx.red)
+    sigma = 0.05 * d
     _, phi_dyn = cm.phi_hyper_split(x)      # static comps evaluated once
     lnlike = lambda q: lnlike_hyper_fn(cm, q, b, phi_fn=phi_dyn)
     scales = jnp.asarray(_SCALES, dtype=cm.cdtype)
     probs = jnp.asarray(_SCALE_P, dtype=cm.cdtype)
+    use_de = hist is not None
+    if use_de:
+        H = hist.shape[0]
+        gamma0 = jnp.asarray(2.38 / np.sqrt(2.0 * d), cm.cdtype)
 
     def step(carry, key):
         x, ll0, lp0 = carry
-        k0, k1, k2, k3, k4 = jr.split(key, 5)
+        k0, k1, k2, k3, k4, k5 = jr.split(key, 6)
         # SCAM branch: jump along one adapted covariance eigendirection
-        j = jr.randint(k1, (), 0, len(cm.idx.red))
+        j = jr.randint(k1, (), 0, d)
         stepsz = 2.38 * jnp.sqrt(S[j]) * jr.normal(k2, dtype=cm.cdtype)
         q_scam = x.at[rind].add(stepsz * U[:, j])
         # single-site branch
         scale = jr.choice(k1, scales, p=probs)
-        jj = rind[jr.randint(k2, (), 0, len(cm.idx.red))]
+        jj = rind[jr.randint(k2, (), 0, d)]
         q_ss = x.at[jj].add(jr.normal(k3, dtype=cm.cdtype) * sigma * scale)
-        q = jnp.where(jr.uniform(k0) < 0.5, q_scam, q_ss)
+        r = jr.uniform(k0)
+        if use_de:
+            # DE branch: gamma (h_a - h_b) over two distinct history rows;
+            # 10% of jumps use gamma=1 for mode hopping (standard DE-MC)
+            ka, kb, kg = jr.split(k5, 3)
+            a_ix = jr.randint(ka, (), 0, H)
+            b_ix = (a_ix + 1 + jr.randint(kb, (), 0, H - 1)) % H
+            gamma = jnp.where(jr.uniform(kg) < 0.1, 1.0, gamma0)
+            q_de = x.at[rind].add(gamma * (hist[a_ix] - hist[b_ix]))
+            # weights mirror the reference ratios: DE .5 / SCAM .3 /
+            # single-site .2
+            q = jnp.where(r < 0.5, q_de,
+                          jnp.where(r < 0.8, q_scam, q_ss))
+        else:
+            q = jnp.where(r < 0.5, q_scam, q_ss)
         lp1 = cm.lnprior(q)
         ll1 = lnlike(q)
         ok = jnp.isfinite(lp1) & jnp.isfinite(ll1)
@@ -1044,6 +1085,16 @@ class JaxGibbsDriver:
                                    and bool(np.any(np.asarray(cm.red_rho_ix_x)
                                                    < cm.nx)))
         self.do_red_mh = len(cm.idx.red) > 0
+        if self.do_red_mh and self.chunk_size > DE_DELAY - DE_Q:
+            # a larger chunk could outrun the DE history delay (rows not
+            # yet written at dispatch), and a silent seed-freeze fallback
+            # would make the sampled process depend on chunk_size —
+            # breaking the chunk-grid-independence that bitwise resume
+            # rests on
+            raise ValueError(
+                f"chunk_size={self.chunk_size} exceeds the DE history "
+                f"delay margin ({DE_DELAY - DE_Q}); use chunk_size <= "
+                f"{DE_DELAY - DE_Q} for models with a red hyper MH block")
         # sampled ORF weights (bin_orf / legendre_orf): MH block on the
         # coefficient-conditional correlated likelihood
         self.do_orf_mh = cm.orf_B is not None and len(cm.idx.orf) > 0
@@ -1067,6 +1118,13 @@ class JaxGibbsDriver:
         self.cov_red = None
         self.red_U = None
         self.red_S = None
+        #: (C, H, d) frozen DE history (ter Braak-style sampling from the
+        #: past), seeded from the adaptation record and refreshed from
+        #: already-written chain rows at chunk dispatch (always a full
+        #: chunk behind, so the refresh is a pure function of the row
+        #: index and resume stays bitwise)
+        self.red_hist = None
+        self._de_dev_cache = {}
         self.aclength_ecorr = None
         self.b = np.zeros((self.C, cm.P, cm.Bmax), dtype=cm.cdtype)
         self._sweep_fns = {}
@@ -1248,6 +1306,13 @@ class JaxGibbsDriver:
                             + 1e-12 * np.eye(d))
             self.cov_red = np.stack(covs)             # (C, d, d)
             self._set_red_eigs()
+            # seed the DE history from the post-burn adaptation record
+            # (thinned to H rows); chunk dispatches refresh it from chain
+            # rows once enough are written
+            burn0 = min(100, rec.shape[1] // 2)
+            take = np.linspace(burn0, rec.shape[1] - 1,
+                               DE_HIST_LEN).astype(int)
+            self.red_hist = rec[:, take, :]           # (C, H, d)
 
         if cm.K and len(cm.rho_ix_x):
             self.key, k = jr.split(self.key)
@@ -1289,12 +1354,19 @@ class JaxGibbsDriver:
 
     # ---- per-sweep kernel ---------------------------------------------------
 
-    def _aux(self):
+    def _aux(self, chain=None, ii=None):
         """Per-chain adaptation state passed to the sweep body as explicit
         jit arguments (never closure constants: a cached chunk function
         must not bake in stale proposal state).  Entries for inactive
         blocks are None, which vanishes from the pytree so vmap/jit only
-        see the live arrays."""
+        see the live arrays.
+
+        When ``(chain, ii)`` is given (steady-chunk dispatch), the DE
+        history entries are the buffers for the DE periods the chunk can
+        touch, plus the per-iteration switch index — the compiled body
+        selects between them by the absolute iteration, so the history a
+        sweep sees is a pure function of the iteration index and resume
+        stays bitwise no matter where checkpoints land."""
         import jax.numpy as jnp
 
         dt = self.cm.dtype
@@ -1302,6 +1374,17 @@ class JaxGibbsDriver:
         def cast(a):
             return None if a is None else jnp.asarray(a, dt)
 
+        if self.red_hist is None:
+            de = (None, None, None)
+        else:
+            if chain is None or ii is None:
+                hp = hn = jnp.asarray(self.red_hist, self.cm.cdtype)
+                sw = np.iinfo(np.int32).max
+            else:
+                m0 = ii // DE_Q
+                hp, hn = self._de_bufs(chain, m0)
+                sw = (m0 + 1) * DE_Q
+            de = (hp, hn, jnp.full((self.C,), sw, jnp.int32))
         return (
             cast(self.chol_white), cast(self.mode_white),
             cast(self.asqrt_white),
@@ -1309,6 +1392,7 @@ class JaxGibbsDriver:
             cast(self.asqrt_ecorr),
             None if self.red_U is None else jnp.asarray(self.red_U),
             None if self.red_S is None else jnp.asarray(self.red_S),
+            *de,
         )
 
     def _sweep_body(self, bdraw="mh"):
@@ -1334,7 +1418,11 @@ class JaxGibbsDriver:
         def body(carry, key, aux, t):
             x, b, u = carry
             (chol_w, mode_w, asq_w, chol_e, mode_e, asq_e,
-             red_U, red_S) = aux
+             red_U, red_S, hist_a, hist_b, de_sw) = aux
+            # per-iteration DE-period select: pure in the absolute
+            # iteration index, so chunk/checkpoint grids cannot shift it
+            red_hist = (None if hist_a is None
+                        else jnp.where(t < de_sw, hist_a, hist_b))
             out = (x, b)
             k = jr.split(key, 8)
             if len(cm.idx.white) and nw:
@@ -1356,7 +1444,7 @@ class JaxGibbsDriver:
                 x = tprocess_alpha_update(cm, x, b, k[6])
             if self.do_red_mh:
                 x = red_mh_block(cm, x, b, k[5], red_U, red_S,
-                                 self.red_steps)
+                                 self.red_steps, hist=red_hist)
             if cm.K and len(cm.rho_ix_x):
                 x = rho_update(cm, x, b, k[3])
             if self.do_orf_mh:
@@ -1575,6 +1663,11 @@ class JaxGibbsDriver:
                     f"correlation matrix (min eigenvalue {wmin.min():.2e}); "
                     "start the *_orfw_* parameters at 0 (G = identity) — "
                     "x0[idx.orf] = 0")
+        # a fresh run invalidates DE buffers derived from a previous
+        # run's chain rows (the facade reuses one backend across
+        # sample() calls); the seed entry (-1) is still valid but cheap
+        # to rebuild once per run
+        self._de_dev_cache = {}
         ii = start
         if ii == 0:
             # draw b from the initial state before any conditional touches
@@ -1646,7 +1739,7 @@ class JaxGibbsDriver:
             fn = self._chunk_fn(self.chunk_size)
             x, b_dev, xs, bs = fn(x, b_dev, self.key,
                                   jnp.asarray(ii, dtype=jnp.int32),
-                                  self._aux())
+                                  self._aux(chain, ii))
             if n < self.chunk_size:
                 x, b_dev = xs[n], bs[n]
                 xs, bs = xs[:n], bs[:n]
@@ -1656,6 +1749,46 @@ class JaxGibbsDriver:
             ii += n
         if pending is not None:
             yield _writeback(*pending)
+
+    def _de_hist_for(self, chain, m):
+        """(C, H, d) DE history for DE period ``m`` (iterations
+        [m*DE_Q, (m+1)*DE_Q)): chain rows [m*DE_Q - DE_DELAY - H,
+        m*DE_Q - DE_DELAY).  DE_DELAY >= DE_Q + chunk guarantees those
+        rows were written back (or preloaded, on resume) before any chunk
+        touching period ``m`` is dispatched (chunk_size is capped at
+        DE_DELAY - DE_Q in the constructor); until the window exists the
+        adaptation-record seed, checkpointed in adapt_state, is used."""
+        lo = m * DE_Q - DE_DELAY - DE_HIST_LEN
+        hi = m * DE_Q - DE_DELAY
+        if lo < 0:
+            return self.red_hist
+        rows = np.asarray(chain[lo:hi], dtype=np.float64)
+        if rows.ndim == 2:          # squeezed single-chain layout
+            rows = rows[:, None, :]
+        return np.ascontiguousarray(
+            rows[:, :, np.asarray(self.cm.idx.red)].transpose(1, 0, 2))
+
+    def _de_bufs(self, chain, m0):
+        """Device-resident DE buffers for periods ``(m0, m0+1)``,
+        memoized: a period spans DE_Q/chunk dispatches, so rebuilding +
+        re-uploading the (C, H, d) buffers every chunk would ship
+        identical bytes down the (tunneled) device link most dispatches.
+        The seed buffer is cached once under key -1 — every pre-window
+        period shares the same device array."""
+        import jax.numpy as jnp
+
+        self._de_dev_cache = {k: v for k, v in self._de_dev_cache.items()
+                              if k < 0 or k >= m0}
+        out = []
+        for m in (m0, m0 + 1):
+            key = -1 if m * DE_Q - DE_DELAY - DE_HIST_LEN < 0 else m
+            buf = self._de_dev_cache.get(key)
+            if buf is None:
+                buf = jnp.asarray(self._de_hist_for(chain, m),
+                                  self.cm.cdtype)
+                self._de_dev_cache[key] = buf
+            out.append(buf)
+        return out
 
     # ---- checkpointable state ----------------------------------------------
 
@@ -1667,7 +1800,8 @@ class JaxGibbsDriver:
                "b_pad": np.asarray(self.b, dtype=np.float64),
                "x_cur": np.asarray(getattr(
                    self, "x_cur", np.zeros((self.C, self.cm.nx))))}
-        for key in ("aclength_white", "cov_red", "aclength_ecorr",
+        for key in ("aclength_white", "cov_red", "red_hist",
+                    "aclength_ecorr",
                     "chol_white", "mode_white", "asqrt_white",
                     "chol_ecorr", "mode_ecorr", "asqrt_ecorr"):
             val = getattr(self, key)
@@ -1689,7 +1823,8 @@ class JaxGibbsDriver:
         self.b = np.asarray(state["b_pad"], dtype=self.cm.cdtype)
         if "x_cur" in state:
             self.x_resume = np.asarray(state["x_cur"], dtype=np.float64)
-        for key in ("aclength_white", "cov_red", "aclength_ecorr",
+        for key in ("aclength_white", "cov_red", "red_hist",
+                    "aclength_ecorr",
                     "chol_white", "mode_white", "asqrt_white",
                     "chol_ecorr", "mode_ecorr", "asqrt_ecorr"):
             if key in state:
@@ -1697,6 +1832,12 @@ class JaxGibbsDriver:
                 setattr(self, key, int(val) if val.ndim == 0 else val)
         if self.cov_red is not None:
             self._set_red_eigs()
+        if self.do_red_mh and self.cov_red is not None \
+                and self.red_hist is None:
+            raise RuntimeError(
+                "resume checkpoint lacks the red-block DE history "
+                "(red_hist) — it was written by an incompatible version; "
+                "delete the chain directory to start fresh")
         if len(self.cm.idx.white) and (self.aclength_white is None
                                        or self.chol_white is None
                                        or self.mode_white is None):

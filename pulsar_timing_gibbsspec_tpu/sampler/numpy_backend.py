@@ -108,6 +108,7 @@ class NumpyGibbs:
         self.aclength_white = None
         self.cov_white = None
         self.cov_red = None
+        self.red_hist = None
         self.aclength_ecorr = None
 
     # ---- parameter helpers -------------------------------------------------
@@ -292,8 +293,12 @@ class NumpyGibbs:
         """Power-law (A, gamma) block (reference :271-329).  The reference
         drives this with PTMCMCSampler (SCAM/AM/DE); here the adaptation run
         estimates the red-block covariance on the marginalized likelihood,
-        and per-sweep steps mix single-site and covariance (SCAM-style
-        eigendirection) jumps on the cheap b-conditional likelihood."""
+        and per-sweep steps mix differential-evolution (past-history pair
+        differences, the reference's top-weighted jump), covariance
+        (SCAM-style eigendirection) and single-site jumps on the cheap
+        b-conditional likelihood."""
+        from .blocks import de_step, seed_red_hist
+
         rind = self.idx.red
         if adapt:
             rec = np.zeros((self.red_adapt_iters, len(rind)))
@@ -304,6 +309,7 @@ class NumpyGibbs:
             self.cov_red = np.atleast_2d(np.cov(burn, rowvar=False))
             self.cov_red += 1e-12 * np.eye(len(rind))
             self._red_eigs = np.linalg.svd(self.cov_red)
+            self.red_hist = seed_red_hist(burn)
             return xnew
 
         x = xs.copy()
@@ -311,9 +317,13 @@ class NumpyGibbs:
         lp0 = self.get_lnprior(x)
         U, S, _ = self._red_eigs
         for _ in range(self.red_steps):
-            q = x.copy()
-            if self.rng.uniform() < 0.5:
+            r = self.rng.uniform()
+            if r < 0.5:
+                # DE: reference ratio weights it highest (DE=50/SCAM=30)
+                q = de_step(self.rng, x, rind, self.red_hist)
+            elif r < 0.8:
                 # SCAM: jump along one adapted eigendirection
+                q = x.copy()
                 j = self.rng.integers(len(rind))
                 step = 2.38 * np.sqrt(S[j]) * self.rng.standard_normal()
                 q[rind] += step * U[:, j]
@@ -323,6 +333,9 @@ class NumpyGibbs:
             ll1 = self.lnlike_red(q) if np.isfinite(lp1) else -np.inf
             if (ll1 + lp1) - (ll0 + lp0) > np.log(self.rng.uniform()):
                 x, ll0, lp0 = q, ll1, lp1
+        # roll the current state into the history (sampling from the past)
+        self.red_hist = np.roll(self.red_hist, -1, axis=0)
+        self.red_hist[-1] = x[rind]
         return x
 
     def update_red_rho(self, xs):
@@ -417,7 +430,8 @@ class NumpyGibbs:
         from .blocks import rng_state_pack
 
         out = {"rng_state": rng_state_pack(self.rng), "b": self.b}
-        for key in ("aclength_white", "cov_white", "cov_red", "aclength_ecorr"):
+        for key in ("aclength_white", "cov_white", "cov_red", "red_hist",
+                    "aclength_ecorr"):
             val = getattr(self, key)
             if val is not None:
                 out[key] = np.asarray(val)
@@ -428,9 +442,15 @@ class NumpyGibbs:
 
         rng_state_unpack(self.rng, state["rng_state"])
         self.b = np.asarray(state["b"])
-        for key in ("aclength_white", "cov_white", "cov_red", "aclength_ecorr"):
+        for key in ("aclength_white", "cov_white", "cov_red", "red_hist",
+                    "aclength_ecorr"):
             if key in state:
                 val = state[key]
                 setattr(self, key, int(val) if val.ndim == 0 else np.asarray(val))
         if self.cov_red is not None:
             self._red_eigs = np.linalg.svd(self.cov_red)
+            if self.red_hist is None:
+                raise RuntimeError(
+                    "resume checkpoint lacks the red-block DE history "
+                    "(red_hist) — it was written by an incompatible "
+                    "version; delete the chain directory to start fresh")

@@ -174,6 +174,7 @@ class NumpyPTAGibbs:
         self.aclength_white = None
         self.cov_white = None
         self.cov_red = None
+        self.red_hist = None
         self.aclength_ecorr = None
 
     # ---- helpers -----------------------------------------------------------
@@ -481,6 +482,8 @@ class NumpyPTAGibbs:
         rind = self.idx.red
         if not len(rind):
             return xs.copy()
+        from .blocks import de_step, seed_red_hist
+
         if adapt:
             rec = np.zeros((self.red_adapt_iters, len(rind)))
             xnew = self._mh_loop(xs, rind, self.lnlike_fullmarg,
@@ -489,13 +492,17 @@ class NumpyPTAGibbs:
             self.cov_red = np.atleast_2d(np.cov(burn, rowvar=False))
             self.cov_red += 1e-12 * np.eye(len(rind))
             self._red_eigs = np.linalg.svd(self.cov_red)
+            self.red_hist = seed_red_hist(burn)
             return xnew
         x = xs.copy()
         ll0, lp0 = self.lnlike_red(x), self.get_lnprior(x)
         U, S, _ = self._red_eigs
         for _ in range(self.red_steps):
-            q = x.copy()
-            if self.rng.uniform() < 0.5:
+            r = self.rng.uniform()
+            if r < 0.5:
+                q = de_step(self.rng, x, rind, self.red_hist)
+            elif r < 0.8:
+                q = x.copy()
                 j = self.rng.integers(len(rind))
                 q[rind] += 2.38 * np.sqrt(S[j]) * self.rng.standard_normal() * U[:, j]
             else:
@@ -504,6 +511,8 @@ class NumpyPTAGibbs:
             ll1 = self.lnlike_red(q) if np.isfinite(lp1) else -np.inf
             if (ll1 + lp1) - (ll0 + lp0) > np.log(self.rng.uniform()):
                 x, ll0, lp0 = q, ll1, lp1
+        self.red_hist = np.roll(self.red_hist, -1, axis=0)
+        self.red_hist[-1] = x[rind]
         return x
 
     @property
@@ -596,7 +605,8 @@ class NumpyPTAGibbs:
         out = {"rng_state": rng_state_pack(self.rng)}
         for ii, b in enumerate(self.b):
             out[f"b{ii}"] = b
-        for key in ("aclength_white", "cov_white", "cov_red", "aclength_ecorr"):
+        for key in ("aclength_white", "cov_white", "cov_red", "red_hist",
+                    "aclength_ecorr"):
             val = getattr(self, key)
             if val is not None:
                 out[key] = np.asarray(val)
@@ -607,9 +617,15 @@ class NumpyPTAGibbs:
 
         rng_state_unpack(self.rng, state["rng_state"])
         self.b = [np.asarray(state[f"b{ii}"]) for ii in range(self.P)]
-        for key in ("aclength_white", "cov_white", "cov_red", "aclength_ecorr"):
+        for key in ("aclength_white", "cov_white", "cov_red", "red_hist",
+                    "aclength_ecorr"):
             if key in state:
                 val = state[key]
                 setattr(self, key, int(val) if val.ndim == 0 else np.asarray(val))
         if self.cov_red is not None:
             self._red_eigs = np.linalg.svd(self.cov_red)
+            if self.red_hist is None:
+                raise RuntimeError(
+                    "resume checkpoint lacks the red-block DE history "
+                    "(red_hist) — it was written by an incompatible "
+                    "version; delete the chain directory to start fresh")

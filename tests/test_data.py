@@ -2,8 +2,8 @@ import numpy as np
 import pytest
 
 from pulsar_timing_gibbsspec_tpu.data import (
-    design_matrix, fourier_basis, get_tspan, load_directory, load_pulsar,
-    parse_par, parse_tim,
+    design_matrix, fourier_basis, from_enterprise, get_tspan, load_directory,
+    load_pulsar, parse_par, parse_tim,
 )
 from pulsar_timing_gibbsspec_tpu.data.simulate import inject_residuals, powerlaw_psd
 
@@ -88,3 +88,68 @@ def test_load_directory_and_tspan():
     for p in psrs:
         assert p.ntoa == len(p.residuals) == len(p.toaerrs)
         assert p.backends() == ["test"]
+
+
+class _FakeEnterprisePulsar:
+    """Synthetic object exposing the enterprise Pulsar attribute surface
+    (the reference's real-data loader, clean_demo.ipynb cells 3-5)."""
+
+    def __init__(self, n=64, m=5, seed=7):
+        rng = np.random.default_rng(seed)
+        self.name = "J0000+0000"
+        self.toas = np.sort(rng.uniform(0, 9.0 * 365.25 * 86400.0, n)) \
+            + 53000.0 * 86400.0
+        self.toaerrs = np.full(n, 5e-7)
+        self.residuals = 1e-6 * rng.standard_normal(n)
+        self.freqs = rng.choice([430.0, 1410.0], n)
+        self.backend_flags = np.asarray(
+            ["430_ASP" if f < 1000 else "L-wide_PUPPI" for f in self.freqs],
+            dtype=object)
+        self.Mmat = rng.standard_normal((n, m))
+        self.fitpars = ["Offset", "F0", "F1", "RAJ", "DECJ"]
+        # enterprise flags: per-TOA arrays keyed by flag name
+        self.flags = {
+            "pta": np.asarray(["NANOGrav"] * n, dtype=object),
+            "fe": np.asarray(["430" if f < 1000 else "L-wide"
+                              for f in self.freqs], dtype=object),
+        }
+        th, ph = 1.1, 2.2
+        self.pos = np.array([np.sin(th) * np.cos(ph),
+                             np.sin(th) * np.sin(ph), np.cos(th)])
+
+
+def test_from_enterprise_adapter():
+    epsr = _FakeEnterprisePulsar()
+    p = from_enterprise(epsr)
+    # full-fidelity passthrough: the enterprise design matrix and post-fit
+    # residuals land untouched
+    np.testing.assert_array_equal(p.Mmat, epsr.Mmat)
+    np.testing.assert_array_equal(p.residuals, epsr.residuals)
+    np.testing.assert_array_equal(p.toas, epsr.toas)
+    np.testing.assert_array_equal(p.pos, epsr.pos)
+    assert p.name == "J0000+0000"
+    assert p.fitpars == epsr.fitpars
+    assert p.backends() == ["430_ASP", "L-wide_PUPPI"]
+    # 'pta' normalized to the scalar label the factory's ECORR gate reads
+    assert p.flags["pta"] == "NANOGrav"
+    # other flags stay per-TOA
+    assert len(p.flags["fe"]) == p.ntoa
+
+    # and the product is model-ready: factory + compile accept it, with the
+    # NANOGrav flag enabling the ECORR branch under backend selection
+    from pulsar_timing_gibbsspec_tpu.models.factory import model_general
+    from pulsar_timing_gibbsspec_tpu.sampler.compiled import compile_pta
+
+    pta = model_general([p], tm_svd=True, white_vary=True,
+                        common_psd="spectrum", common_components=5,
+                        select="backend")
+    cm = compile_pta(pta)
+    assert cm.P == 1
+    assert any("ecorr" in nm for nm in pta.param_names)
+
+
+def test_from_enterprise_rejects_mismatched_design_matrix():
+    epsr = _FakeEnterprisePulsar()
+    epsr.Mmat = epsr.Mmat[:-3]
+    with pytest.raises(ValueError, match="does not match"):
+        from_enterprise(epsr)

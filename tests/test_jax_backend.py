@@ -223,6 +223,37 @@ def test_jax_resume_bitwise(j1713, tmp_path):
     np.testing.assert_array_equal(resumed, full)
 
 
+def test_resume_bitwise_across_de_refresh(j1713, tmp_path):
+    """Bitwise resume must hold across a DE-history refresh boundary
+    (iteration DE_Q*m >= DE_DELAY + DE_HIST_LEN, first at 384): the
+    refreshed buffers are rebuilt from chain rows, and the resumed run's
+    chunk grid is shifted off the original — the per-iteration period
+    select in the sweep body is what keeps the two runs identical."""
+    from pulsar_timing_gibbsspec_tpu.sampler.jax_backend import (
+        DE_DELAY, DE_HIST_LEN, DE_Q)
+
+    niter = DE_DELAY + DE_HIST_LEN + 2 * DE_Q - 60   # crosses m=3 and m=4
+    pta = model_general([j1713], tm_svd=True, red_var=True,
+                        red_psd="powerlaw", red_components=5,
+                        white_vary=False, common_psd="spectrum",
+                        common_components=5)
+    x0 = pta.initial_sample(np.random.default_rng(8))
+    kw = dict(backend="jax", seed=12, progress=False, white_adapt_iters=50,
+              chunk_size=50)
+    full = PulsarBlockGibbs(pta, **kw).sample(
+        x0, outdir=str(tmp_path / "full"), niter=niter, save_every=50)
+    # split just past the first refresh so the resumed run re-derives a
+    # refreshed (non-seed) buffer from preloaded chain rows
+    PulsarBlockGibbs(pta, **kw).sample(
+        x0, outdir=str(tmp_path / "split"), niter=3 * DE_Q + 20,
+        save_every=50)
+    resumed = PulsarBlockGibbs(pta, **kw).sample(
+        x0, outdir=str(tmp_path / "split"), niter=niter, resume=True,
+        save_every=50)
+    assert np.all(np.isfinite(full))
+    np.testing.assert_array_equal(resumed, full)
+
+
 def test_resume_bitwise_hd_red_and_tprocess(psrs8, j1713, tmp_path):
     """Bitwise resume holds for the round-2 blocks too: the correlated-ORF
     sweep with intrinsic red (carried b enters the sequential conditional)
@@ -528,6 +559,22 @@ def test_sharded_pta_sweep(pta8, tmp_path):
     # rho parameters moved (the common draw runs over the sharded axis)
     idx = BlockIndex.build(pta8.param_names)
     assert np.std(chain[1:, idx.rho[0]]) > 0
+
+
+def test_make_mesh_raises_when_under_provisioned():
+    """An under-provisioned mesh must fail loudly, never truncate: a
+    truncated 1-device 'multi-device' dryrun exercises no sharding at all
+    (the round-2 vacuous-pass failure mode)."""
+    import jax
+    import pytest
+
+    from pulsar_timing_gibbsspec_tpu.parallel import make_mesh
+
+    n = len(jax.devices())
+    with pytest.raises(RuntimeError, match="refusing to build a truncated"):
+        make_mesh(n + 1)
+    # exact provisioning still works
+    assert make_mesh(n).devices.size == n
 
 
 def test_pad_pulsars_inert(psrs8):
