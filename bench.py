@@ -208,12 +208,37 @@ def main(argv=None):
     if args.orf in ("both", "crn"):
         crn = bench_config("crn", n_psr, niter, np_iters, adapt, nchains,
                            profile)
-    if args.orf in ("both", "hd"):
+    if args.orf == "hd":
         # the sequential cross-pulsar conditional sweep is heavier per
-        # sweep; fewer iterations keep the wall-clock comparable
+        # sweep; fewer iterations and chains keep the wall-clock (and the
+        # compiled program) in check
         hd = bench_config("hd", n_psr, max(100, niter // 4),
-                          max(5, np_iters // 4), adapt, nchains,
+                          max(5, np_iters // 4), adapt,
+                          nchains if args.nchains else min(nchains, 16),
                           profile=False)
+    elif args.orf == "both":
+        # own interpreter: the big correlated-ORF program has crashed the
+        # tunneled TPU worker before, and a worker crash kills the whole
+        # client — the headline CRN number must survive it
+        import subprocess
+
+        # honor an explicit --nchains verbatim; only the default is
+        # capped for the heavier HD program
+        cmd = [sys.executable, os.path.abspath(__file__), "--orf", "hd",
+               "--niter", str(niter), "--numpy-iters", str(np_iters),
+               "--nchains", str(nchains if args.nchains
+                                else min(nchains, 16)), "--no-profile"]
+        if args.quick:
+            cmd.append("--quick")
+        try:
+            res = subprocess.run(cmd, capture_output=True, text=True,
+                                 timeout=3600)
+            sys.stderr.write(res.stderr)
+            line = next(l for l in res.stdout.splitlines()
+                        if l.startswith("{"))
+            hd = json.loads(line)["hd"]
+        except Exception as exc:                      # noqa: BLE001
+            hd = {"error": f"{type(exc).__name__}: {exc}"[:300]}
 
     head = crn or hd
     # the headline is total posterior samples/sec of one chip (C vmapped

@@ -212,15 +212,22 @@ def draw_b_hd_sequential(cm: CompiledPTA, x, b, key):
     step an exact conditional (a valid Gibbs sweep; it mixes the
     cross-pulsar correlations over sweeps instead of within one).
 
-    The per-step factorization is XLA's native f64 Cholesky of a single
-    ``(Bmax, Bmax)`` system — the batched-vs-serial penalty does not
-    apply when the scan is already sequential.
+    Scheduling: each pulsar's conditional precision ``Sigma_p = TNT_p +
+    diag(pinv_p with (G^-1)_pp/rho on the gw columns)`` depends only on
+    ``x`` — never on the other pulsars' coefficients, which enter only
+    the linear term.  So all P factorizations run as ONE batched
+    matmul-scheduled blocked Cholesky before the scan (the same fast
+    kernel as the CRN b-draw), and the sequential scan is left with
+    gathers + three (Bmax,Bmax) matvecs per step.  On one v5e at
+    nchains=8 this cuts the 45-pulsar HD b-draw from ~174 ms (per-step
+    f64 factorizations inside the scan) to the batched-factor cost plus
+    a latency-bound scan.
     """
     import jax
     import jax.numpy as jnp
     import jax.random as jr
 
-    from ..ops.linalg import precond_cholesky, precond_sample, precond_solve
+    from ..ops.linalg import blocked_chol_inv
 
     cdt = cm.cdtype
     B, P, K = cm.Bmax, cm.P, cm.K
@@ -229,15 +236,23 @@ def draw_b_hd_sequential(cm: CompiledPTA, x, b, key):
     phi = cm.phi(x)
     pinv = 1.0 / phi                               # (P, B)
     rows_p = jnp.arange(P)[:, None]
-    gw_cols = jnp.concatenate([cm.gw_sin_ix, cm.gw_cos_ix], axis=1)
-    pinv = pinv.at[rows_p, gw_cols].set(0.0, mode="drop")
     rho = 10.0 ** (2.0 * jnp.asarray(x, cdt)[cm.rho_ix_x])       # (K,)
     Ginv = cm.orf_ginv_k(x).astype(cdt)            # (K, P, P)
-    keys = jr.split(key, P)
-    eye = jnp.eye(B, dtype=cdt)
     gsin = jnp.asarray(cm.gw_sin_ix)
     gcos = jnp.asarray(cm.gw_cos_ix)
     live_mask = jnp.asarray(cm.psr_mask, cdt)
+
+    # batched factorization of every pulsar's conditional precision:
+    # gw columns carry the conditional prior precision (G^-1)_pp / rho
+    prior_prec = jnp.diagonal(Ginv, axis1=1, axis2=2).T / rho    # (P, K)
+    pin = pinv.at[rows_p, gsin].set(prior_prec, mode="drop")
+    pin = pin.at[rows_p, gcos].set(prior_prec, mode="drop")
+    Sigma = TNT + pin[:, :, None] * jnp.eye(B, dtype=cdt)
+    diag = jnp.diagonal(Sigma, axis1=-2, axis2=-1)
+    dj = 1.0 / jnp.sqrt(diag)                      # (P, B)
+    A = Sigma * dj[:, :, None] * dj[:, None, :]
+    _, Li = blocked_chol_inv(A)                    # (P, B, B)
+    z = jr.normal(key, (P, B), cdt)
 
     def gather_a(b):
         """(P, K, 2) GW coefficients from the padded b array."""
@@ -249,21 +264,14 @@ def draw_b_hd_sequential(cm: CompiledPTA, x, b, key):
         a = gather_a(b) * live_mask[:, None, None]
         g_row = Ginv[:, p, :]                      # (K, P)
         gpp = Ginv[:, p, p]                        # (K,)
-        # conditional prior precision on p's gw cols and its linear term
-        prior_prec = gpp / rho                     # (K,)
         cross = (jnp.einsum("kq,qkf->kf", g_row, a)
                  - gpp[:, None] * a[p]) / rho[:, None]   # (K, 2)
-        pin_p = pinv[p]
-        pin_p = pin_p.at[gsin[p]].set(prior_prec, mode="drop")
-        pin_p = pin_p.at[gcos[p]].set(prior_prec, mode="drop")
         d_p = d[p]
         d_p = d_p.at[gsin[p]].add(-cross[:, 0], mode="drop")
         d_p = d_p.at[gcos[p]].add(-cross[:, 1], mode="drop")
-        Sigma = TNT[p] + pin_p[:, None] * eye
-        L, dj = precond_cholesky(Sigma)
-        mean = precond_solve(L, dj, d_p)
-        z = jr.normal(keys[p], (B,), cdt)
-        bp = precond_sample(L, dj, mean, z)
+        u = Li[p] @ (dj[p] * d_p)
+        mean = dj[p] * (Li[p].T @ u)
+        bp = mean + dj[p] * (Li[p].T @ z[p])
         # pad pulsars keep their inert coords; real rows update
         b = b.at[p].set(jnp.where(live_mask[p] > 0, bp, b[p]))
         return b, None
