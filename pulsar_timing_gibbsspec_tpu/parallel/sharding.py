@@ -34,7 +34,7 @@ _PULSAR_FIELDS = (
     "gw_sin_ix", "gw_cos_ix", "gw_f", "gw_df", "gw_hyp_ix", "gw_rho_ix",
     "red_valid", "red_hyp_ix", "red_rho_ix", "red_rho_ix_x",
     "red_sin_ix", "red_cos_ix",
-    "ec_cols", "ec_ix",
+    "ec_cols", "ec_ix", "ke_eid", "ke_par_ix",
     "white_par_ix", "white_nper", "ecorr_par_ix", "ecorr_nper",
 )
 #: replicated small arrays
@@ -97,6 +97,8 @@ def shard_compiled(cm: CompiledPTA, mesh) -> CompiledPTA:
     updates = {}
     for name in _PULSAR_FIELDS:
         arr = getattr(cm, name)
+        if arr is None:          # mode-gated fields (e.g. kernel ECORR off)
+            continue
         arr = np.asarray(arr)
         updates[name] = jax.device_put(arr, pulsar_sharding(mesh, arr.ndim))
     for name in _REPLICATED_FIELDS:

@@ -135,9 +135,10 @@ def profile_blocks(driver, x, repeats=5, inner=50):
             driver.chol_white, driver.mode_white, driver.asqrt_white))
 
         def white1(x, b, k, chol, mw, aw):
-            r2 = jb.residual_sq(cm, b)
+            r = jnp.asarray(cm.y) - jb.b_matvec(cm, b)
             xn, _ = jb.parallel_cov_mh_scan(
-                cm, x, k, jb.white_ll_rel(cm, x, r2), cm.white_par_ix,
+                cm, x, k, jb.white_block_ll(cm, x, r, r * r),
+                cm.white_par_ix,
                 cm.white_nper, chol, nw, record=False, mode=mw, asqrt=aw)
             return xn, b
 
@@ -146,14 +147,16 @@ def profile_blocks(driver, x, repeats=5, inner=50):
 
         out[f"white_mh[{nw}]"] = _scan_time(white, x, b, inner, repeats)
 
-    if len(cm.idx.ecorr) and driver.aclength_ecorr and cm.ec_cols.shape[1]:
+    if len(cm.idx.ecorr) and driver.aclength_ecorr and (cm.ec_cols.shape[1]
+                                                        or cm.has_ke):
         ne = driver.aclength_ecorr
         aux_e = tuple(jnp.asarray(a, cm.dtype) for a in (
             driver.chol_ecorr, driver.mode_ecorr, driver.asqrt_ecorr))
 
         def ecorr1(x, b, k, chol, me, ae):
+            r = jnp.asarray(cm.y) - jb.b_matvec(cm, b)
             xn, _ = jb.parallel_cov_mh_scan(
-                cm, x, k, jb.ecorr_ll_rel(cm, x, b), cm.ecorr_par_ix,
+                cm, x, k, jb.ecorr_block_ll(cm, x, b, r), cm.ecorr_par_ix,
                 cm.ecorr_nper, chol, ne, record=False, mode=me, asqrt=ae)
             return xn, b
 

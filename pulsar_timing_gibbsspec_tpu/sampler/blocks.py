@@ -71,10 +71,21 @@ def validate_sampling_flags(pta, hypersample=None, ecorrsample=None,
             f"hypersample={hypersample!r}: the common free-spectrum block "
             "is sampled by its exact conditional (inverse-CDF / Gumbel-max "
             "grid); an MH alternative is not implemented")
-    if ecorrsample not in (None, "mh"):
+    if ecorrsample == "kernel":
+        # working kernel semantics (the reference's own kernel path is
+        # dead code, pulsar_gibbs.py:409-486): epoch blocks live inside N
+        # via Woodbury, marginally identical to basis ECORR
+        if not any("ecorr" in n for n in names):
+            raise ValueError(
+                "ecorrsample='kernel' but the model has no ECORR "
+                "parameters (need white_vary=True on NANOGrav-flagged "
+                "data with a backend selection)")
+    elif ecorrsample not in (None, "mh"):
         raise NotImplementedError(
             f"ecorrsample={ecorrsample!r}: ECORR amplitudes are sampled by "
-            "adapted-proposal MH; other kernels are not implemented")
+            "adapted-proposal MH on the basis representation, or by the "
+            "in-N Woodbury kernel with ecorrsample='kernel'; other "
+            "kernels are not implemented")
     if redsample == "conditional" and has_red_pl and not has_red_rho:
         raise NotImplementedError(
             "redsample='conditional' but the intrinsic red process has "

@@ -228,6 +228,22 @@ class CompiledPTA:
     #: (the CRN layout); False for correlated ORFs, whose processes keep
     #: their own columns — then the red conditionals see no gw 'other'
     red_shares_gw: bool = True
+    #: kernel-ECORR execution mode (``ecorrsample='kernel'``): the epoch
+    #: blocks live inside N (Woodbury) instead of sampled basis columns.
+    #: Marginally identical to basis ECORR — ``N = D + U c U^T`` with
+    #: disjoint epoch indicators U is what the basis representation
+    #: integrates to — so the two modes are KS-cross-validated against
+    #: each other.  ``ke_eid[p, i]`` is TOA i's epoch id (Emax = dummy
+    #: slot for TOAs outside every epoch and pads), ``ke_par_ix[p, e]``
+    #: gathers the owning backend's log10_ecorr out of xe (dummy epochs
+    #: point at the -40 constant, whose 10^(2*.) underflows to a zero
+    #: correction).  None when the mode is off.
+    ke_eid: object = None      # (P, Nmax) int32 -> [0, Emax]
+    ke_par_ix: object = None   # (P, Emax) int32 -> xe
+
+    @property
+    def has_ke(self) -> bool:
+        return self.ke_eid is not None
 
     # =======================================================================
     # device-side pure functions (jit/vmap-safe; arrays close over as consts)
@@ -496,11 +512,19 @@ def _as_i32(a):
     return np.asarray(a, dtype=np.int32)
 
 
-def compile_pta(pta, pad_pulsars: int | None = None) -> CompiledPTA:
+def compile_pta(pta, pad_pulsars: int | None = None,
+                kernel_ecorr: bool = False) -> CompiledPTA:
     """Compile a host :class:`~..models.pta.PTA` into a CompiledPTA.
 
     ``pad_pulsars``: total pulsar-axis length (>= len(pta.pulsars)); extra
     slots are inert dummy pulsars so the axis divides a device-mesh size.
+
+    ``kernel_ecorr``: execute ECORR epoch blocks inside N (Woodbury, the
+    reference's ``ecorrsample='kernel'`` semantics — its own path is dead
+    code at ``pulsar_gibbs.py:409-486``) instead of as sampled basis
+    columns.  The ECORR basis columns are dropped from T (they are always
+    the trailing block, see ``models/pta.py`` layout) and the per-TOA
+    epoch structure is compiled into ``ke_eid``/``ke_par_ix``.
     """
     settings.apply()
     np_dtype = np.float64 if settings.precision == "f64" else np.float32
@@ -533,7 +557,19 @@ def compile_pta(pta, pad_pulsars: int | None = None) -> CompiledPTA:
     if P < P_real:
         raise ValueError("pad_pulsars smaller than the pulsar count")
     Nmax = max(m.pulsar.ntoa for m in models)
-    widths = tuple(m.get_basis().shape[1] for m in models)
+    if kernel_ecorr and not any(m._ecorr for m in models):
+        raise ValueError(
+            "ecorrsample='kernel' requested but the model has no ECORR "
+            "signal (build with white_vary=True on NANOGrav-flagged data)")
+
+    def _width(m):
+        # kernel mode: ECORR columns (always the trailing basis block) are
+        # not sampled — they live inside N via Woodbury
+        if kernel_ecorr and m._ecorr:
+            return m._slices[m._ecorr[0].name].start
+        return m.get_basis().shape[1]
+
+    widths = tuple(_width(m) for m in models)
     Bmax = max(widths)
 
     efac1 = const_ref(1.0)
@@ -558,7 +594,7 @@ def compile_pta(pta, pad_pulsars: int | None = None) -> CompiledPTA:
             sl_ = m._slices[s.name]
             gp_mask[ii, sl_.start:sl_.stop] = 1.0
         y[ii, :n] = m.pulsar.residuals
-        T[ii, :n, :w] = m.get_basis()
+        T[ii, :n, :w] = m.get_basis()[:, :w]
         toa_mask[ii, :n] = 1.0
         basis_mask[ii, :w] = 1.0
         psr_mask[ii] = 1.0
@@ -579,7 +615,10 @@ def compile_pta(pta, pad_pulsars: int | None = None) -> CompiledPTA:
             sl_ = m._slices[s.name]
             phi_base[ii, sl_] = np.clip(s.get_phi({}), PHI_FLOOR, big_phi)
         # GP columns start at 0 and accumulate component contributions
-        for s in m._fourier + m._chrom + m._ecorr:
+        # (kernel mode: the ECORR columns are dropped, and touching their
+        # now-out-of-range slice would zero pad columns whose phi must be 1)
+        ecs = [] if kernel_ecorr else m._ecorr
+        for s in m._fourier + m._chrom + ecs:
             sl_ = m._slices[s.name]
             phi_base[ii, sl_.start:sl_.stop] = 0.0
 
@@ -640,7 +679,7 @@ def compile_pta(pta, pad_pulsars: int | None = None) -> CompiledPTA:
         comp_specs.append((kind, rows))
     ec_rows = []
     for m in models:
-        if m._ecorr:
+        if m._ecorr and not kernel_ecorr:
             s = m._ecorr[0]
             sl_ = m._slices[s.name]
             cols = np.arange(sl_.start, sl_.stop)
@@ -648,6 +687,26 @@ def compile_pta(pta, pad_pulsars: int | None = None) -> CompiledPTA:
             ec_rows.append((cols, refs))
         else:
             ec_rows.append((np.zeros(0, np.int64), []))
+
+    # ---- kernel-ECORR epoch structure --------------------------------------
+    ke_eid = ke_par_ix = None
+    if kernel_ecorr:
+        Emax = max((m._ecorr[0]._U.shape[1] if m._ecorr else 0)
+                   for m in models)
+        # dummy epoch: id Emax, parameter = the -40 constant, so its
+        # c = 10^-80 correction underflows (f32 exponent range) to zero
+        ke_eid = np.full((P, Nmax), Emax, np.int32)
+        ke_par_ix = np.full((P, max(Emax, 1)), equad_off, np.int32)
+        for ii, m in enumerate(models):
+            if not m._ecorr:
+                continue
+            s = m._ecorr[0]
+            U = s._U                                    # (ntoa, E)
+            n = m.pulsar.ntoa
+            in_epoch = U.sum(axis=1) > 0
+            ke_eid[ii, :n] = np.where(in_epoch, U.argmax(axis=1), Emax)
+            for e, lab in enumerate(s._owners):
+                ke_par_ix[ii, e] = ref(s._by_backend[lab])
     if any(len(r[0]) for r in ec_rows):
         comp_specs.append(("ecorr", [
             (cols, np.zeros(len(cols)), np.zeros(len(cols)), [], refs)
@@ -969,4 +1028,5 @@ def compile_pta(pta, pad_pulsars: int | None = None) -> CompiledPTA:
         orf_name=orf_name, orf_Ginv=orf_Ginv, gp_mask=gp_mask,
         red_shares_gw=red_shares_gw,
         orf_B=orf_B, orf_par_ix=orf_par_ix,
+        ke_eid=ke_eid, ke_par_ix=ke_par_ix,
     )

@@ -28,13 +28,14 @@ class _GibbsBase:
         validate_sampling_flags(pta, hypersample, ecorrsample, redsample)
         self.pta = pta
         self.backend_name = backend
+        self.ecorrsample = ecorrsample
         self.progress = progress
         if backend == "numpy":
-            self._backend = self._make_numpy(hypersample, redsample, seed,
-                                             backend_opts)
+            self._backend = self._make_numpy(hypersample, ecorrsample,
+                                             redsample, seed, backend_opts)
         elif backend == "jax":
-            self._backend = self._make_jax(hypersample, redsample, seed,
-                                           backend_opts)
+            self._backend = self._make_jax(hypersample, ecorrsample,
+                                           redsample, seed, backend_opts)
         else:
             raise ValueError(f"unknown backend '{backend}'")
 
@@ -57,10 +58,15 @@ class _GibbsBase:
     @property
     def b_param_names(self):
         out = []
+        kernel = self.ecorrsample == "kernel"
         for pname in self.pta.pulsars:
             m = self.pta.model(pname)
             named = {}
             for s in m.signals:
+                if kernel and s in m._ecorr:
+                    # kernel mode drops the (trailing) ECORR basis columns
+                    # from bchain — their names must not outnumber them
+                    continue
                 sl = m._slices[s.name]
                 for jj in range(sl.start, sl.stop):
                     # shared Fourier columns: first (widest) signal wins,
@@ -168,13 +174,15 @@ class _GibbsBase:
 class PulsarBlockGibbs(_GibbsBase):
     """Single-pulsar blocked Gibbs (reference ``pulsar_gibbs.py``)."""
 
-    def _make_numpy(self, hypersample, redsample, seed, opts):
-        return _NumpySingleDriver(self.pta, hypersample, redsample, seed, opts)
+    def _make_numpy(self, hypersample, ecorrsample, redsample, seed, opts):
+        return _NumpySingleDriver(self.pta, hypersample, ecorrsample,
+                                  redsample, seed, opts)
 
-    def _make_jax(self, hypersample, redsample, seed, opts):
+    def _make_jax(self, hypersample, ecorrsample, redsample, seed, opts):
         from .jax_backend import JaxGibbsDriver
 
         return JaxGibbsDriver(self.pta, hypersample=hypersample,
+                              ecorrsample=ecorrsample,
                               redsample=redsample, seed=seed, **opts)
 
 
@@ -182,15 +190,17 @@ class PTABlockGibbs(_GibbsBase):
     """Multi-pulsar blocked Gibbs with a common free spectrum (reference
     ``pta_gibbs.py``)."""
 
-    def _make_numpy(self, hypersample, redsample, seed, opts):
+    def _make_numpy(self, hypersample, ecorrsample, redsample, seed, opts):
         from .numpy_pta import NumpyPTAGibbs
 
-        return _NumpyPTADriver(self.pta, hypersample, redsample, seed, opts)
+        return _NumpyPTADriver(self.pta, hypersample, ecorrsample,
+                               redsample, seed, opts)
 
-    def _make_jax(self, hypersample, redsample, seed, opts):
+    def _make_jax(self, hypersample, ecorrsample, redsample, seed, opts):
         from .jax_backend import JaxGibbsDriver
 
         return JaxGibbsDriver(self.pta, hypersample=hypersample,
+                              ecorrsample=ecorrsample,
                               redsample=redsample, seed=seed, common_rho=True,
                               **opts)
 
@@ -198,10 +208,11 @@ class PTABlockGibbs(_GibbsBase):
 class _NumpySingleDriver:
     """Adapter: NumpyGibbs sweeps -> the facade's run/adapt-state protocol."""
 
-    def __init__(self, pta, hypersample, redsample, seed, opts):
-        self.g = NumpyGibbs(pta, hypersample=hypersample, redsample=redsample,
+    def __init__(self, pta, hypersample, ecorrsample, redsample, seed, opts):
+        self.g = NumpyGibbs(pta, hypersample=hypersample,
+                            ecorrsample=ecorrsample, redsample=redsample,
                             seed=seed, **opts)
-        self.nb_total = pta.get_basis()[0].shape[1]
+        self.nb_total = self.g.nb_total
 
     def run(self, x, chain, bchain, start, niter):
         first = start == 0
@@ -225,12 +236,13 @@ class _NumpySingleDriver:
 
 
 class _NumpyPTADriver:
-    def __init__(self, pta, hypersample, redsample, seed, opts):
+    def __init__(self, pta, hypersample, ecorrsample, redsample, seed, opts):
         from .numpy_pta import NumpyPTAGibbs
 
         self.g = NumpyPTAGibbs(pta, hypersample=hypersample,
+                               ecorrsample=ecorrsample,
                                redsample=redsample, seed=seed, **opts)
-        self.nb_total = sum(T.shape[1] for T in pta.get_basis())
+        self.nb_total = self.g.nb_total
 
     def run(self, x, chain, bchain, start, niter):
         first = start == 0

@@ -85,6 +85,84 @@ def tnt_d(cm: CompiledPTA, Nvec):
     return G[:, :cm.Bmax, :cm.Bmax], G[:, :cm.Bmax, cm.Bmax]
 
 
+def ke_segsum(cm: CompiledPTA, vals):
+    """Sum ``vals`` (P, Nmax[, ...]) per ECORR epoch -> (P, Emax+1[, ...]);
+    the trailing slot collects dummy/pad TOAs and is dropped by callers."""
+    import jax.numpy as jnp
+
+    E = cm.ke_par_ix.shape[1]
+    shape = (cm.P, E + 1) + vals.shape[2:]
+    out = jnp.zeros(shape, vals.dtype)
+    return out.at[jnp.arange(cm.P)[:, None], jnp.asarray(cm.ke_eid)].add(vals)
+
+
+def ke_weights(cm: CompiledPTA, x, Nvec):
+    """Per-epoch Woodbury pieces of ``N = D + U c U^T`` with disjoint epoch
+    indicators U (kernel ECORR): ``c_e = 10^(2 log10_ecorr)``, ``s_e =
+    sum_(i in e) 1/D_i``, ``w_e = c_e / (1 + c_e s_e)`` — so
+    ``N^-1 = D^-1 - w_e (D^-1 1_e)(D^-1 1_e)^T`` per block and
+    ``logdet N = sum log D + sum log1p(c_e s_e)``.  Exponent-safe on the
+    TPU's f32-range f64: c ~ 1e-14, 1/D ~ 1e12, and every product is
+    O(1e-2..1e2).  Returns ``(c, s, w)``, each (P, Emax) in the compute
+    dtype; dummy epochs have c = 10^-80 -> 0 underflow -> w = 0."""
+    import jax.numpy as jnp
+
+    cdt = cm.cdtype
+    c = (10.0 ** (2.0 * cm.xe(x)[cm.ke_par_ix])).astype(cdt)     # (P, E)
+    invN = (jnp.asarray(cm.toa_mask, cdt) / Nvec.astype(cdt))
+    s = ke_segsum(cm, invN)[:, :-1]
+    w = c / (1.0 + c * s)
+    return c, s, w
+
+
+def tnt_d_ke(cm: CompiledPTA, Nvec, w):
+    """Kernel-ECORR :func:`tnt_d`: ``T^T N^-1 T`` and ``T^T N^-1 y`` with
+    the block N, via the Woodbury correction ``- V^T diag(w) V`` where
+    ``V_e = sum_(i in e) [T|y]_i / D_i`` — the same fused augmented-Gram
+    trick as the diagonal path, so ``d``'s correction rides the last
+    column for free."""
+    import jax.numpy as jnp
+
+    TNT, d = tnt_d(cm, Nvec)
+    Ta = jnp.concatenate([jnp.asarray(cm.T),
+                          jnp.asarray(cm.y)[:, :, None]], axis=2)
+    TNa = (Ta / Nvec.astype(cm.dtype)[:, :, None]).astype(cm.cdtype)
+    V = ke_segsum(cm, TNa)[:, :-1]                   # (P, E, B+1)
+    corr = jnp.einsum("peb,pe,pec->pbc", V, w.astype(cm.cdtype), V,
+                      preferred_element_type=cm.cdtype)
+    return (TNT - corr[:, :cm.Bmax, :cm.Bmax],
+            d - corr[:, :cm.Bmax, cm.Bmax])
+
+
+def tnt_d_x(cm: CompiledPTA, x, Nvec):
+    """``(TNT, d)`` for the current state: diagonal N, or the kernel-ECORR
+    block N when the model compiles in that mode."""
+    if not cm.has_ke:
+        return tnt_d(cm, Nvec)
+    _, _, w = ke_weights(cm, x, Nvec)
+    return tnt_d_ke(cm, Nvec, w)
+
+
+def ke_ll_corr(cm: CompiledPTA, x, Nvec, z):
+    """(P,) Woodbury correction to a diagonal Gaussian log-density:
+    ``-0.5 [sum_e log1p(c_e s_e) - sum_e w_e z_e^2]`` with ``z_e =
+    sum_(i in e) r_i / D_i`` passed in.  Every term is O(1)-O(E), so the
+    correction carries MH acceptance differences exactly even in f32."""
+    import jax.numpy as jnp
+
+    c, s, w = ke_weights(cm, x, Nvec)
+    return -0.5 * (jnp.sum(jnp.log1p(c * s), axis=1)
+                   - jnp.sum(w * z * z, axis=1))
+
+
+def ke_rz(cm: CompiledPTA, Nvec, r):
+    """(P, Emax) per-epoch ``z_e = sum r_i / D_i`` in the compute dtype."""
+    import jax.numpy as jnp
+
+    invN = (jnp.asarray(cm.toa_mask, cm.cdtype) / Nvec.astype(cm.cdtype))
+    return ke_segsum(cm, r.astype(cm.cdtype) * invN)[:, :-1]
+
+
 def lnlike_white_fn(cm: CompiledPTA, x, r2):
     """Diagonal white-noise likelihood conditional on b, with the residual
     square ``r2 = (y - T b)^2`` precomputed for the block (reference
@@ -154,6 +232,11 @@ def lnlike_fullmarg_fn(cm: CompiledPTA, x, TNT, d):
     N = cm.ndiag(x)
     phi = cm.phi(x)
     out = -0.5 * jnp.sum(cm.toa_mask * (jnp.log(N) + cm.y ** 2 / N))
+    if cm.has_ke:
+        # kernel-ECORR: N is the Woodbury block matrix (TNT/d passed in
+        # must come from tnt_d_x); correct logdet N and y^T N^-1 y
+        out = out + jnp.sum(ke_ll_corr(
+            cm, x, N, ke_rz(cm, N, jnp.asarray(cm.y))))
     logdet_phi = jnp.sum(jnp.log(phi), axis=-1)
     Sigma = TNT + _batched_diag(1.0 / phi)
     L, dj = precond_cholesky(Sigma)
@@ -191,7 +274,7 @@ def draw_b_fn(cm: CompiledPTA, x, key, b=None):
             b = jnp.zeros((cm.P, cm.Bmax), cm.cdtype)
         return draw_b_hd_sequential(cm, x, b, key)
     N = cm.ndiag_fast(x)
-    TNT, d = tnt_d(cm, N)
+    TNT, d = tnt_d_x(cm, x, N)
     phi = cm.phi(x)
     z = jr.normal(key, (cm.P, cm.Bmax), dtype=cm.cdtype)
     b, _ = mvn_conditional_draw(TNT, 1.0 / phi, d, z)
@@ -232,7 +315,7 @@ def draw_b_hd_sequential(cm: CompiledPTA, x, b, key):
     cdt = cm.cdtype
     B, P, K = cm.Bmax, cm.P, cm.K
     N = cm.ndiag_fast(x)
-    TNT, d = tnt_d(cm, N)                          # (P, B, B), (P, B)
+    TNT, d = tnt_d_x(cm, x, N)                          # (P, B, B), (P, B)
     phi = cm.phi(x)
     pinv = 1.0 / phi                               # (P, B)
     rows_p = jnp.arange(P)[:, None]
@@ -299,7 +382,7 @@ def draw_b_joint(cm: CompiledPTA, x, key):
     B, P = cm.Bmax, cm.P
     PB = P * B
     N = cm.ndiag_fast(x)
-    TNT, d = tnt_d(cm, N)
+    TNT, d = tnt_d_x(cm, x, N)
     phi = cm.phi(x)
     pinv = 1.0 / phi                                     # (P, B)
     rows_p = jnp.arange(P)[:, None]
@@ -641,6 +724,59 @@ def ecorr_ll_rel(cm: CompiledPTA, x0, b):
                                + 0.5 * u * (1.0 - ratio)), axis=1)
 
     return ll_rel
+
+
+def white_block_ll(cm: CompiledPTA, x, r, r2):
+    """The white MH block's target: diagonal relative form, or the
+    Woodbury form when the model compiled with kernel ECORR."""
+    if cm.has_ke:
+        return white_ll_ke(cm, x, r, r2)
+    return white_ll_rel(cm, x, r2)
+
+
+def ecorr_block_ll(cm: CompiledPTA, x, b, r):
+    """The ECORR MH block's target: basis-coefficient conditional, or the
+    kernel (in-N Woodbury) conditional on the residual."""
+    if cm.has_ke:
+        return ecorr_ll_ke(cm, x, r)
+    return ecorr_ll_rel(cm, x, b)
+
+
+def white_ll_ke(cm: CompiledPTA, x0, r, r2):
+    """Kernel-ECORR white-block likelihood closure: the f32-exact relative
+    diagonal form plus the O(1) Woodbury correction (whose x0 constant
+    cancels in MH differences).  ``r`` is the block-fixed residual."""
+    base = white_ll_rel(cm, x0, r2)
+
+    def ll(q):
+        Nq = cm.ndiag(q)
+        return base(q) + ke_ll_corr(cm, q, Nq, ke_rz(cm, Nq, r))
+
+    return ll
+
+
+def ecorr_ll_ke(cm: CompiledPTA, x0, r):
+    """Kernel-ECORR block likelihood closure (ECORR amplitudes only): with
+    the diagonal D fixed, only ``c_e(q)`` moves, so the per-epoch
+    aggregates ``s_e`` and ``z_e^2`` are precomputed once per block and
+    each MH step costs O(Emax).  Differentiable — the same closure feeds
+    the Laplace proposal curvature."""
+    import jax.numpy as jnp
+
+    N0 = cm.ndiag(x0)
+    cdt = cm.cdtype
+    invN = (jnp.asarray(cm.toa_mask, cdt) / N0.astype(cdt))
+    s = ke_segsum(cm, invN)[:, :-1]
+    z = ke_segsum(cm, r.astype(cdt) * invN)[:, :-1]
+    z2 = z * z
+
+    def ll(q):
+        c = (10.0 ** (2.0 * cm.xe(q)[cm.ke_par_ix])).astype(cdt)
+        w = c / (1.0 + c * s)
+        return -0.5 * (jnp.sum(jnp.log1p(c * s), axis=1)
+                       - jnp.sum(w * z2, axis=1))
+
+    return ll
 
 
 def red_mh_block(cm: CompiledPTA, x, b, key, U, S, nsteps, hist=None):
@@ -1035,6 +1171,7 @@ class JaxGibbsDriver:
     """
 
     def __init__(self, pta, hypersample=None, redsample=None,
+                 ecorrsample=None,
                  seed=None, common_rho=False, white_adapt_iters=1000,
                  red_adapt_iters=2000, red_steps=20, chunk_size=None,
                  pad_pulsars=None, mesh=None, warmup_sweeps=50,
@@ -1046,9 +1183,10 @@ class JaxGibbsDriver:
 
         from .blocks import validate_sampling_flags
 
-        validate_sampling_flags(pta, hypersample, redsample=redsample)
+        validate_sampling_flags(pta, hypersample, ecorrsample, redsample)
         self._jax, self._jr = jax, jr
-        self.cm = compile_pta(pta, pad_pulsars=pad_pulsars)
+        self.cm = compile_pta(pta, pad_pulsars=pad_pulsars,
+                              kernel_ecorr=(ecorrsample == "kernel"))
         if mesh is not None:
             from ..parallel.sharding import shard_compiled
 
@@ -1218,9 +1356,10 @@ class JaxGibbsDriver:
             self.key, k = jr.split(self.key)
 
             def rec_white(x, b, k, chol, mode, asq):
-                r2 = residual_sq(cm, b)
+                r = jax.numpy.asarray(cm.y) - b_matvec(cm, b)
                 return parallel_cov_mh_scan(
-                    cm, x, k, white_ll_rel(cm, x, r2), cm.white_par_ix,
+                    cm, x, k, white_block_ll(cm, x, r, r * r),
+                    cm.white_par_ix,
                     cm.white_nper, chol, self.white_adapt_iters,
                     mode=mode, asqrt=asq)
 
@@ -1244,11 +1383,15 @@ class JaxGibbsDriver:
             self.aclength_white = min(self._act_from_rec(rec3, cm.white_nper),
                                       self.white_steps_max)
 
-        if len(cm.idx.ecorr) and cm.ec_cols.shape[1]:
+        if len(cm.idx.ecorr) and (cm.ec_cols.shape[1] or cm.has_ke):
             def lap_ec(x, b):
+                if cm.has_ke:
+                    r = jax.numpy.asarray(cm.y) - b_matvec(cm, b)
+                    curv = ecorr_ll_ke(cm, x, r)
+                else:
+                    curv = lambda q: lnlike_ecorr_per(cm, q, b)
                 xm, L, asq = laplace_newton_chol(
-                    cm, x, lambda q: lnlike_ecorr_per(cm, q, b),
-                    cm.ecorr_par_ix, cm.ecorr_nper)
+                    cm, x, curv, cm.ecorr_par_ix, cm.ecorr_nper)
                 safe = np.minimum(np.asarray(cm.ecorr_par_ix), cm.nx - 1)
                 return xm, L, asq, xm[safe]
 
@@ -1259,8 +1402,9 @@ class JaxGibbsDriver:
             self.key, k = jr.split(self.key)
 
             def rec_ec(x, b, k, chol, mode, asq):
+                r = jax.numpy.asarray(cm.y) - b_matvec(cm, b)
                 return parallel_cov_mh_scan(
-                    cm, x, k, ecorr_ll_rel(cm, x, b), cm.ecorr_par_ix,
+                    cm, x, k, ecorr_block_ll(cm, x, b, r), cm.ecorr_par_ix,
                     cm.ecorr_nper, chol, self.white_adapt_iters,
                     mode=mode, asqrt=asq)
 
@@ -1299,7 +1443,7 @@ class JaxGibbsDriver:
 
             def adapt(x, k):
                 N = cm.ndiag(x)
-                TNT, d = tnt_d(cm, N)
+                TNT, d = tnt_d_x(cm, x, N)
                 return mh_scan(cm, x, k,
                                lambda q: lnlike_fullmarg_fn(cm, q, TNT, d),
                                cm.idx.red, self.red_adapt_iters)
@@ -1433,17 +1577,19 @@ class JaxGibbsDriver:
                         else jnp.where(t < de_sw, hist_a, hist_b))
             out = (x, b)
             k = jr.split(key, 8)
+            # the cached u = T b makes the white residual free
+            r = jnp.asarray(cm.y) - u
             if len(cm.idx.white) and nw:
-                # the cached u = T b makes the white residual free
-                r = jnp.asarray(cm.y) - u
-                r2 = r * r
                 x, _ = parallel_cov_mh_scan(
-                    cm, x, k[0], white_ll_rel(cm, x, r2), cm.white_par_ix,
+                    cm, x, k[0], white_block_ll(cm, x, r, r * r),
+                    cm.white_par_ix,
                     cm.white_nper, chol_w, nw, record=False,
                     mode=mode_w, asqrt=asq_w)
-            if len(cm.idx.ecorr) and ne and cm.ec_cols.shape[1]:
+            if len(cm.idx.ecorr) and ne and (cm.ec_cols.shape[1]
+                                             or cm.has_ke):
                 x, _ = parallel_cov_mh_scan(
-                    cm, x, k[1], ecorr_ll_rel(cm, x, b), cm.ecorr_par_ix,
+                    cm, x, k[1], ecorr_block_ll(cm, x, b, r),
+                    cm.ecorr_par_ix,
                     cm.ecorr_nper, chol_e, ne, record=False,
                     mode=mode_e, asqrt=asq_e)
             if self.do_red_conditional:
@@ -1488,26 +1634,32 @@ class JaxGibbsDriver:
             x, b, u = carry
             out = (x, b)
             k = jr.split(key, 8)
+            r = jax.numpy.asarray(cm.y) - u
             if len(cm.idx.white):
                 # Laplace proposal square roots recomputed at the current
                 # state each warmup sweep (W HVPs + a batched WxW eigh,
                 # small next to the b-draw for the W<=2 blocks) so the white
                 # block actually travels toward the typical set instead of
                 # freezing under prior-width single-site jumps
-                r = jax.numpy.asarray(cm.y) - u
                 r2 = r * r
                 _, chol, _ = laplace_newton_chol(
                     cm, x, lambda q: lnlike_white_per(cm, q, r2),
                     cm.white_par_ix, cm.white_nper, newton_iters=0)
                 x, _ = parallel_cov_mh_scan(
-                    cm, x, k[0], white_ll_rel(cm, x, r2), cm.white_par_ix,
+                    cm, x, k[0], white_block_ll(cm, x, r, r * r),
+                    cm.white_par_ix,
                     cm.white_nper, chol, nw, record=False)
-            if len(cm.idx.ecorr) and cm.ec_cols.shape[1]:
+            if len(cm.idx.ecorr) and (cm.ec_cols.shape[1] or cm.has_ke):
+                if cm.has_ke:
+                    curv = ecorr_ll_ke(cm, x, r)
+                else:
+                    curv = lambda q: lnlike_ecorr_per(cm, q, b)
                 _, chol, _ = laplace_newton_chol(
-                    cm, x, lambda q: lnlike_ecorr_per(cm, q, b),
+                    cm, x, curv,
                     cm.ecorr_par_ix, cm.ecorr_nper, newton_iters=0)
                 x, _ = parallel_cov_mh_scan(
-                    cm, x, k[1], ecorr_ll_rel(cm, x, b), cm.ecorr_par_ix,
+                    cm, x, k[1], ecorr_block_ll(cm, x, b, r),
+                    cm.ecorr_par_ix,
                     cm.ecorr_nper, chol, nw, record=False)
             if self.do_red_conditional:
                 x = red_conditional_update(cm, x, b, k[2])
@@ -1593,10 +1745,12 @@ class JaxGibbsDriver:
 
     def _chunk_fn(self, n):
         if n not in self._sweep_fns:
-            if self.cm.orf_name != "crn":
+            if self.cm.orf_name != "crn" or self.cm.has_ke:
                 # correlated ORF: both bdraw variants reduce to the joint
                 # draw — a body pair would trace the large joint program
-                # twice into one executable for nothing
+                # twice into one executable for nothing.  Kernel ECORR:
+                # the Metropolised b-draw's exact accept density assumes
+                # diagonal N, so only the exact draw runs
                 bodies = self._sweep_body("exact")
             else:
                 bodies = (self._sweep_body("mh"), self._sweep_body("exact"))

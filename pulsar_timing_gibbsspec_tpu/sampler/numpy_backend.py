@@ -37,12 +37,13 @@ class NumpyGibbs:
     """Single-pulsar oracle sampler over a host PTA model."""
 
     def __init__(self, pta, hypersample=None, redsample=None,
+                 ecorrsample=None,
                  white_adapt_iters=1000, red_adapt_iters=2000, red_steps=20,
                  seed=None):
         self.pta = pta
         if len(pta.pulsars) != 1:
             raise ValueError("NumpyGibbs is single-pulsar; use the PTA facade")
-        validate_sampling_flags(pta, hypersample, redsample=redsample)
+        validate_sampling_flags(pta, hypersample, ecorrsample, redsample)
         self.hypersample = hypersample
         self.redsample = redsample
         self.white_adapt_iters = white_adapt_iters
@@ -98,6 +99,28 @@ class NumpyGibbs:
             ec_slice = self._model.basis_slice("ecorr")
             self.ecid = np.arange(ec_slice.start, ec_slice.stop)
 
+        # kernel-ECORR mode: the epoch blocks live inside N (Woodbury),
+        # marginally identical to the basis representation; the (trailing)
+        # ECORR columns are dropped from T and never sampled
+        self.kernel_ecorr = ecorrsample == "kernel"
+        if self.kernel_ecorr:
+            if self.ecorr_sig is None:
+                raise ValueError(
+                    "ecorrsample='kernel' but the model has no ECORR signal")
+            self._T = self._T[:, :self.ecid[0]]
+            U = self.ecorr_sig._U                       # (ntoa, E)
+            self._ke_E = U.shape[1]
+            self._ke_eid = np.where(U.sum(axis=1) > 0, U.argmax(axis=1),
+                                    self._ke_E)
+            from ..models.priors import Constant
+
+            self._ke_params = []
+            for lab in self.ecorr_sig._owners:
+                p = self.ecorr_sig._by_backend[lab]
+                self._ke_params.append(
+                    (p.name, p.value if isinstance(p, Constant) else None))
+
+        self.nb_total = self._T.shape[1]
         self.b = np.zeros(self._T.shape[1])
         # per-sweep caches (invalidated when white params move,
         # reference pulsar_gibbs.py:664-665)
@@ -133,11 +156,49 @@ class NumpyGibbs:
         self._TNT = None
         self._d = None
 
+    def _ke_wood(self, params, Nvec):
+        """Per-epoch Woodbury pieces of the kernel-ECORR block N = D +
+        U c U^T (disjoint epoch indicators): returns ``(c, s, w)`` with
+        ``s_e = sum 1/D``, ``w_e = c/(1 + c s)``."""
+        c = np.array([10.0 ** (2.0 * (v if v is not None else params[nm]))
+                      for nm, v in self._ke_params])
+        s = np.bincount(self._ke_eid, weights=1.0 / Nvec,
+                        minlength=self._ke_E + 1)[:self._ke_E]
+        return c, s, c / (1.0 + c * s)
+
+    def _ke_corr(self, params, Nvec, r):
+        """Woodbury correction to the diagonal log-density of ``r``:
+        ``-0.5 [sum log1p(c s) - sum w z^2]``, ``z_e = sum r/D``."""
+        c, s, w = self._ke_wood(params, Nvec)
+        z = np.bincount(self._ke_eid, weights=r / Nvec,
+                        minlength=self._ke_E + 1)[:self._ke_E]
+        return -0.5 * (np.sum(np.log1p(c * s)) - np.sum(w * z * z))
+
+    def _tnt_d(self, params, Nvec):
+        """Per-sweep ``(T^T N^-1 T, T^T N^-1 y)``; the kernel-ECORR
+        correction is applied at use time (it moves with the ECORR
+        parameters, unlike the cached diagonal part)."""
+        self._ensure_cache(Nvec)
+        if not self.kernel_ecorr:
+            return self._TNT, self._d
+        _, _, w = self._ke_wood(params, Nvec)
+        A = np.column_stack([self._T, self._y]) / Nvec[:, None]
+        V = np.zeros((self._ke_E + 1, A.shape[1]))
+        np.add.at(V, self._ke_eid, A)
+        V = V[:self._ke_E]
+        corr = (V * w[:, None]).T @ V
+        return self._TNT - corr[:-1, :-1], self._d - corr[:-1, -1]
+
     def lnlike_white(self, xs):
-        """Diagonal Gaussian likelihood of ``y - T b`` (reference :523-546)."""
+        """Gaussian likelihood of ``y - T b`` (reference :523-546):
+        diagonal N, plus the per-epoch Woodbury terms in kernel-ECORR
+        mode."""
         Nvec = self._ndiag(xs)
         r = self._y - self._T @ self.b
-        return -0.5 * (np.sum(np.log(Nvec)) + np.sum(r * r / Nvec))
+        out = -0.5 * (np.sum(np.log(Nvec)) + np.sum(r * r / Nvec))
+        if self.kernel_ecorr:
+            out += self._ke_corr(self.map_params(xs), Nvec, r)
+        return out
 
     def _gw_tau(self):
         """Per-frequency (sin^2 + cos^2)/2 of the GW coefficients
@@ -201,17 +262,21 @@ class NumpyGibbs:
         """b-marginalized likelihood (reference :569-610)."""
         params = self.map_params(xs)
         Nvec = self.pta.get_ndiag(params)[0]
-        phiinv, logdet_phi = self.pta.get_phiinv(params, logdet=True)[0]
-        self._ensure_cache(Nvec)
+        W = self._T.shape[1]
+        phi = self.pta.get_phi(params)[0][:W]   # kernel mode: ecorr cols cut
+        phiinv, logdet_phi = 1.0 / phi, float(np.sum(np.log(phi)))
+        TNT, d = self._tnt_d(params, Nvec)
         out = -0.5 * (np.sum(np.log(Nvec)) + np.sum(self._y**2 / Nvec))
-        Sigma = self._TNT + np.diag(phiinv)
+        if self.kernel_ecorr:
+            out += self._ke_corr(params, Nvec, self._y)
+        Sigma = TNT + np.diag(phiinv)
         try:
             cf = sl.cho_factor(Sigma)
         except np.linalg.LinAlgError:
             return -np.inf
-        expval = sl.cho_solve(cf, self._d)
+        expval = sl.cho_solve(cf, d)
         logdet_sigma = 2.0 * np.sum(np.log(np.diag(cf[0])))
-        return float(out + 0.5 * (self._d @ expval - logdet_sigma - logdet_phi))
+        return float(out + 0.5 * (d @ expval - logdet_sigma - logdet_phi))
 
     # ---- conditional draws -------------------------------------------------
 
@@ -220,17 +285,18 @@ class NumpyGibbs:
         (reference :489-520, including the QR fallback)."""
         params = self.map_params(xs)
         Nvec = self.pta.get_ndiag(params)[0]
-        phiinv = self.pta.get_phiinv(params, logdet=False)[0]
-        self._ensure_cache(Nvec)
-        Sigma = self._TNT + np.diag(phiinv)
+        W = self._T.shape[1]
+        phiinv = 1.0 / self.pta.get_phi(params)[0][:W]
+        TNT, d = self._tnt_d(params, Nvec)
+        Sigma = TNT + np.diag(phiinv)
         try:
             u, s, _ = sl.svd(Sigma)
-            mn = u @ ((u.T @ self._d) / s)
+            mn = u @ ((u.T @ d) / s)
             Li = u * np.sqrt(1.0 / s)
         except np.linalg.LinAlgError:
             Q, R = sl.qr(Sigma)
             Sigi = sl.solve(R, Q.T)
-            mn = Sigi @ self._d
+            mn = Sigi @ d
             u, s, _ = sl.svd(Sigi)
             Li = u * np.sqrt(s)
         self.b = mn + Li @ self.rng.standard_normal(len(mn))
@@ -385,20 +451,22 @@ class NumpyGibbs:
         return xnew
 
     def update_ecorr(self, xs, adapt=False):
-        """ECORR block via MH on the b-conditional likelihood — the update
-        the reference disables as broken (``pulsar_gibbs.py:409-486,676-683``)
-        implemented against the basis-ECORR coefficients."""
+        """ECORR block via MH — the update the reference disables as
+        broken (``pulsar_gibbs.py:409-486,676-683``), implemented against
+        the basis-ECORR coefficients, or (kernel mode) against the
+        in-N Woodbury white conditional given b."""
         eind = self.idx.ecorr
         sigma = 0.05 * len(eind)
+        target = self.lnlike_white if self.kernel_ecorr else self.lnlike_ecorr
         if adapt:
             rec = np.zeros((self.white_adapt_iters, len(eind)))
-            xnew = self._mh_loop(xs, eind, self.lnlike_ecorr,
+            xnew = self._mh_loop(xs, eind, target,
                                  self.white_adapt_iters, sigma, record=rec)
             burn = rec[min(100, len(rec) // 2):]
             self.aclength_ecorr = int(max(
                 1, max(int(integrated_act(burn[:, j])) for j in range(len(eind)))))
             return xnew
-        return self._mh_loop(xs, eind, self.lnlike_ecorr,
+        return self._mh_loop(xs, eind, target,
                              self.aclength_ecorr, sigma)
 
     # ---- sweep -------------------------------------------------------------

@@ -35,11 +35,17 @@ class NumpyPTAGibbs:
     noise blocks."""
 
     def __init__(self, pta, hypersample=None, redsample=None,
+                 ecorrsample=None,
                  white_adapt_iters=1000, red_adapt_iters=2000, red_steps=20,
                  seed=None):
         self.pta = pta
         self.P = len(pta.pulsars)
-        validate_sampling_flags(pta, hypersample, redsample=redsample)
+        validate_sampling_flags(pta, hypersample, ecorrsample, redsample)
+        if ecorrsample == "kernel":
+            raise NotImplementedError(
+                "ecorrsample='kernel' is implemented on the single-pulsar "
+                "NumPy oracle and on the JAX backend (both facades); the "
+                "multi-pulsar NumPy oracle keeps the basis representation")
         self.hypersample = hypersample
         self.redsample = redsample
         self.white_adapt_iters = white_adapt_iters
@@ -50,6 +56,7 @@ class NumpyPTAGibbs:
         self.idx = BlockIndex.build(pta.param_names)
         self._y = pta.get_residuals()
         self._T = pta.get_basis()
+        self.nb_total = sum(T.shape[1] for T in self._T)
         try:
             self.rhomin, self.rhomax = rho_bounds(pta, "gw")
         except ValueError:   # powerlaw-family common process: no rho block
