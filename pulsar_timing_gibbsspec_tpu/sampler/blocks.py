@@ -191,6 +191,27 @@ def proposal_step(rng, x, idx, sigma):
     return q
 
 
+def ke_woodbury(params, Nvec, eid, E, prm):
+    """Per-epoch Woodbury pieces of a kernel-ECORR block
+    ``N = D + U c U^T`` (disjoint epoch indicators U): ``c_e =
+    10^(2 log10_ecorr)``, ``s_e = sum_(i in e) 1/D_i``, ``w_e =
+    c_e / (1 + c_e s_e)`` — shared by both f64 oracles so the formula
+    cannot drift between them.  ``prm`` is [(param_name, const_or_None)]
+    per epoch owner; ``eid`` maps TOAs to epochs with ``E`` = dummy."""
+    c = np.array([10.0 ** (2.0 * (v if v is not None else params[nm]))
+                  for nm, v in prm])
+    s = np.bincount(eid, weights=1.0 / Nvec, minlength=E + 1)[:E]
+    return c, s, c / (1.0 + c * s)
+
+
+def ke_corr(params, Nvec, r, eid, E, prm):
+    """Woodbury correction to the diagonal Gaussian log-density of ``r``:
+    ``-0.5 [sum log1p(c s) - sum w z^2]`` with ``z_e = sum r/D``."""
+    c, s, w = ke_woodbury(params, Nvec, eid, E, prm)
+    z = np.bincount(eid, weights=r / Nvec, minlength=E + 1)[:E]
+    return -0.5 * (np.sum(np.log1p(c * s)) - np.sum(w * z * z))
+
+
 def de_step(rng, x, idx, hist):
     """Differential-evolution proposal from a past-sample history buffer —
     the reference PTMCMC's top-weighted jump (DE=50 vs SCAM=30/AM=15,

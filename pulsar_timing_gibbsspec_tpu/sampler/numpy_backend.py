@@ -157,22 +157,16 @@ class NumpyGibbs:
         self._d = None
 
     def _ke_wood(self, params, Nvec):
-        """Per-epoch Woodbury pieces of the kernel-ECORR block N = D +
-        U c U^T (disjoint epoch indicators): returns ``(c, s, w)`` with
-        ``s_e = sum 1/D``, ``w_e = c/(1 + c s)``."""
-        c = np.array([10.0 ** (2.0 * (v if v is not None else params[nm]))
-                      for nm, v in self._ke_params])
-        s = np.bincount(self._ke_eid, weights=1.0 / Nvec,
-                        minlength=self._ke_E + 1)[:self._ke_E]
-        return c, s, c / (1.0 + c * s)
+        from .blocks import ke_woodbury
+
+        return ke_woodbury(params, Nvec, self._ke_eid, self._ke_E,
+                           self._ke_params)
 
     def _ke_corr(self, params, Nvec, r):
-        """Woodbury correction to the diagonal log-density of ``r``:
-        ``-0.5 [sum log1p(c s) - sum w z^2]``, ``z_e = sum r/D``."""
-        c, s, w = self._ke_wood(params, Nvec)
-        z = np.bincount(self._ke_eid, weights=r / Nvec,
-                        minlength=self._ke_E + 1)[:self._ke_E]
-        return -0.5 * (np.sum(np.log1p(c * s)) - np.sum(w * z * z))
+        from .blocks import ke_corr
+
+        return ke_corr(params, Nvec, r, self._ke_eid, self._ke_E,
+                       self._ke_params)
 
     def _tnt_d(self, params, Nvec):
         """Per-sweep ``(T^T N^-1 T, T^T N^-1 y)``; the kernel-ECORR

@@ -41,11 +41,7 @@ class NumpyPTAGibbs:
         self.pta = pta
         self.P = len(pta.pulsars)
         validate_sampling_flags(pta, hypersample, ecorrsample, redsample)
-        if ecorrsample == "kernel":
-            raise NotImplementedError(
-                "ecorrsample='kernel' is implemented on the single-pulsar "
-                "NumPy oracle and on the JAX backend (both facades); the "
-                "multi-pulsar NumPy oracle keeps the basis representation")
+        self.kernel_ecorr = ecorrsample == "kernel"
         self.hypersample = hypersample
         self.redsample = redsample
         self.white_adapt_iters = white_adapt_iters
@@ -56,7 +52,6 @@ class NumpyPTAGibbs:
         self.idx = BlockIndex.build(pta.param_names)
         self._y = pta.get_residuals()
         self._T = pta.get_basis()
-        self.nb_total = sum(T.shape[1] for T in self._T)
         try:
             self.rhomin, self.rhomax = rho_bounds(pta, "gw")
         except ValueError:   # powerlaw-family common process: no rho block
@@ -174,6 +169,31 @@ class NumpyPTAGibbs:
                     self.orf_name, pos, K,
                     orf_ifreq=getattr(sig0, "orf_ifreq", 0))
 
+        # kernel-ECORR mode: drop the (trailing) ECORR columns per pulsar
+        # and carry the epoch structure for in-N Woodbury corrections
+        self._ke = None
+        if self.kernel_ecorr:
+            if not any(s is not None for s in self.ecorr_sigs):
+                raise ValueError(
+                    "ecorrsample='kernel' but no pulsar has an ECORR signal")
+            from ..models.priors import Constant
+
+            self._ke, T2 = [], []
+            for ii, (T, ec) in enumerate(zip(self._T, self.ecorr_sigs)):
+                if ec is None:
+                    self._ke.append(None)
+                    T2.append(T)
+                    continue
+                T2.append(T[:, :self.ecid[ii][0]])
+                U = ec._U
+                E = U.shape[1]
+                eid = np.where(U.sum(axis=1) > 0, U.argmax(axis=1), E)
+                prm = [(p.name, p.value if isinstance(p, Constant) else None)
+                       for p in (ec._by_backend[lab] for lab in ec._owners)]
+                self._ke.append((eid, E, prm))
+            self._T = T2
+
+        self.nb_total = sum(T.shape[1] for T in self._T)
         self.b = [np.zeros(T.shape[1]) for T in self._T]
         self._TNT = None
         self._d = None
@@ -213,6 +233,31 @@ class NumpyPTAGibbs:
 
     # ---- likelihoods -------------------------------------------------------
 
+    def _ke_corr_ii(self, params, Nvec, r, ii):
+        """Woodbury correction to pulsar ``ii``'s diagonal log-density."""
+        from .blocks import ke_corr
+
+        eid, E, prm = self._ke[ii]
+        return ke_corr(params, Nvec, r, eid, E, prm)
+
+    def _tnt_d_ii(self, params, Nvecs, ii):
+        """Pulsar ``ii``'s ``(T^T N^-1 T, T^T N^-1 y)`` with the kernel-
+        ECORR correction applied at use time (it moves with the ECORR
+        parameters, unlike the cached diagonal part)."""
+        from .blocks import ke_woodbury
+
+        self._ensure_cache(Nvecs)
+        if self._ke is None or self._ke[ii] is None:
+            return self._TNT[ii], self._d[ii]
+        eid, E, prm = self._ke[ii]
+        _, _, w = ke_woodbury(params, Nvecs[ii], eid, E, prm)
+        A = np.column_stack([self._T[ii], self._y[ii]]) / Nvecs[ii][:, None]
+        V = np.zeros((E + 1, A.shape[1]))
+        np.add.at(V, eid, A)
+        V = V[:E]
+        corr = (V * w[:, None]).T @ V
+        return self._TNT[ii] - corr[:-1, :-1], self._d[ii] - corr[:-1, -1]
+
     def lnlike_white(self, xs):
         params = self.map_params(xs)
         Nvecs = self.pta.get_ndiag(params)
@@ -221,6 +266,8 @@ class NumpyPTAGibbs:
             r = self._y[ii] - self._T[ii] @ self.b[ii]
             out += -0.5 * (np.sum(np.log(Nvecs[ii]))
                            + np.sum(r * r / Nvecs[ii]))
+            if self._ke is not None and self._ke[ii] is not None:
+                out += self._ke_corr_ii(params, Nvecs[ii], r, ii)
         return out
 
     def lnlike_red(self, xs):
@@ -268,21 +315,24 @@ class NumpyPTAGibbs:
         ``pta_gibbs.py:577-621``)."""
         params = self.map_params(xs)
         Nvecs = self.pta.get_ndiag(params)
-        phinv = self.pta.get_phiinv(params, logdet=True)
-        self._ensure_cache(Nvecs)
+        phis = self.pta.get_phi(params)
         out = 0.0
         for ii in range(self.P):
             out += -0.5 * (np.sum(np.log(Nvecs[ii]))
                            + np.sum(self._y[ii] ** 2 / Nvecs[ii]))
-            phiinv_ii, logdet_phi = phinv[ii]
-            Sigma = self._TNT[ii] + np.diag(phiinv_ii)
+            if self._ke is not None and self._ke[ii] is not None:
+                out += self._ke_corr_ii(params, Nvecs[ii], self._y[ii], ii)
+            phi_ii = phis[ii][:self._T[ii].shape[1]]
+            phiinv_ii, logdet_phi = 1.0 / phi_ii, np.sum(np.log(phi_ii))
+            TNT, d = self._tnt_d_ii(params, Nvecs, ii)
+            Sigma = TNT + np.diag(phiinv_ii)
             try:
                 cf = sl.cho_factor(Sigma)
             except np.linalg.LinAlgError:
                 return -np.inf
-            expval = sl.cho_solve(cf, self._d[ii])
+            expval = sl.cho_solve(cf, d)
             logdet_sigma = 2.0 * np.sum(np.log(np.diag(cf[0])))
-            out += 0.5 * (self._d[ii] @ expval - logdet_sigma - logdet_phi)
+            out += 0.5 * (d @ expval - logdet_sigma - logdet_phi)
         return float(out)
 
     # ---- conditional draws -------------------------------------------------
@@ -292,12 +342,12 @@ class NumpyPTAGibbs:
             return self._draw_b_joint(xs)
         params = self.map_params(xs)
         Nvecs = self.pta.get_ndiag(params)
-        phinv = self.pta.get_phiinv(params, logdet=False)
-        self._ensure_cache(Nvecs)
+        phis = self.pta.get_phi(params)
         for ii in range(self.P):
-            Sigma = self._TNT[ii] + np.diag(phinv[ii])
+            TNT, d = self._tnt_d_ii(params, Nvecs, ii)
+            Sigma = TNT + np.diag(1.0 / phis[ii][:self._T[ii].shape[1]])
             u, s, _ = sl.svd(Sigma)
-            mn = u @ ((u.T @ self._d[ii]) / s)
+            mn = u @ ((u.T @ d) / s)
             Li = u * np.sqrt(1.0 / s)
             self.b[ii] = mn + Li @ self.rng.standard_normal(len(mn))
         return self.b
@@ -311,15 +361,17 @@ class NumpyPTAGibbs:
         params = self.map_params(xs)
         Nvecs = self.pta.get_ndiag(params)
         phis = self.pta.get_phi(params)
-        self._ensure_cache(Nvecs)
         offs = np.cumsum([0] + [T.shape[1] for T in self._T])
         nb = offs[-1]
         Sigma = np.zeros((nb, nb))
         phiinv_diag = np.zeros(nb)
+        ds = []
         for ii in range(self.P):
             sl_ = slice(offs[ii], offs[ii + 1])
-            Sigma[sl_, sl_] = self._TNT[ii]
-            pin = 1.0 / phis[ii]
+            TNT, d_ii = self._tnt_d_ii(params, Nvecs, ii)
+            Sigma[sl_, sl_] = TNT
+            ds.append(d_ii)
+            pin = 1.0 / phis[ii][:self._T[ii].shape[1]]
             pin[self.gwid[ii]] = 0.0         # replaced by the group blocks
             phiinv_diag[sl_] = pin
         Sigma[np.diag_indices(nb)] += phiinv_diag
@@ -331,7 +383,7 @@ class NumpyPTAGibbs:
                 rows = np.array([offs[ii] + self.gwid[ii][2 * k + phase]
                                  for ii in range(self.P)])
                 Sigma[np.ix_(rows, rows)] += Ginv[k] / rho[k]
-        d = np.concatenate(self._d)
+        d = np.concatenate(ds)
         cf = sl.cho_factor(Sigma, lower=True)
         mn = sl.cho_solve(cf, d)
         z = self.rng.standard_normal(nb)
@@ -561,15 +613,16 @@ class NumpyPTAGibbs:
     def update_ecorr(self, xs, adapt=False):
         eind = self.idx.ecorr
         sigma = 0.05 * len(eind)
+        target = self.lnlike_white if self.kernel_ecorr else self.lnlike_ecorr
         if adapt:
             rec = np.zeros((self.white_adapt_iters, len(eind)))
-            xnew = self._mh_loop(xs, eind, self.lnlike_ecorr,
+            xnew = self._mh_loop(xs, eind, target,
                                  self.white_adapt_iters, sigma, rec)
             burn = rec[min(100, len(rec) // 2):]
             self.aclength_ecorr = int(max(
                 1, max(int(integrated_act(burn[:, j])) for j in range(len(eind)))))
             return xnew
-        return self._mh_loop(xs, eind, self.lnlike_ecorr,
+        return self._mh_loop(xs, eind, target,
                              self.aclength_ecorr, sigma)
 
     # ---- sweep -------------------------------------------------------------

@@ -160,6 +160,47 @@ def test_kernel_jax_vs_numpy_ks(ng_psr, tmp_path):
     assert np.median(pvals) > 0.05, pvals
 
 
+def test_kernel_fullmarg_equals_basis(ng_psr):
+    """The b-marginalized likelihood integrates out every coefficient, so
+    basis and kernel representations of the same model must give the SAME
+    value at the same hyperparameters — an exact (not statistical)
+    equivalence check, on both oracles."""
+    from pulsar_timing_gibbsspec_tpu.sampler.numpy_pta import NumpyPTAGibbs
+
+    pta = _model(ng_psr)
+    rng = np.random.default_rng(5)
+    for cls, kw in ((NumpyGibbs, {}), (NumpyPTAGibbs, {})):
+        gb = cls(pta, seed=0, **kw)
+        gk = cls(pta, ecorrsample="kernel", seed=0, **kw)
+        for _ in range(4):
+            x = pta.initial_sample(rng)
+            vb, vk = gb.lnlike_fullmarg(x), gk.lnlike_fullmarg(x)
+            gb.invalidate_cache()
+            gk.invalidate_cache()
+            np.testing.assert_allclose(vk, vb, rtol=1e-8, err_msg=cls.__name__)
+
+
+def test_kernel_pta_oracle_sweeps(ng_psr):
+    """The multi-pulsar oracle runs kernel mode end-to-end (two flagged
+    pulsars sharing a common spectrum) and stays finite."""
+    import dataclasses
+
+    from pulsar_timing_gibbsspec_tpu.sampler.numpy_pta import NumpyPTAGibbs
+
+    p2 = dataclasses.replace(ng_psr, name="FAKE_K2",
+                             residuals=ng_psr.residuals[::-1].copy())
+    pta = model_general([ng_psr, p2], tm_svd=True, red_var=False,
+                        white_vary=True, common_psd="spectrum",
+                        common_components=4)
+    g = NumpyPTAGibbs(pta, ecorrsample="kernel", seed=4,
+                      white_adapt_iters=100)
+    x = g.sweep(pta.initial_sample(np.random.default_rng(1)), first=True)
+    for _ in range(5):
+        x = g.sweep(x)
+    assert np.all(np.isfinite(x))
+    assert g.nb_total == sum(T.shape[1] for T in g._T)
+
+
 def test_kernel_resume_bitwise(ng_psr, tmp_path):
     pta = _model(ng_psr)
     x0 = pta.initial_sample(np.random.default_rng(3))
