@@ -212,6 +212,18 @@ def ke_corr(params, Nvec, r, eid, E, prm):
     return -0.5 * (np.sum(np.log1p(c * s)) - np.sum(w * z * z))
 
 
+def ke_tnt_corr(T, y, Nvec, w, eid, E):
+    """Woodbury correction to the augmented Gram ``([T|y]^T N^-1 [T|y])``
+    of a kernel-ECORR block: ``V^T diag(w) V`` with ``V_e = sum_(i in e)
+    [T|y]_i / D_i``.  Shared by both f64 oracles; the last row/column
+    carries the ``d = T^T N^-1 y`` correction."""
+    A = np.column_stack([T, y]) / Nvec[:, None]
+    V = np.zeros((E + 1, A.shape[1]))
+    np.add.at(V, eid, A)
+    V = V[:E]
+    return (V * w[:, None]).T @ V
+
+
 def de_step(rng, x, idx, hist):
     """Differential-evolution proposal from a past-sample history buffer —
     the reference PTMCMC's top-weighted jump (DE=50 vs SCAM=30/AM=15,

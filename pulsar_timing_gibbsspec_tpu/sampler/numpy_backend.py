@@ -172,15 +172,14 @@ class NumpyGibbs:
         """Per-sweep ``(T^T N^-1 T, T^T N^-1 y)``; the kernel-ECORR
         correction is applied at use time (it moves with the ECORR
         parameters, unlike the cached diagonal part)."""
+        from .blocks import ke_tnt_corr
+
         self._ensure_cache(Nvec)
         if not self.kernel_ecorr:
             return self._TNT, self._d
         _, _, w = self._ke_wood(params, Nvec)
-        A = np.column_stack([self._T, self._y]) / Nvec[:, None]
-        V = np.zeros((self._ke_E + 1, A.shape[1]))
-        np.add.at(V, self._ke_eid, A)
-        V = V[:self._ke_E]
-        corr = (V * w[:, None]).T @ V
+        corr = ke_tnt_corr(self._T, self._y, Nvec, w, self._ke_eid,
+                           self._ke_E)
         return self._TNT - corr[:-1, :-1], self._d - corr[:-1, -1]
 
     def lnlike_white(self, xs):

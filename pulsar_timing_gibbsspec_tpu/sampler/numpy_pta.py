@@ -197,6 +197,7 @@ class NumpyPTAGibbs:
         self.b = [np.zeros(T.shape[1]) for T in self._T]
         self._TNT = None
         self._d = None
+        self._tnt_ke_cache = {}
 
         self.aclength_white = None
         self.cov_white = None
@@ -215,6 +216,7 @@ class NumpyPTAGibbs:
     def invalidate_cache(self):
         self._TNT = None
         self._d = None
+        self._tnt_ke_cache = {}
 
     def _ensure_cache(self, Nvecs):
         if self._TNT is None:
@@ -243,20 +245,28 @@ class NumpyPTAGibbs:
     def _tnt_d_ii(self, params, Nvecs, ii):
         """Pulsar ``ii``'s ``(T^T N^-1 T, T^T N^-1 y)`` with the kernel-
         ECORR correction applied at use time (it moves with the ECORR
-        parameters, unlike the cached diagonal part)."""
-        from .blocks import ke_woodbury
+        parameters, unlike the cached diagonal part).  Memoized on the
+        ECORR parameter values: the red MH block evaluates the
+        marginalized likelihood thousands of times per adaptation with
+        the white/ECORR state frozen, and the correction is loop-
+        invariant there.  ``invalidate_cache`` clears the memo alongside
+        the diagonal Gram cache."""
+        from .blocks import ke_tnt_corr, ke_woodbury
 
         self._ensure_cache(Nvecs)
         if self._ke is None or self._ke[ii] is None:
             return self._TNT[ii], self._d[ii]
         eid, E, prm = self._ke[ii]
+        ckey = (ii,) + tuple(v if v is not None else params[nm]
+                             for nm, v in prm)
+        hit = self._tnt_ke_cache.get(ckey)
+        if hit is not None:
+            return hit
         _, _, w = ke_woodbury(params, Nvecs[ii], eid, E, prm)
-        A = np.column_stack([self._T[ii], self._y[ii]]) / Nvecs[ii][:, None]
-        V = np.zeros((E + 1, A.shape[1]))
-        np.add.at(V, eid, A)
-        V = V[:E]
-        corr = (V * w[:, None]).T @ V
-        return self._TNT[ii] - corr[:-1, :-1], self._d[ii] - corr[:-1, -1]
+        corr = ke_tnt_corr(self._T[ii], self._y[ii], Nvecs[ii], w, eid, E)
+        out = (self._TNT[ii] - corr[:-1, :-1], self._d[ii] - corr[:-1, -1])
+        self._tnt_ke_cache[ckey] = out
+        return out
 
     def lnlike_white(self, xs):
         params = self.map_params(xs)

@@ -561,6 +561,30 @@ def test_sharded_pta_sweep(pta8, tmp_path):
     assert np.std(chain[1:, idx.rho[0]]) > 0
 
 
+def test_sharded_hd_sweep(psrs8, tmp_path):
+    """The correlated-ORF (HD) sweep also runs over a pulsar-sharded mesh:
+    the sequential cross-pulsar conditional gathers other shards'
+    coefficients, so GSPMD must insert the collectives and the chain must
+    stay finite with moving rho draws."""
+    import jax
+
+    from pulsar_timing_gibbsspec_tpu.parallel import make_mesh
+
+    assert len(jax.devices()) >= 8, "conftest must force 8 virtual devices"
+    pta = model_general(psrs8, tm_svd=True, red_var=False,
+                        white_vary=False, common_psd="spectrum",
+                        common_components=3, orf="hd")
+    mesh = make_mesh(8)
+    g = PTABlockGibbs(pta, backend="jax", seed=7, progress=False,
+                      mesh=mesh, warmup_sweeps=5)
+    x0 = pta.initial_sample(np.random.default_rng(2))
+    chain = g.sample(x0, outdir=str(tmp_path / "hd"), niter=30)
+    assert chain.shape == (30, len(pta.param_names))
+    assert np.all(np.isfinite(chain))
+    idx = BlockIndex.build(pta.param_names)
+    assert np.std(chain[1:, idx.rho[0]]) > 0
+
+
 def test_make_mesh_raises_when_under_provisioned():
     """An under-provisioned mesh must fail loudly, never truncate: a
     truncated 1-device 'multi-device' dryrun exercises no sharding at all
