@@ -10,12 +10,9 @@
 // ptg_integrated_act: tau = 1 + 2 * sum_{t<=W} rho_t with the window W the
 // first lag satisfying W >= c * tau(W).  Runs in O(n * W) with incremental
 // autocovariances, which beats the FFT path for the ~1000-sample adaptation
-// chains this gates (W is typically < 100).
-//
-// ptg_integrated_act_many: column-wise ACT over a row-major (n, m) chain
-// block, returning the max over columns — exactly the quantity
-// `aclength_white = max_j ceil(act(chain_j))` the sampler needs, in one
-// native call.
+// chains this gates (W is typically < 100).  The sampler calls it per
+// sub-chain column and sizes the per-sweep MH scans by a percentile of
+// the results (jax_backend._act_from_rec).
 
 #include <cmath>
 #include <cstdint>
@@ -47,18 +44,6 @@ double ptg_integrated_act(const double* x, long n, double c) {
         }
     }
     return tau > 1.0 ? tau : 1.0;
-}
-
-double ptg_integrated_act_many(const double* x, long n, long m, double c) {
-    // x is row-major (n, m): x[i*m + j]
-    double worst = 1.0;
-    std::vector<double> col((size_t)n);
-    for (long j = 0; j < m; ++j) {
-        for (long i = 0; i < n; ++i) col[(size_t)i] = x[i * m + j];
-        double tau = ptg_integrated_act(col.data(), n, c);
-        if (tau > worst) worst = tau;
-    }
-    return worst;
 }
 
 }  // extern "C"

@@ -21,31 +21,17 @@ def _load():
         lib = ctypes.CDLL(str(so))
         # a stale .so built before a symbol was added must degrade to the
         # NumPy fallback, not break available()
-        if not (hasattr(lib, "ptg_integrated_act")
-                and hasattr(lib, "ptg_integrated_act_many")):
+        if not hasattr(lib, "ptg_integrated_act"):
             return None
         lib.ptg_integrated_act.restype = ctypes.c_double
         lib.ptg_integrated_act.argtypes = [
             ctypes.POINTER(ctypes.c_double), ctypes.c_long, ctypes.c_double]
-        lib.ptg_integrated_act_many.restype = ctypes.c_double
-        lib.ptg_integrated_act_many.argtypes = [
-            ctypes.POINTER(ctypes.c_double), ctypes.c_long, ctypes.c_long,
-            ctypes.c_double]
         _LIB = lib
     return _LIB
 
 
 def available() -> bool:
     return _load() is not None
-
-
-def act_many(block: np.ndarray, c: float = 5.0) -> float:
-    """Max column-wise ACT of a row-major (n, m) chain block in one call."""
-    lib = _load()
-    block = np.ascontiguousarray(block, dtype=np.float64)
-    n, m = block.shape
-    ptr = block.ctypes.data_as(ctypes.POINTER(ctypes.c_double))
-    return float(lib.ptg_integrated_act_many(ptr, n, m, c))
 
 
 def act(x: np.ndarray, c: float = 5.0) -> float:

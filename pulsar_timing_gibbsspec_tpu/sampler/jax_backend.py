@@ -1476,13 +1476,21 @@ class JaxGibbsDriver:
         self.b = self._jit_draw_b(x, self._chain_keys(k))
         return x
 
-    def _act_from_rec(self, rec, nper):
-        """Max integrated ACT over every (chain, pulsar, parameter)
-        sub-chain of an adaptation record (C, steps, P, W) — the static
-        per-sweep scan length (reference ``aclength_white``,
-        ``pulsar_gibbs.py:367-371``)."""
-        from ..native import acor_native
+    def _act_from_rec(self, rec, nper, pct=95.0):
+        """Static per-sweep scan length from an adaptation record
+        (C, steps, P, W): the ``pct``-th percentile (ceil) of the
+        per-(chain, pulsar, parameter) integrated ACTs.
 
+        The reference sizes its sub-chain by the max over ONE pulsar's
+        parameters (``aclength_white``, ``pulsar_gibbs.py:367-371``).
+        Here the record spans C chains x P pulsars, and the max becomes
+        an extreme order statistic over hundreds of sub-chains, dominated
+        by likelihood-unconstrained coordinates (posterior ~ prior, e.g.
+        an EQUAD far below the measurement noise) whose mixing is
+        posterior-irrelevant — measured on the 45-pulsar bench model:
+        median ACT 4.9, 90th pct 12.9, max ~69, pinning every pulsar at
+        the 64-step cap.  Any fixed length is a valid MH kernel; the
+        percentile sizes it for the identified bulk."""
         rec = np.asarray(rec, dtype=np.float64)
         nper = np.asarray(nper)
         cols = []
@@ -1492,10 +1500,9 @@ class JaxGibbsDriver:
                      for w in range(int(nper[p]))]
         if not cols:
             return 1
-        block = np.ascontiguousarray(np.column_stack(cols))
-        if acor_native.available():
-            return max(1, int(acor_native.act_many(block)))
-        return max(1, max(int(integrated_act(c)) for c in cols))
+        # integrated_act dispatches to the native C estimator when built
+        acts = [integrated_act(col) for col in cols]
+        return max(1, int(np.ceil(np.percentile(acts, pct))))
 
     def _set_red_eigs(self):
         import jax.numpy as jnp
