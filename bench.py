@@ -200,7 +200,9 @@ def main(argv=None):
     adapt = 300 if args.quick else 1000
     # default C: the throughput-optimal point measured on one v5e chip
     # (C-sweep with the Metropolised b-draw: 8 -> 344, 16 -> 466,
-    # 32 -> 579, 48 -> 525 samples/s; the knee is ~32)
+    # 32 -> 579, 48 -> 525 samples/s; re-confirmed after the
+    # percentile-ACT change: 32 -> 462 at tight windows, 64 -> 481 with
+    # the exact b-draw ballooning to ~400 ms — the knee stays ~32)
     nchains = args.nchains or (4 if args.quick else 32)
     profile = not args.no_profile
 
