@@ -171,7 +171,11 @@ def from_enterprise(epsr) -> Pulsar:
     flags = {}
     for key, val in dict(getattr(epsr, "flags", {}) or {}).items():
         arr = np.asarray(val)
-        flags[key] = str(arr.flat[0]) if key == "pta" and arr.size else arr
+        if key == "pta":
+            # always a scalar label, even when the flag array is empty
+            flags[key] = str(arr.flat[0]) if arr.size else ""
+        else:
+            flags[key] = arr
     flags.setdefault("pta", "")
     pos = np.asarray(getattr(epsr, "pos", np.zeros(3)), dtype=np.float64)
     return Pulsar(

@@ -241,6 +241,23 @@ def de_step(rng, x, idx, hist):
     return q
 
 
+def de_hist_push(hist, pend, count, row, period=128):
+    """Frozen-window DE history update (the NumPy-oracle analogue of the
+    JAX path's ``DE_Q``/``DE_DELAY`` rule): new states accumulate in the
+    rolling ``pend`` buffer while :func:`de_step` proposals keep reading
+    the *frozen* ``hist`` snapshot, which refreshes from ``pend`` only
+    every ``period`` pushes.  Between refreshes the proposal distribution
+    is fixed, so the DE jump is exactly symmetric conditional on the
+    snapshot (ter Braak & Vrugt 2008 sampling-from-the-past) rather than
+    continuously adapting.  Returns ``(hist, pend, count)``."""
+    pend = np.roll(pend, -1, axis=0)
+    pend[-1] = row
+    count = int(count) + 1
+    if count % period == 0:
+        hist = pend.copy()
+    return hist, pend, count
+
+
 def seed_red_hist(rec, hist_len=64):
     """Thin a post-burn adaptation record (steps, d) into a (hist_len, d)
     DE history seed."""

@@ -745,11 +745,17 @@ def ecorr_block_ll(cm: CompiledPTA, x, b, r):
 def white_ll_ke(cm: CompiledPTA, x0, r, r2):
     """Kernel-ECORR white-block likelihood closure: the f32-exact relative
     diagonal form plus the O(1) Woodbury correction (whose x0 constant
-    cancels in MH differences).  ``r`` is the block-fixed residual."""
+    cancels in MH differences).  ``r`` is the block-fixed residual.
+
+    ``ndiag_fast`` throughout — the same N variant the relative diagonal
+    base and the exact b-draw's KE weights use (``draw_b_fn`` ->
+    ``tnt_d_x``), so the white-block target and the b-draw conditional
+    see one consistent N even where the fast and f64 diagonals differ by
+    f32 storage rounding."""
     base = white_ll_rel(cm, x0, r2)
 
     def ll(q):
-        Nq = cm.ndiag(q)
+        Nq = cm.ndiag_fast(q)
         return base(q) + ke_ll_corr(cm, q, Nq, ke_rz(cm, Nq, r))
 
     return ll
@@ -760,10 +766,11 @@ def ecorr_ll_ke(cm: CompiledPTA, x0, r):
     the diagonal D fixed, only ``c_e(q)`` moves, so the per-epoch
     aggregates ``s_e`` and ``z_e^2`` are precomputed once per block and
     each MH step costs O(Emax).  Differentiable — the same closure feeds
-    the Laplace proposal curvature."""
+    the Laplace proposal curvature.  ``ndiag_fast`` for consistency with
+    the b-draw's KE weights (see :func:`white_ll_ke`)."""
     import jax.numpy as jnp
 
-    N0 = cm.ndiag(x0)
+    N0 = cm.ndiag_fast(x0)
     cdt = cm.cdtype
     invN = (jnp.asarray(cm.toa_mask, cdt) / N0.astype(cdt))
     s = ke_segsum(cm, invN)[:, :-1]

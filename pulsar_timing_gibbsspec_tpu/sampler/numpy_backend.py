@@ -132,6 +132,8 @@ class NumpyGibbs:
         self.cov_white = None
         self.cov_red = None
         self.red_hist = None
+        self._red_pend = None
+        self._red_count = 0
         self.aclength_ecorr = None
 
     # ---- parameter helpers -------------------------------------------------
@@ -356,7 +358,7 @@ class NumpyGibbs:
         differences, the reference's top-weighted jump), covariance
         (SCAM-style eigendirection) and single-site jumps on the cheap
         b-conditional likelihood."""
-        from .blocks import de_step, seed_red_hist
+        from .blocks import de_hist_push, de_step, seed_red_hist
 
         rind = self.idx.red
         if adapt:
@@ -369,6 +371,8 @@ class NumpyGibbs:
             self.cov_red += 1e-12 * np.eye(len(rind))
             self._red_eigs = np.linalg.svd(self.cov_red)
             self.red_hist = seed_red_hist(burn)
+            self._red_pend = self.red_hist.copy()
+            self._red_count = 0
             return xnew
 
         x = xs.copy()
@@ -392,9 +396,10 @@ class NumpyGibbs:
             ll1 = self.lnlike_red(q) if np.isfinite(lp1) else -np.inf
             if (ll1 + lp1) - (ll0 + lp0) > np.log(self.rng.uniform()):
                 x, ll0, lp0 = q, ll1, lp1
-        # roll the current state into the history (sampling from the past)
-        self.red_hist = np.roll(self.red_hist, -1, axis=0)
-        self.red_hist[-1] = x[rind]
+        # push the state into the frozen-window history (proposals keep
+        # reading a snapshot that refreshes every de_hist_push period)
+        self.red_hist, self._red_pend, self._red_count = de_hist_push(
+            self.red_hist, self._red_pend, self._red_count, x[rind])
         return x
 
     def update_red_rho(self, xs):
@@ -492,8 +497,8 @@ class NumpyGibbs:
 
         out = {"rng_state": rng_state_pack(self.rng), "b": self.b}
         for key in ("aclength_white", "cov_white", "cov_red", "red_hist",
-                    "aclength_ecorr"):
-            val = getattr(self, key)
+                    "aclength_ecorr", "_red_pend", "_red_count"):
+            val = getattr(self, key, None)
             if val is not None:
                 out[key] = np.asarray(val)
         return out
@@ -504,7 +509,7 @@ class NumpyGibbs:
         rng_state_unpack(self.rng, state["rng_state"])
         self.b = np.asarray(state["b"])
         for key in ("aclength_white", "cov_white", "cov_red", "red_hist",
-                    "aclength_ecorr"):
+                    "aclength_ecorr", "_red_pend", "_red_count"):
             if key in state:
                 val = state[key]
                 setattr(self, key, int(val) if val.ndim == 0 else np.asarray(val))
@@ -515,3 +520,6 @@ class NumpyGibbs:
                     "resume checkpoint lacks the red-block DE history "
                     "(red_hist) — it was written by an incompatible "
                     "version; delete the chain directory to start fresh")
+            if getattr(self, "_red_pend", None) is None:
+                self._red_pend = np.asarray(self.red_hist).copy()
+                self._red_count = 0

@@ -203,6 +203,8 @@ class NumpyPTAGibbs:
         self.cov_white = None
         self.cov_red = None
         self.red_hist = None
+        self._red_pend = None
+        self._red_count = 0
         self.aclength_ecorr = None
 
     # ---- helpers -----------------------------------------------------------
@@ -551,7 +553,7 @@ class NumpyPTAGibbs:
         rind = self.idx.red
         if not len(rind):
             return xs.copy()
-        from .blocks import de_step, seed_red_hist
+        from .blocks import de_hist_push, de_step, seed_red_hist
 
         if adapt:
             rec = np.zeros((self.red_adapt_iters, len(rind)))
@@ -562,6 +564,8 @@ class NumpyPTAGibbs:
             self.cov_red += 1e-12 * np.eye(len(rind))
             self._red_eigs = np.linalg.svd(self.cov_red)
             self.red_hist = seed_red_hist(burn)
+            self._red_pend = self.red_hist.copy()
+            self._red_count = 0
             return xnew
         x = xs.copy()
         ll0, lp0 = self.lnlike_red(x), self.get_lnprior(x)
@@ -580,8 +584,8 @@ class NumpyPTAGibbs:
             ll1 = self.lnlike_red(q) if np.isfinite(lp1) else -np.inf
             if (ll1 + lp1) - (ll0 + lp0) > np.log(self.rng.uniform()):
                 x, ll0, lp0 = q, ll1, lp1
-        self.red_hist = np.roll(self.red_hist, -1, axis=0)
-        self.red_hist[-1] = x[rind]
+        self.red_hist, self._red_pend, self._red_count = de_hist_push(
+            self.red_hist, self._red_pend, self._red_count, x[rind])
         return x
 
     @property
@@ -676,8 +680,8 @@ class NumpyPTAGibbs:
         for ii, b in enumerate(self.b):
             out[f"b{ii}"] = b
         for key in ("aclength_white", "cov_white", "cov_red", "red_hist",
-                    "aclength_ecorr"):
-            val = getattr(self, key)
+                    "aclength_ecorr", "_red_pend", "_red_count"):
+            val = getattr(self, key, None)
             if val is not None:
                 out[key] = np.asarray(val)
         return out
@@ -688,7 +692,7 @@ class NumpyPTAGibbs:
         rng_state_unpack(self.rng, state["rng_state"])
         self.b = [np.asarray(state[f"b{ii}"]) for ii in range(self.P)]
         for key in ("aclength_white", "cov_white", "cov_red", "red_hist",
-                    "aclength_ecorr"):
+                    "aclength_ecorr", "_red_pend", "_red_count"):
             if key in state:
                 val = state[key]
                 setattr(self, key, int(val) if val.ndim == 0 else np.asarray(val))
@@ -699,3 +703,6 @@ class NumpyPTAGibbs:
                     "resume checkpoint lacks the red-block DE history "
                     "(red_hist) — it was written by an incompatible "
                     "version; delete the chain directory to start fresh")
+            if getattr(self, "_red_pend", None) is None:
+                self._red_pend = np.asarray(self.red_hist).copy()
+                self._red_count = 0

@@ -1,0 +1,221 @@
+"""Probe 2: where does the segmented b-draw's non-Gram cost sit, and how
+well does a cheap proposal (segmented Gram + f32 ridge factor + refined
+mean) accept?
+
+Components timed at C chains on the real device:
+  - phi(x) f64
+  - Sigma build + Jacobi precond (f64 elementwise)
+  - blocked_chol_inv f64
+  - solves/matvecs (mean + sample)
+  - f32 factor pipeline (native cholesky + triangular solves)
+  - proposed production draw: segmented Gram -> f64 Sigma -> f32 ridge
+    factor -> iteratively-refined mean -> sample + exact Hastings accept
+
+Usage: python tools/draw_probe.py [--nchains 32] [--warm 200]
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+from tools.gram_probe import tnt_d_seg  # noqa: E402
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nchains", type=int, default=32)
+    ap.add_argument("--warm", type=int, default=200)
+    ap.add_argument("--adapt", type=int, default=300)
+    args = ap.parse_args()
+
+    import bench
+    import jax
+    import jax.numpy as jnp
+    import jax.random as jr
+
+    from pulsar_timing_gibbsspec_tpu import profiling
+    from pulsar_timing_gibbsspec_tpu.ops.linalg import (
+        _batched_diag, blocked_chol_inv)
+    from pulsar_timing_gibbsspec_tpu.sampler import jax_backend as jb
+    from pulsar_timing_gibbsspec_tpu.sampler.jax_backend import JaxGibbsDriver
+
+    pta = bench.build_pta(45)
+    x0 = pta.initial_sample(np.random.default_rng(0))
+    drv = JaxGibbsDriver(pta, seed=1, common_rho=True,
+                         white_adapt_iters=args.adapt, chunk_size=50,
+                         nchains=args.nchains)
+    C = drv.C
+    cm = drv.cm
+    cshape, bshape = drv.chain_shapes(args.warm)
+    chain = np.zeros(cshape)
+    bchain = np.zeros(bshape)
+    t0 = time.time()
+    for _ in drv.run(x0, chain, bchain, 0, args.warm):
+        pass
+    print(f"# warmup {args.warm} iters in {time.time()-t0:.1f}s",
+          file=sys.stderr)
+
+    x = jnp.asarray(np.asarray(drv.x_cur, np.float64), cm.cdtype)
+    b = jnp.asarray(drv.b)
+
+    def t_body(single, label):
+        def body(xx, bb, k):
+            return jax.vmap(single)(xx, bb, jr.split(k, C))
+
+        t = profiling._scan_time(body, x, b, 20, 3)
+        print(f"{label:36s} {t*1e3:9.3f} ms  (C={C})")
+        return t
+
+    mark = 1e-30
+
+    # keep the computed arrays live in the scan carry so XLA can't elide
+    def ps(b1, *arrs):
+        s = sum(jnp.sum(a).astype(b1.dtype) for a in arrs)
+        return b1 + mark * s
+
+    t_body(lambda x1, b1, k1: (x1, ps(b1, cm.phi(x1))), "phi(x) f64")
+
+    def sigma_build(x1, b1, k1):
+        N = cm.ndiag_fast(x1)
+        TNT, d = tnt_d_seg(cm, N, 8)
+        phi = cm.phi(x1)
+        Sig = TNT + _batched_diag(1.0 / phi)
+        diag = jnp.diagonal(Sig, axis1=-2, axis2=-1)
+        dj = 1.0 / jnp.sqrt(diag)
+        A = Sig * dj[:, :, None] * dj[:, None, :]
+        return x1, ps(b1, A, d)
+
+    t_body(sigma_build, "gram_seg + Sigma build + precond")
+
+    def with_chol(x1, b1, k1):
+        N = cm.ndiag_fast(x1)
+        TNT, d = tnt_d_seg(cm, N, 8)
+        phi = cm.phi(x1)
+        Sig = TNT + _batched_diag(1.0 / phi)
+        diag = jnp.diagonal(Sig, axis1=-2, axis2=-1)
+        dj = 1.0 / jnp.sqrt(diag)
+        A = Sig * dj[:, :, None] * dj[:, None, :]
+        L, Li = blocked_chol_inv(A)
+        return x1, ps(b1, Li)
+
+    t_body(with_chol, "... + blocked_chol_inv f64")
+
+    def full_seg_draw(x1, b1, k1):
+        N = cm.ndiag_fast(x1)
+        TNT, d = tnt_d_seg(cm, N, 8)
+        phi = cm.phi(x1)
+        Sig = TNT + _batched_diag(1.0 / phi)
+        diag = jnp.diagonal(Sig, axis1=-2, axis2=-1)
+        dj = 1.0 / jnp.sqrt(diag)
+        A = Sig * dj[:, :, None] * dj[:, None, :]
+        L, Li = blocked_chol_inv(A)
+        u = jnp.einsum("...ij,...j->...i", Li, dj * d)
+        mean = dj * jnp.einsum("...ji,...j->...i", Li, u)
+        z = jr.normal(k1, (cm.P, cm.Bmax), cm.cdtype)
+        samp = mean + dj * jnp.einsum("...ji,...j->...i", Li, z)
+        return x1, samp
+
+    t_body(full_seg_draw, "... + solves (full seg draw)")
+
+    # ---- the candidate production proposal ------------------------------
+    from pulsar_timing_gibbsspec_tpu.ops.linalg import (
+        precond_cholesky, precond_solve)
+
+    RIDGE = 4e-6
+
+    def draw_refined(x1, b1, u1, k1, nrefine=2):
+        fdt = cm.dtype
+        cdt = cm.cdtype
+        k1a, k2a = jr.split(k1)
+        N = cm.ndiag_fast(x1)
+        TNT, d = tnt_d_seg(cm, N, 8)                 # f64 values
+        phi = cm.phi(x1)
+        Sig = TNT + _batched_diag(1.0 / phi)         # f64
+        diag = jnp.diagonal(Sig, axis1=-2, axis2=-1)
+        dj = 1.0 / jnp.sqrt(diag)                    # f64
+        A = (Sig * dj[:, :, None] * dj[:, None, :]).astype(fdt)
+        L32 = jnp.linalg.cholesky(
+            A + fdt(RIDGE) * jnp.eye(cm.Bmax, dtype=fdt))
+        dj32 = dj.astype(fdt)
+
+        def solve32(v):
+            w = jax.scipy.linalg.solve_triangular(
+                L32, (dj32 * v.astype(fdt)), lower=True)
+            w = jax.scipy.linalg.solve_triangular(L32, w, lower=True,
+                                                  trans=1)
+            return dj32 * w
+
+        m = solve32(d).astype(cdt)
+        for _ in range(nrefine):
+            r = d - jnp.einsum("...ij,...j->...i", Sig, m)
+            m = m + solve32(r).astype(cdt)
+        z = jr.normal(k1a, (cm.P, cm.Bmax), fdt)
+        step = dj32 * jax.scipy.linalg.solve_triangular(
+            L32, z, lower=True, trans=1)
+        bp = m + step.astype(cdt)
+        up = jb.b_matvec(cm, bp)
+        lpi_new = jb._logpi_b_per(cm, x1, bp, up)
+        lpi_old = jb._logpi_b_per(cm, x1, b1, u1)
+        w_old = jnp.einsum("pji,pj->pi", L32,
+                           ((b1 - m).astype(fdt) / dj32), precision="highest")
+        logq_old = -0.5 * jnp.sum(w_old * w_old, axis=1).astype(cdt)
+        logq_new = -0.5 * jnp.sum(z * z, axis=1).astype(cdt)
+        logr = (lpi_new - lpi_old) + (logq_old - logq_new)
+        ok = jnp.all(jnp.isfinite(bp.astype(fdt)), axis=1) & jnp.isfinite(
+            logr)
+        logu = jnp.log(jr.uniform(k2a, (cm.P,), cdt))
+        acc = ok & (logr > logu)
+        b_new = jnp.where(acc[:, None], bp, b1)
+        u_new = jnp.where(acc[:, None], up, u1)
+        return b_new, u_new, acc, logr
+
+    def prod_draw(x1, b1, k1):
+        u1 = jb.b_matvec(cm, b1)
+        bn, un, acc, _ = draw_refined(x1, b1, u1, k1)
+        return x1, bn
+
+    t_body(prod_draw, "candidate refined-mean MH draw")
+
+    def cur_mh(x1, b1, k1):
+        u1 = jb.b_matvec(cm, b1)
+        bn, un, acc = jb.draw_b_mh(cm, x1, b1, u1, k1)
+        return x1, bn
+
+    t_body(cur_mh, "current draw_b_mh (f32)")
+
+    # acceptance of the candidate across chains
+    @jax.jit
+    def acc_of(x1, b1, k1):
+        u1 = jb.b_matvec(cm, b1)
+        _, _, acc, logr = draw_refined(x1, b1, u1, k1)
+        return jnp.minimum(1.0, jnp.exp(logr))
+
+    accs = []
+    for ci in range(C):
+        accs.append(np.asarray(acc_of(x[ci], b[ci], jr.PRNGKey(ci))))
+    accs = np.concatenate(accs)
+    print(f"refined-MH accept: mean={accs.mean():.6f} "
+          f"min={accs.min():.6f} p1={np.percentile(accs, 1):.6f}")
+
+    # acceptance of current f32 draw for comparison
+    @jax.jit
+    def acc_cur(x1, b1, k1):
+        u1 = jb.b_matvec(cm, b1)
+        _, _, acc = jb.draw_b_mh(cm, x1, b1, u1, k1)
+        return acc
+
+    accs2 = []
+    for ci in range(C):
+        accs2.append(np.asarray(acc_cur(x[ci], b[ci], jr.PRNGKey(ci))))
+    accs2 = np.concatenate(accs2)
+    print(f"current f32 draw accept-rate (binary, one step): "
+          f"{accs2.mean():.4f}")
+
+
+if __name__ == "__main__":
+    main()

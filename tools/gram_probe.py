@@ -1,0 +1,229 @@
+"""Probe: segmented-f32 MXU Gram vs the emulated-f64 VPU Gram (tnt_d).
+
+The exact b-draw's cost is dominated by the f64-accumulated TNT einsum
+(VERDICT r3: b_draw 148.7 ms at C=32, ~40% of the steady sweep after
+EXACT_EVERY amortization).  The inputs are f32 *entries* already — the f64
+buys only exact accumulation over the Nmax~720 TOA axis.  This probe
+measures, on the real device and the real 45-pulsar bench model at a
+warmed-up state:
+
+  - wall time of the current f64 Gram vs segmented f32 einsums (f32 MXU
+    accumulate within segments of m TOAs, f64 sum over segments) at
+    several segment counts, at C=32 and C=64;
+  - accuracy: max Gram error relative to the Jacobi scale sqrt(Gbb*Gcc);
+  - lambda_min of the preconditioned conditional precision A = D Sigma D
+    (the margin that decides whether a straight Gibbs swap risks an
+    indefinite Cholesky);
+  - the b-draw conditional-mean error in posterior-sigma units;
+  - the Metropolis log-ratio if the segmented draw is used as a proposal
+    with the exact accept (predicted acceptance).
+
+Usage: python tools/gram_probe.py [--nchains 32] [--warm 200]
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+
+def tnt_d_seg(cm, Nvec, nseg):
+    """Segmented Gram: f32 MXU einsum per segment, f64 segment reduction."""
+    import jax.numpy as jnp
+
+    Ta = jnp.concatenate([jnp.asarray(cm.T),
+                          jnp.asarray(cm.y)[:, :, None]], axis=2)
+    TNa = Ta / Nvec.astype(cm.dtype)[:, :, None]
+    P, N, B1 = Ta.shape
+    m = N // nseg
+    assert m * nseg == N, (N, nseg)
+    G32 = jnp.einsum("psnb,psnc->spbc", TNa.reshape(P, nseg, m, B1),
+                     Ta.reshape(P, nseg, m, B1), precision="highest")
+    G = jnp.sum(G32.astype(cm.cdtype), axis=0)
+    return G[:, :cm.Bmax, :cm.Bmax], G[:, :cm.Bmax, cm.Bmax]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nchains", type=int, default=32)
+    ap.add_argument("--warm", type=int, default=200)
+    ap.add_argument("--adapt", type=int, default=300)
+    args = ap.parse_args()
+
+    import bench
+    import jax
+    import jax.numpy as jnp
+    import jax.random as jr
+
+    from pulsar_timing_gibbsspec_tpu import profiling
+    from pulsar_timing_gibbsspec_tpu.ops.linalg import (
+        _batched_diag, blocked_chol_inv, mvn_conditional_draw)
+    from pulsar_timing_gibbsspec_tpu.sampler import jax_backend as jb
+    from pulsar_timing_gibbsspec_tpu.sampler.jax_backend import JaxGibbsDriver
+
+    print(f"# devices: {jax.devices()}", file=sys.stderr)
+    pta = bench.build_pta(45)
+    x0 = pta.initial_sample(np.random.default_rng(0))
+    drv = JaxGibbsDriver(pta, seed=1, common_rho=True,
+                         white_adapt_iters=args.adapt, chunk_size=50,
+                         nchains=args.nchains)
+    C = drv.C
+    cm = drv.cm
+    cshape, bshape = drv.chain_shapes(args.warm)
+    chain = np.zeros(cshape)
+    bchain = np.zeros(bshape)
+    t0 = time.time()
+    for _ in drv.run(x0, chain, bchain, 0, args.warm):
+        pass
+    print(f"# warmup {args.warm} iters done in {time.time()-t0:.1f}s",
+          file=sys.stderr)
+
+    x = jnp.asarray(np.asarray(drv.x_cur, np.float64), cm.cdtype)  # (C, nx)
+    b = jnp.asarray(drv.b)                                         # (C,P,B)
+    if x.ndim == 1:
+        x = jnp.tile(x, (C, 1))
+
+    # ---- timing ---------------------------------------------------------
+    def time_gram(fn, label):
+        def single(x1, b1, k1):
+            N = cm.ndiag_fast(x1)
+            TNT, d = fn(cm, N)
+            return x1, b1 + 1e-30 * d[:, :] + 1e-30 * TNT[:, :, 0]
+
+        def body(xx, bb, k):
+            return jax.vmap(single)(xx, bb, jr.split(k, C))
+
+        t = profiling._scan_time(body, x, b, 20, 3)
+        print(f"{label:28s} {t*1e3:9.3f} ms  (C={C})")
+
+    time_gram(jb.tnt_d, "tnt_d f64 (current)")
+    for nseg in (4, 8, 16):
+        time_gram(lambda cm_, N, n=nseg: tnt_d_seg(cm_, N, n),
+                  f"tnt_d_seg f32 nseg={nseg}")
+
+    # full exact draw vs segmented draw
+    def time_draw(fn, label):
+        def body(xx, bb, k):
+            return jax.vmap(lambda x1, b1, k1: (x1, fn(x1, k1)))(
+                xx, bb, jr.split(k, C))
+
+        t = profiling._scan_time(body, x, b, 20, 3)
+        print(f"{label:28s} {t*1e3:9.3f} ms  (C={C})")
+
+    def draw_exact(x1, k1):
+        return jb.draw_b_fn(cm, x1, k1)
+
+    def draw_seg(x1, k1, nseg=8):
+        N = cm.ndiag_fast(x1)
+        TNT, d = tnt_d_seg(cm, N, nseg)
+        phi = cm.phi(x1)
+        z = jr.normal(k1, (cm.P, cm.Bmax), cm.cdtype)
+        bb, _ = mvn_conditional_draw(TNT, 1.0 / phi, d, z)
+        return bb
+
+    time_draw(draw_exact, "draw_b exact f64 (current)")
+    time_draw(draw_seg, "draw_b segmented nseg=8")
+
+    # ---- accuracy at the warmed state (chain 0..3) ----------------------
+    @jax.jit
+    def grams(x1):
+        N = cm.ndiag_fast(x1)
+        TNT0, d0 = jb.tnt_d(cm, N)
+        outs = {"f64": (TNT0, d0)}
+        for nseg in (4, 8, 16):
+            outs[f"seg{nseg}"] = tnt_d_seg(cm, N, nseg)
+        phi = cm.phi(x1)
+        return outs, phi
+
+    for ci in range(min(4, C)):
+        outs, phi = grams(x[ci])
+        TNT0, d0 = outs["f64"]
+        TNT0 = np.asarray(TNT0, np.float64)
+        d0 = np.asarray(d0, np.float64)
+        phi = np.asarray(phi, np.float64)
+        Sig0 = TNT0 + np.stack([np.diag(1.0 / p) for p in phi])
+        dg = np.sqrt(np.einsum("pb,pc->pbc",
+                               np.diagonal(Sig0, axis1=1, axis2=2),
+                               np.diagonal(Sig0, axis1=1, axis2=2)))
+        lam = []
+        for p in range(cm.P):
+            dj = 1.0 / np.sqrt(np.diag(Sig0[p]))
+            A = Sig0[p] * dj[:, None] * dj[None, :]
+            lam.append(np.linalg.eigvalsh(A)[0])
+        lam = np.array(lam)
+        line = (f"chain {ci}: lam_min(precond A) min={lam.min():.3e} "
+                f"p5={np.percentile(lam, 5):.3e}")
+        for nseg in (4, 8, 16):
+            T1, _ = outs[f"seg{nseg}"]
+            err = np.max(np.abs(np.asarray(T1, np.float64) - TNT0) / dg)
+            line += f"  err_seg{nseg}={err:.2e}"
+        print(line)
+
+    # ---- draw-mean error in sigma units + MH log-ratio ------------------
+    @jax.jit
+    def mean_err(x1, k1):
+        N = cm.ndiag_fast(x1)
+        TNT0, d0 = jb.tnt_d(cm, N)
+        TNT1, d1 = tnt_d_seg(cm, N, 8)
+        phi = cm.phi(x1)
+        z = jr.normal(k1, (cm.P, cm.Bmax), cm.cdtype)
+        b0, m0 = mvn_conditional_draw(TNT0, 1.0 / phi, d0, z)
+        b1, m1 = mvn_conditional_draw(TNT1, 1.0 / phi, d1, z)
+        # posterior sigma: diag of Sigma^-1 via the factor
+        Sig = TNT0 + _batched_diag(1.0 / phi)
+        diag = jnp.diagonal(Sig, axis1=-2, axis2=-1)
+        dj = 1.0 / jnp.sqrt(diag)
+        A = Sig * dj[..., :, None] * dj[..., None, :]
+        _, Li = blocked_chol_inv(A)
+        # Sigma^-1 = D Li^T Li D  ->  var_i = dj_i^2 sum_k Li[k, i]^2
+        var = dj * dj * jnp.sum(Li * Li, axis=-2)
+        sig = jnp.sqrt(var)
+        return jnp.max(jnp.abs(m1 - m0) / sig), jnp.max(
+            jnp.abs(b1 - b0) / sig)
+
+    for ci in range(min(4, C)):
+        me, be = mean_err(x[ci], jr.PRNGKey(ci))
+        print(f"chain {ci}: mean_err={float(me):.3e} sigma, "
+              f"draw_err={float(be):.3e} sigma")
+
+    # MH log-ratio of the segmented draw as proposal vs exact target
+    @jax.jit
+    def mh_logr(x1, b1, k1):
+        N = cm.ndiag_fast(x1)
+        TNT1, d1 = tnt_d_seg(cm, N, 8)
+        phi = cm.phi(x1)
+        Sig = TNT1 + _batched_diag(1.0 / phi)
+        diag = jnp.diagonal(Sig, axis1=-2, axis2=-1)
+        dj = 1.0 / jnp.sqrt(diag)
+        A = Sig * dj[..., :, None] * dj[..., None, :]
+        L, Li = blocked_chol_inv(A)
+        u = jnp.einsum("...ij,...j->...i", Li, dj * d1)
+        mean = dj * jnp.einsum("...ji,...j->...i", Li, u)
+        z = jr.normal(k1, (cm.P, cm.Bmax), cm.cdtype)
+        bp = mean + dj * jnp.einsum("...ji,...j->...i", Li, z)
+        up = jb.b_matvec(cm, bp)
+        u_old = jb.b_matvec(cm, b1)
+        lpi_new = jb._logpi_b_per(cm, x1, bp, up)
+        lpi_old = jb._logpi_b_per(cm, x1, b1, u_old)
+        w_old = jnp.einsum("pji,pj->pi", L, (b1 - mean) / dj)
+        logq_old = -0.5 * jnp.sum(w_old * w_old, axis=1)
+        logq_new = -0.5 * jnp.sum(z * z, axis=1)
+        return (lpi_new - lpi_old) + (logq_old - logq_new)
+
+    accs = []
+    for ci in range(min(8, C)):
+        lr = np.asarray(mh_logr(x[ci], b[ci], jr.PRNGKey(100 + ci)),
+                        np.float64)
+        accs.append(np.minimum(1.0, np.exp(lr)))
+    accs = np.concatenate(accs)
+    print(f"MH-accept of segmented proposal: mean={accs.mean():.6f} "
+          f"min={accs.min():.6f} p1={np.percentile(accs, 1):.6f}")
+
+
+if __name__ == "__main__":
+    main()
