@@ -58,22 +58,39 @@ def build_pta(n_psr=45, nbins=10, orf="crn"):
         red_var=True, red_psd="spectrum", red_components=nbins, **kw)
 
 
-def _window_rates(marks):
+NWINDOWS = 5
+
+
+def _window_rates(marks, nwin=NWINDOWS):
     """Per-window sweep rates from (iteration, time) marks split into
-    three equal spans."""
+    ``nwin`` equal spans (median-of-windows absorbs tunnel hiccups; >=5
+    windows so the median has real support)."""
     marks = np.asarray(marks, dtype=np.float64)
     if len(marks) < 2:
         return []
-    if len(marks) < 4:
+    if len(marks) < nwin + 1:
         its, ts = marks[-1, 0] - marks[0, 0], marks[-1, 1] - marks[0, 1]
         return [float(its / ts)] if ts > 0 else []
-    cuts = np.linspace(0, len(marks) - 1, 4).astype(int)
+    cuts = np.linspace(0, len(marks) - 1, nwin + 1).astype(int)
     out = []
     for a, b in zip(cuts[:-1], cuts[1:]):
         dt = marks[b, 1] - marks[a, 1]
         if dt > 0:
             out.append(float((marks[b, 0] - marks[a, 0]) / dt))
     return out
+
+
+def _raw_marks(marks):
+    """Self-explaining raw totals: every cross-round number is
+    re-derivable from (iteration, unix-time) mark pairs."""
+    marks = np.asarray(marks, dtype=np.float64)
+    if len(marks) < 2:
+        return {}
+    return {
+        "steady_sweeps": int(marks[-1, 0] - marks[0, 0]),
+        "steady_wall_s": round(float(marks[-1, 1] - marks[0, 1]), 3),
+        "marks": [[int(i), round(float(t), 3)] for i, t in marks],
+    }
 
 
 def bench_jax(pta, x0, niter, adapt_iters, nchains, profile=False):
@@ -104,6 +121,7 @@ def bench_jax(pta, x0, niter, adapt_iters, nchains, profile=False):
     assert windows, "benchmark too short to measure a steady window"
     assert np.all(np.isfinite(chain)), "non-finite chain values"
     steady = float(np.median(windows))
+    raw = _raw_marks(marks)
     prof = None
     if profile:
         from pulsar_timing_gibbsspec_tpu import profiling
@@ -112,7 +130,7 @@ def bench_jax(pta, x0, niter, adapt_iters, nchains, profile=False):
         fl = profiling.sweep_flops(drv.cm, nchains=C)
         print(profiling.format_report(times, fl, steady), file=sys.stderr)
         prof = times
-    return steady, windows, C, drv, prof
+    return steady, windows, C, drv, prof, raw
 
 
 def bench_numpy(gibbs, x0, niter):
@@ -121,8 +139,9 @@ def bench_numpy(gibbs, x0, niter):
     for ii in range(niter):
         x = gibbs.sweep(x)
         marks.append((ii + 1, time.time()))
-    windows = _window_rates(marks)
-    return float(np.median(windows)), windows
+    windows = _window_rates(marks, nwin=3)
+    return float(np.median(windows)), windows, _raw_marks(
+        [marks[0], marks[-1]])
 
 
 def _retry_transport(fn):
@@ -156,10 +175,11 @@ def bench_config(orf, n_psr, niter, np_iters, adapt, nchains, profile):
         idx = BlockIndex.build(pta.param_names)
         if len(idx.orf):
             x0[idx.orf] = 0.0
-    jax_rate, windows, C, drv, prof = _retry_transport(
+    jax_rate, windows, C, drv, prof, raw = _retry_transport(
         lambda: bench_jax(pta, x0, niter, adapt, nchains, profile=profile))
     g = NumpyPTAGibbs(pta, seed=2, white_adapt_iters=adapt)
-    np_rate, np_windows = bench_numpy(g, np.asarray(x0, np.float64), np_iters)
+    np_rate, np_windows, np_raw = bench_numpy(
+        g, np.asarray(x0, np.float64), np_iters)
     fl = profiling.sweep_flops(drv.cm, nchains=C)
     out = {
         "sweeps_per_sec": round(jax_rate, 2),
@@ -170,6 +190,8 @@ def bench_config(orf, n_psr, niter, np_iters, adapt, nchains, profile):
         "vs_oracle": round(C * jax_rate / np_rate, 2),
         "mfu": round(fl["total"] * jax_rate / profiling.device_peak_flops(),
                      6),
+        "raw": raw,
+        "numpy_raw": np_raw,
     }
     if prof is not None:
         out["per_block_ms"] = {k: round(v * 1e3, 3) for k, v in prof.items()}
@@ -255,7 +277,8 @@ def main(argv=None):
         "device_kind": jax.devices()[0].device_kind,
         **{k: head[k] for k in ("sweeps_per_sec", "rate_windows", "nchains",
                                 "numpy_sweeps_per_sec",
-                                "numpy_rate_windows", "mfu")},
+                                "numpy_rate_windows", "mfu", "raw",
+                                "numpy_raw")},
     }
     if crn is not None and "per_block_ms" in crn:
         out["per_block_ms"] = crn["per_block_ms"]

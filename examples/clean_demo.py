@@ -27,15 +27,28 @@ def main():
     ap.add_argument("--psr", default="J1713+0747")
     ap.add_argument("--backend", default="jax", choices=["jax", "numpy"])
     ap.add_argument("--outdir", default="./chains_clean_demo")
+    ap.add_argument("--npz", default=None, metavar="SNAPSHOT",
+                    help="load a recorded enterprise.Pulsar attribute "
+                    "surface (.npz, see tools/make_enterprise_snapshot.py) "
+                    "through the from_enterprise adapter instead of the "
+                    "par/tim loader — the reference's real-data path "
+                    "(clean_demo.ipynb cells 3-5)")
     args = ap.parse_args()
 
     from pulsar_timing_gibbsspec_tpu import PulsarBlockGibbs, model_general
-    from pulsar_timing_gibbsspec_tpu.data import load_pulsar
+    from pulsar_timing_gibbsspec_tpu.data import (load_enterprise_snapshot,
+                                                  load_pulsar)
 
-    # reference clean_demo cell 3: Pulsar(par, tim)
-    psr = load_pulsar(f"{REFDATA}/{args.psr}.par", f"{REFDATA}/{args.psr}.tim",
-                      inject=dict(log10_A=np.log10(2e-15), gamma=13.0 / 3.0,
-                                  nmodes=30))
+    if args.npz:
+        # reference clean_demo cell 3 with a real timing solution:
+        # enterprise.Pulsar attribute surface -> from_enterprise
+        psr = load_enterprise_snapshot(args.npz)
+    else:
+        # reference clean_demo cell 3: Pulsar(par, tim)
+        psr = load_pulsar(f"{REFDATA}/{args.psr}.par",
+                          f"{REFDATA}/{args.psr}.tim",
+                          inject=dict(log10_A=np.log10(2e-15),
+                                      gamma=13.0 / 3.0, nmodes=30))
     # cell 5: model_general(red_var=False, white_vary=True,
     #                       common_psd='spectrum', common_components=10)
     pta = model_general([psr], tm_svd=True, red_var=False, white_vary=True,

@@ -1,5 +1,5 @@
 from .dataset import (Pulsar, load_pulsar, load_directory, get_tspan,
-                      from_enterprise)
+                      from_enterprise, load_enterprise_snapshot)
 from .partim import parse_par, parse_tim
 from .fourier import fourier_basis
 from .design import design_matrix
@@ -9,6 +9,8 @@ __all__ = [
     "load_pulsar",
     "load_directory",
     "get_tspan",
+    "from_enterprise",
+    "load_enterprise_snapshot",
     "parse_par",
     "parse_tim",
     "fourier_basis",

@@ -190,3 +190,36 @@ def from_enterprise(epsr) -> Pulsar:
         flags=flags,
         pos=pos,
     )
+
+
+def load_enterprise_snapshot(path) -> Pulsar:
+    """Load a recorded ``enterprise.Pulsar`` attribute surface (``.npz``)
+    through :func:`from_enterprise`.
+
+    The snapshot format (written by ``tools/make_enterprise_snapshot.py``)
+    records exactly the attributes the adapter consumes: ``name``,
+    ``toas``/``toaerrs``/``residuals`` [s], ``freqs`` [MHz],
+    ``backend_flags``, the full tempo2-structured ``Mmat`` with
+    ``fitpars``, per-TOA ``flag_<name>`` arrays and ``pos``.  Loading goes
+    through :func:`from_enterprise` itself, so the real-data adapter is
+    the code path exercised — hermetically, with no enterprise install
+    (reference ``clean_demo.ipynb`` cells 3-5).
+    """
+    import types
+
+    with np.load(path, allow_pickle=False) as z:
+        flags = {k[len("flag_"):]: z[k] for k in z.files
+                 if k.startswith("flag_")}
+        epsr = types.SimpleNamespace(
+            name=str(z["name"]),
+            toas=z["toas"],
+            toaerrs=z["toaerrs"],
+            residuals=z["residuals"],
+            freqs=z["freqs"],
+            backend_flags=z["backend_flags"].astype(object),
+            Mmat=z["Mmat"],
+            fitpars=[str(s) for s in z["fitpars"]],
+            flags=flags,
+            pos=z["pos"],
+        )
+    return from_enterprise(epsr)

@@ -188,13 +188,17 @@ def model_general(psrs, tm_var=False, tm_linear=False, tmparam_list=None,
             p.init = 0.0
             return p
 
-        if orf_el == "bin_orf":
+        # zero_diag_* variants carry the same sampled weight set as their
+        # full counterparts (the zero diagonal only changes G(theta))
+        orf_base = (orf_el[len("zero_diag_"):]
+                    if orf_el.startswith("zero_diag_") else orf_el)
+        if orf_base == "bin_orf":
             from .orf import BIN_ORF_EDGES
 
             orf_param_sets.append([
                 orf_weight(f"{gname}_orfw_bin_{j}")
                 for j in range(len(BIN_ORF_EDGES) - 1)])
-        elif orf_el == "legendre_orf":
+        elif orf_base == "legendre_orf":
             orf_param_sets.append([
                 orf_weight(f"{gname}_orfw_leg_{l}")
                 for l in range(leg_lmax + 1)])

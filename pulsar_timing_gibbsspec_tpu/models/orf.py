@@ -160,7 +160,16 @@ def orf_param_basis(name: str, positions, leg_lmax: int = 5):
       ``l = 0..leg_lmax``; ``B_l[a,b] = P_l(cos zeta_ab)`` off-diagonal
 
     Returns ``(B, labels)`` with ``B`` of shape (J, P, P), zero diagonal.
+
+    ``zero_diag_bin_orf`` / ``zero_diag_legendre_orf`` (the reference's
+    fixed-common-amplitude detection-statistic variants,
+    ``model_definition.py:202-205``) carry the same weight basis — the
+    difference is only that ``G(theta)`` omits the identity, which makes
+    the prior non-PD; the sampler gate in ``sampler/compiled.py`` rejects
+    sampling them, but the model *builds*.
     """
+    if name.startswith("zero_diag_"):
+        name = name[len("zero_diag_"):]
     P = len(positions)
     cosz = np.eye(P)
     for a in range(P):

@@ -194,6 +194,15 @@ def profile_blocks(driver, x, repeats=5, inner=50):
     out["b_draw"] = _scan_time(
         vm(lambda x, b, k: (x, jb.draw_b_fn(cm, x, k))), x, b, inner,
         repeats)
+    if cm.orf_name == "crn" and not cm.has_ke:
+        # the production refresh slot (exact_every): Metropolised
+        # segmented-Gram draw, cheaper than the f64 exact draw above
+        def refresh1(x1, b1, k1):
+            u1 = jb.b_matvec(cm, b1)
+            bn, _, _ = jb.draw_b_refresh(cm, x1, b1, u1, k1)
+            return x1, bn
+
+        out["b_refresh"] = _scan_time(vm(refresh1), x, b, inner, repeats)
 
     # the composed sweep, timed the same way (this is what the chunked
     # driver actually runs; t=1 exercises the Metropolised-b-draw branch),

@@ -508,6 +508,90 @@ class CompiledPTA:
         return jnp.where(self.red_valid[:, None] > 0, out, PHI_FLOOR)
 
 
+# ===========================================================================
+# pytree registration: CompiledPTA as a jit ARGUMENT
+# ===========================================================================
+#
+# Closure-captured jax.Arrays are lowered as replicated constants — GSPMD
+# drops their shardings entirely (measured: a jit-captured pulsar-sharded
+# basis compiles to zero collective ops, i.e. every device computes the
+# whole model).  Single-chip drivers may keep the closure style, but any
+# multi-device path MUST pass the sharded CompiledPTA *as an argument* so
+# the compiled program sees the pulsar-axis shardings and inserts the
+# mesh collectives (`__graft_entry__.dryrun_multichip` asserts this on
+# the optimized HLO).  Registering the dataclass as a pytree makes that
+# an ordinary function argument: array fields are leaves, everything
+# else rides an identity-hashed static box (stable per instance, so
+# repeated calls with the same model hit the jit cache).
+
+_CM_ARRAY_FIELDS = (
+    "y", "T", "toa_mask", "basis_mask", "psr_mask", "sigma2",
+    "efac_ix", "equad_ix", "gequad_ix", "const_pool", "phi_base",
+    "components", "pkind", "pa", "pb", "prop_scale",
+    "gw_sin_ix", "gw_cos_ix", "gw_f", "gw_df", "gw_hyp_ix", "gw_rho_ix",
+    "rho_ix_x", "red_valid", "red_hyp_ix", "red_rho_ix", "red_rho_ix_x",
+    "red_sin_ix", "red_cos_ix", "ec_cols", "ec_ix",
+    "white_par_ix", "white_nper", "ecorr_par_ix", "ecorr_nper",
+    "orf_Ginv", "gp_mask", "red_f", "red_df", "orf_B", "orf_par_ix",
+    "ke_eid", "ke_par_ix",
+)
+_CM_STATIC_FIELDS = tuple(
+    f.name for f in dataclasses.fields(CompiledPTA)
+    if f.name not in _CM_ARRAY_FIELDS)
+
+
+class _StaticBox:
+    """Identity-hashed aux-data carrier: jit cache keys compare by
+    instance, and the box is memoized on the CompiledPTA so repeated
+    flattens of one model stay cache-stable."""
+
+    __slots__ = ("data",)
+
+    def __init__(self, data):
+        self.data = data
+
+    def __hash__(self):
+        return id(self)
+
+    def __eq__(self, other):
+        return self is other
+
+
+def _cm_flatten(cm):
+    children = tuple(getattr(cm, n) for n in _CM_ARRAY_FIELDS)
+    box = cm.__dict__.get("_staticbox")
+    if box is None:
+        box = _StaticBox({n: getattr(cm, n) for n in _CM_STATIC_FIELDS})
+        cm.__dict__["_staticbox"] = box
+    return children, box
+
+
+def _cm_unflatten(box, children):
+    kw = dict(box.data)
+    kw.update(zip(_CM_ARRAY_FIELDS, children))
+    cm = CompiledPTA(**kw)
+    cm.__dict__["_staticbox"] = box
+    return cm
+
+
+def _gp_flatten(c):
+    return (c.cols, c.f, c.df, c.hyp_ix, c.rho_ix), c.kind
+
+
+def _gp_unflatten(kind, children):
+    return GPComponent(kind, *children)
+
+
+def _register_pytrees():
+    from jax import tree_util
+
+    tree_util.register_pytree_node(CompiledPTA, _cm_flatten, _cm_unflatten)
+    tree_util.register_pytree_node(GPComponent, _gp_flatten, _gp_unflatten)
+
+
+_register_pytrees()
+
+
 def _as_i32(a):
     return np.asarray(a, dtype=np.int32)
 
@@ -922,6 +1006,18 @@ def compile_pta(pta, pad_pulsars: int | None = None,
         if len(gw_orfs) > 1:
             raise NotImplementedError(f"mixed common-process ORFs {gw_orfs}")
         orf_name = gw_orfs.pop()
+        if orf_name.startswith("zero_diag_"):
+            # builds (reference model_definition.py:202-205: fixed common
+            # amplitude, detection-statistic cross-correlation models) but
+            # G(theta) has zero diagonal -> the coefficient prior is not
+            # positive definite and cannot anchor a Gibbs draw
+            raise NotImplementedError(
+                f"orf='{orf_name}' builds (fixed-amplitude detection-"
+                "statistic model) but cannot be *sampled*: the zero-"
+                "diagonal correlation is not a positive-definite "
+                "coefficient prior.  Evaluate it with your own "
+                "likelihood machinery, or sample the full-diagonal "
+                f"'{orf_name[len('zero_diag_'):]}' instead")
         # intrinsic red is supported alongside a correlated common
         # process only on DISJOINT columns (the factory gives correlated
         # processes their own share_group): the joint cross-pulsar prior

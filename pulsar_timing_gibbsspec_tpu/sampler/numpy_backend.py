@@ -379,17 +379,23 @@ class NumpyGibbs:
         ll0 = self.lnlike_red(x)
         lp0 = self.get_lnprior(x)
         U, S, _ = self._red_eigs
+        am_sqrt = U * np.sqrt(S)[None, :]
         for _ in range(self.red_steps):
             r = self.rng.uniform()
             if r < 0.5:
-                # DE: reference ratio weights it highest (DE=50/SCAM=30)
+                # DE: reference ratio weights it highest (DE=50/SCAM=30/AM=15)
                 q = de_step(self.rng, x, rind, self.red_hist)
-            elif r < 0.8:
+            elif r < 0.65:
                 # SCAM: jump along one adapted eigendirection
                 q = x.copy()
                 j = self.rng.integers(len(rind))
                 step = 2.38 * np.sqrt(S[j]) * self.rng.standard_normal()
                 q[rind] += step * U[:, j]
+            elif r < 0.8:
+                # AM: full adapted-covariance jump
+                q = x.copy()
+                z = self.rng.standard_normal(len(rind))
+                q[rind] += (2.38 / np.sqrt(len(rind))) * (am_sqrt @ z)
             else:
                 q = proposal_step(self.rng, x, rind, 0.05 * len(rind))
             lp1 = self.get_lnprior(q)

@@ -570,14 +570,20 @@ class NumpyPTAGibbs:
         x = xs.copy()
         ll0, lp0 = self.lnlike_red(x), self.get_lnprior(x)
         U, S, _ = self._red_eigs
+        am_sqrt = U * np.sqrt(S)[None, :]
         for _ in range(self.red_steps):
             r = self.rng.uniform()
             if r < 0.5:
                 q = de_step(self.rng, x, rind, self.red_hist)
-            elif r < 0.8:
+            elif r < 0.65:
                 q = x.copy()
                 j = self.rng.integers(len(rind))
                 q[rind] += 2.38 * np.sqrt(S[j]) * self.rng.standard_normal() * U[:, j]
+            elif r < 0.8:
+                # AM: full adapted-covariance jump (reference weight 15/95)
+                q = x.copy()
+                z = self.rng.standard_normal(len(rind))
+                q[rind] += (2.38 / np.sqrt(len(rind))) * (am_sqrt @ z)
             else:
                 q = proposal_step(self.rng, x, rind, 0.05 * len(rind))
             lp1 = self.get_lnprior(q)

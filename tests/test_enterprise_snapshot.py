@@ -1,0 +1,78 @@
+"""The real-data ingestion path, exercised hermetically.
+
+The reference's canonical demo drives a *real* NANOGrav pulsar through
+``enterprise.Pulsar`` into the sampler (``clean_demo.ipynb`` cells 3-5).
+enterprise is not installed here, so the committed snapshot
+``tests/data/enterprise_J1713+0747.npz`` records the enterprise attribute
+surface at full structural fidelity (tempo2-style Mmat with DMX windows and
+backend JUMPs, post-fit residuals, per-TOA flag arrays; see
+``tools/make_enterprise_snapshot.py``), and these tests drive it through
+``from_enterprise`` -> ``model_general`` -> both sampler backends — the
+adapter is the code under test, not a stand-in loader.
+"""
+
+import os
+
+import numpy as np
+import pytest
+from scipy import stats
+
+from pulsar_timing_gibbsspec_tpu.data import load_enterprise_snapshot
+from pulsar_timing_gibbsspec_tpu.models.factory import model_general
+from pulsar_timing_gibbsspec_tpu.sampler.blocks import BlockIndex
+from pulsar_timing_gibbsspec_tpu.sampler.gibbs import PulsarBlockGibbs
+
+SNAP = os.path.join(os.path.dirname(__file__), "data",
+                    "enterprise_J1713+0747.npz")
+
+
+@pytest.fixture(scope="module")
+def epsr():
+    return load_enterprise_snapshot(SNAP)
+
+
+def test_adapter_surface(epsr):
+    """from_enterprise carries the recorded tempo2 solution at full
+    fidelity: wide Mmat (incl. DMX + JUMP columns), post-fit residuals,
+    array flags with a scalar 'pta' label."""
+    assert epsr.name == "J1713+0747"
+    n = epsr.ntoa
+    assert epsr.Mmat.shape == (n, 105)
+    assert any(f.startswith("DMX_") for f in epsr.fitpars)
+    assert "JUMP1" in epsr.fitpars
+    # the pta flag is normalized to a scalar label for the factory's
+    # ECORR gate; other flags stay per-TOA arrays
+    assert epsr.flags["pta"] == "NANOGrav"
+    assert epsr.flags["fe"].shape == (n,)
+    assert len(epsr.backends()) == 4          # 2 receivers x 2 backends
+    # post-fit residuals: orthogonal to the fitted column space
+    Mn = epsr.Mmat / np.linalg.norm(epsr.Mmat, axis=0)
+    proj = np.abs(Mn.T @ epsr.residuals) / np.linalg.norm(epsr.residuals)
+    assert proj.max() < 1e-6
+    # full rank after column normalization
+    assert np.linalg.matrix_rank(Mn) == 105
+
+
+def test_snapshot_through_factory_and_samplers(epsr, tmp_path):
+    """clean-demo model on the snapshot (reference cells 5-9): the wide
+    enterprise Mmat is marginalized, NANOGrav pta flag gates ECORR, both
+    backends sample to KS-matched posteriors."""
+    pta = model_general([epsr], tm_svd=True, red_var=False,
+                        white_vary=True, common_psd="spectrum",
+                        common_components=10)
+    # the NANOGrav flag added per-backend ECORR parameters
+    assert any("ecorr" in p for p in pta.param_names)
+    x0 = pta.initial_sample(np.random.default_rng(7))
+    chains = {}
+    for backend, seed in [("jax", 11), ("numpy", 12)]:
+        g = PulsarBlockGibbs(pta, backend=backend, seed=seed, progress=False)
+        chains[backend] = g.sample(x0, outdir=str(tmp_path / backend),
+                                   niter=1200)
+    burn, thin = 200, 5
+    idx = BlockIndex.build(pta.param_names)
+    cols = list(idx.rho) + list(idx.ecorr[:2])
+    pvals = [stats.ks_2samp(chains["jax"][burn::thin, k],
+                            chains["numpy"][burn::thin, k]).pvalue
+             for k in cols]
+    assert min(pvals) > 1e-4, pvals
+    assert np.median(pvals) > 0.05, pvals

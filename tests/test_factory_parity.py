@@ -200,6 +200,34 @@ def test_orf_menu_and_zero_diag():
         orf_matrix("bin_orf", pos)
 
 
+def test_zero_diag_param_orf_builds(psrs8):
+    """zero_diag_bin_orf / zero_diag_legendre_orf BUILD with the
+    reference's fixed-common-amplitude branch (model_definition.py:202-205)
+    — same sampled weight surface as their full counterparts — and only
+    *sampling* loud-rejects (non-PD coefficient prior)."""
+    pta = model_general(psrs8[:3], tm_svd=True, common_psd="powerlaw",
+                        common_components=5, red_var=False,
+                        orf="zero_diag_bin_orf", log10_A_common=-14.5)
+    names = pta.param_names
+    # the 7 angular-bin weights are sampled parameters
+    assert sum("orfw_bin_" in n for n in names) == 7
+    # the common amplitude is pinned (Constant), not sampled
+    assert not any(n.endswith("gw_zero_diag_bin_orf_log10_A")
+                   for n in names)
+    x = pta.initial_sample(np.random.default_rng(0))
+    assert np.all(np.isfinite(x))
+    with pytest.raises(NotImplementedError, match="zero_diag"):
+        compile_pta(pta)
+
+    pta2 = model_general(psrs8[:3], tm_svd=True, common_psd="powerlaw",
+                         common_components=5, red_var=False,
+                         orf="zero_diag_legendre_orf", leg_lmax=3,
+                         log10_A_common=-14.5)
+    assert sum("orfw_leg_" in n for n in pta2.param_names) == 4
+    with pytest.raises(NotImplementedError, match="zero_diag"):
+        compile_pta(pta2)
+
+
 def test_freq_hd_stack():
     rng = np.random.default_rng(3)
     pos = [v / np.linalg.norm(v) for v in rng.standard_normal((4, 3))]

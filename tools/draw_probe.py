@@ -23,7 +23,7 @@ import time
 import numpy as np
 
 sys.path.insert(0, ".")
-from tools.gram_probe import tnt_d_seg  # noqa: E402
+from tools.gram_probe import tnt_d_nseg  # noqa: E402
 
 
 def main():
@@ -82,7 +82,7 @@ def main():
 
     def sigma_build(x1, b1, k1):
         N = cm.ndiag_fast(x1)
-        TNT, d = tnt_d_seg(cm, N, 8)
+        TNT, d = tnt_d_nseg(cm, N, 8)
         phi = cm.phi(x1)
         Sig = TNT + _batched_diag(1.0 / phi)
         diag = jnp.diagonal(Sig, axis1=-2, axis2=-1)
@@ -94,7 +94,7 @@ def main():
 
     def with_chol(x1, b1, k1):
         N = cm.ndiag_fast(x1)
-        TNT, d = tnt_d_seg(cm, N, 8)
+        TNT, d = tnt_d_nseg(cm, N, 8)
         phi = cm.phi(x1)
         Sig = TNT + _batched_diag(1.0 / phi)
         diag = jnp.diagonal(Sig, axis1=-2, axis2=-1)
@@ -107,7 +107,7 @@ def main():
 
     def full_seg_draw(x1, b1, k1):
         N = cm.ndiag_fast(x1)
-        TNT, d = tnt_d_seg(cm, N, 8)
+        TNT, d = tnt_d_nseg(cm, N, 8)
         phi = cm.phi(x1)
         Sig = TNT + _batched_diag(1.0 / phi)
         diag = jnp.diagonal(Sig, axis1=-2, axis2=-1)
@@ -133,7 +133,7 @@ def main():
         cdt = cm.cdtype
         k1a, k2a = jr.split(k1)
         N = cm.ndiag_fast(x1)
-        TNT, d = tnt_d_seg(cm, N, 8)                 # f64 values
+        TNT, d = tnt_d_nseg(cm, N, 8)                 # f64 values
         phi = cm.phi(x1)
         Sig = TNT + _batched_diag(1.0 / phi)         # f64
         diag = jnp.diagonal(Sig, axis1=-2, axis2=-1)

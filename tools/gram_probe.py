@@ -32,8 +32,10 @@ import numpy as np
 sys.path.insert(0, ".")
 
 
-def tnt_d_seg(cm, Nvec, nseg):
-    """Segmented Gram: f32 MXU einsum per segment, f64 segment reduction."""
+def tnt_d_nseg(cm, Nvec, nseg):
+    """Segmented Gram with an explicit segment COUNT (the production
+    jax_backend.tnt_d_seg takes a segment LENGTH instead — keep the
+    names distinct so the probe sweep over nseg is unambiguous)."""
     import jax.numpy as jnp
 
     Ta = jnp.concatenate([jnp.asarray(cm.T),
@@ -103,7 +105,7 @@ def main():
 
     time_gram(jb.tnt_d, "tnt_d f64 (current)")
     for nseg in (4, 8, 16):
-        time_gram(lambda cm_, N, n=nseg: tnt_d_seg(cm_, N, n),
+        time_gram(lambda cm_, N, n=nseg: tnt_d_nseg(cm_, N, n),
                   f"tnt_d_seg f32 nseg={nseg}")
 
     # full exact draw vs segmented draw
@@ -120,7 +122,7 @@ def main():
 
     def draw_seg(x1, k1, nseg=8):
         N = cm.ndiag_fast(x1)
-        TNT, d = tnt_d_seg(cm, N, nseg)
+        TNT, d = tnt_d_nseg(cm, N, nseg)
         phi = cm.phi(x1)
         z = jr.normal(k1, (cm.P, cm.Bmax), cm.cdtype)
         bb, _ = mvn_conditional_draw(TNT, 1.0 / phi, d, z)
@@ -136,7 +138,7 @@ def main():
         TNT0, d0 = jb.tnt_d(cm, N)
         outs = {"f64": (TNT0, d0)}
         for nseg in (4, 8, 16):
-            outs[f"seg{nseg}"] = tnt_d_seg(cm, N, nseg)
+            outs[f"seg{nseg}"] = tnt_d_nseg(cm, N, nseg)
         phi = cm.phi(x1)
         return outs, phi
 
@@ -169,7 +171,7 @@ def main():
     def mean_err(x1, k1):
         N = cm.ndiag_fast(x1)
         TNT0, d0 = jb.tnt_d(cm, N)
-        TNT1, d1 = tnt_d_seg(cm, N, 8)
+        TNT1, d1 = tnt_d_nseg(cm, N, 8)
         phi = cm.phi(x1)
         z = jr.normal(k1, (cm.P, cm.Bmax), cm.cdtype)
         b0, m0 = mvn_conditional_draw(TNT0, 1.0 / phi, d0, z)
@@ -195,7 +197,7 @@ def main():
     @jax.jit
     def mh_logr(x1, b1, k1):
         N = cm.ndiag_fast(x1)
-        TNT1, d1 = tnt_d_seg(cm, N, 8)
+        TNT1, d1 = tnt_d_nseg(cm, N, 8)
         phi = cm.phi(x1)
         Sig = TNT1 + _batched_diag(1.0 / phi)
         diag = jnp.diagonal(Sig, axis1=-2, axis2=-1)

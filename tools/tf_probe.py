@@ -1,0 +1,172 @@
+"""Probe 3: accuracy + speed of tf_chol_factor (two-float MXU factor) on
+the warmed 45-pulsar bench state.
+
+Reports, per chain: max ||Li A Li^T - I||_max over pulsars (the proposal
+covariance error that prices MH acceptance), plus acceptance stats of a
+b-draw proposal factored by tf_chol_factor, and timing vs the f64
+blocked_chol_inv.
+
+Usage: python tools/tf_probe.py [--nchains 32] [--warm 200]
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nchains", type=int, default=32)
+    ap.add_argument("--warm", type=int, default=200)
+    ap.add_argument("--adapt", type=int, default=300)
+    args = ap.parse_args()
+
+    import bench
+    import jax
+    import jax.numpy as jnp
+    import jax.random as jr
+
+    from pulsar_timing_gibbsspec_tpu import profiling
+    from pulsar_timing_gibbsspec_tpu.ops.linalg import (
+        _batched_diag, blocked_chol_inv, tf_chol_factor)
+    from pulsar_timing_gibbsspec_tpu.sampler import jax_backend as jb
+    from pulsar_timing_gibbsspec_tpu.sampler.jax_backend import JaxGibbsDriver
+
+    pta = bench.build_pta(45)
+    x0 = pta.initial_sample(np.random.default_rng(0))
+    drv = JaxGibbsDriver(pta, seed=1, common_rho=True,
+                         white_adapt_iters=args.adapt, chunk_size=50,
+                         nchains=args.nchains)
+    C = drv.C
+    cm = drv.cm
+    cshape, bshape = drv.chain_shapes(args.warm)
+    chain = np.zeros(cshape)
+    bchain = np.zeros(bshape)
+    t0 = time.time()
+    for _ in drv.run(x0, chain, bchain, 0, args.warm):
+        pass
+    print(f"# warmup {args.warm} iters in {time.time()-t0:.1f}s",
+          file=sys.stderr)
+    x = jnp.asarray(np.asarray(drv.x_cur, np.float64), cm.cdtype)
+    b = jnp.asarray(drv.b)
+
+    @jax.jit
+    def build_A(x1):
+        N = cm.ndiag_fast(x1)
+        TNT, d = jb.tnt_d_seg(cm, N)
+        phi = cm.phi(x1)
+        Sig = TNT + _batched_diag(1.0 / phi)
+        diag = jnp.diagonal(Sig, axis1=-2, axis2=-1)
+        dj = 1.0 / jnp.sqrt(diag)
+        A = Sig * dj[:, :, None] * dj[:, None, :]
+        return A, dj, d
+
+    @jax.jit
+    def tf_err(x1):
+        A, dj, d = build_A(x1)
+        L, Li = tf_chol_factor(A)
+        R = jnp.einsum("...ij,...jk,...lk->...il", Li, A, Li)
+        E = R - jnp.eye(cm.Bmax, dtype=A.dtype)
+        # also check L Li ~ I (logq consistency)
+        S = jnp.einsum("...ij,...jk->...ik", L, Li) - jnp.eye(
+            cm.Bmax, dtype=A.dtype)
+        return (jnp.max(jnp.abs(E), axis=(-2, -1)),
+                jnp.max(jnp.abs(S), axis=(-2, -1)))
+
+    for ci in range(min(4, C)):
+        e, s = tf_err(x[ci])
+        e = np.asarray(e)
+        print(f"chain {ci}: ||Li A Li^T - I||_max: max={e.max():.3e} "
+              f"median={np.median(e):.3e}   ||L Li - I||_max: "
+              f"{float(np.asarray(s).max()):.3e}")
+
+    # ---- MH acceptance with tf-factored proposal ------------------------
+    @jax.jit
+    def mh_logr(x1, b1, k1):
+        A, dj, d = build_A(x1)
+        L, Li = tf_chol_factor(A)
+        u = jnp.einsum("...ij,...j->...i", Li, dj * d)
+        mean = dj * jnp.einsum("...ji,...j->...i", Li, u)
+        z = jr.normal(k1, (cm.P, cm.Bmax), cm.cdtype)
+        bp = mean + dj * jnp.einsum("...ji,...j->...i", Li, z)
+        up = jb.b_matvec(cm, bp)
+        u_old = jb.b_matvec(cm, b1)
+        lpi_new = jb._logpi_b_per(cm, x1, bp, up)
+        lpi_old = jb._logpi_b_per(cm, x1, b1, u_old)
+        w_old = jnp.einsum("pji,pj->pi", L, (b1 - mean) / dj)
+        logq_old = -0.5 * jnp.sum(w_old * w_old, axis=1)
+        logq_new = -0.5 * jnp.sum(z * z, axis=1)
+        return (lpi_new - lpi_old) + (logq_old - logq_new)
+
+    accs = []
+    for ci in range(C):
+        lr = np.asarray(mh_logr(x[ci], b[ci], jr.PRNGKey(500 + ci)),
+                        np.float64)
+        accs.append(np.minimum(1.0, np.exp(lr)))
+    accs = np.concatenate(accs)
+    print(f"tf-proposal MH accept: mean={accs.mean():.6f} "
+          f"min={accs.min():.6f} p1={np.percentile(accs, 1):.6f}")
+
+    # ---- timing ---------------------------------------------------------
+    def t_body(single, label):
+        def body(xx, bb, k):
+            return jax.vmap(single)(xx, bb, jr.split(k, C))
+
+        t = profiling._scan_time(body, x, b, 20, 3)
+        print(f"{label:36s} {t*1e3:9.3f} ms  (C={C})")
+
+    def ps(b1, *arrs):
+        s = sum(jnp.sum(a).astype(b1.dtype) for a in arrs)
+        return b1 + 1e-30 * s
+
+    def factor_tf(x1, b1, k1):
+        A, dj, d = build_A(x1)
+        L, Li = tf_chol_factor(A)
+        return x1, ps(b1, Li, L)
+
+    def factor_f64(x1, b1, k1):
+        A, dj, d = build_A(x1)
+        L, Li = blocked_chol_inv(A)
+        return x1, ps(b1, Li, L)
+
+    t_body(factor_tf, "gram_seg + tf_chol_factor")
+    t_body(factor_f64, "gram_seg + blocked_chol_inv f64")
+
+    def full_tf_draw(x1, b1, k1):
+        u1 = jb.b_matvec(cm, b1)
+        A, dj, d = build_A(x1)
+        L, Li = tf_chol_factor(A)
+        w = jnp.einsum("...ij,...j->...i", Li, dj * d)
+        mean = dj * jnp.einsum("...ji,...j->...i", Li, w)
+        z = jr.normal(k1, (cm.P, cm.Bmax), cm.cdtype)
+        bp = mean + dj * jnp.einsum("...ji,...j->...i", Li, z)
+        up = jb.b_matvec(cm, bp)
+        lpi_new = jb._logpi_b_per(cm, x1, bp, up)
+        lpi_old = jb._logpi_b_per(cm, x1, b1, u1)
+        w_old = jnp.einsum("pji,pj->pi", L, (b1 - mean) / dj)
+        logq_old = -0.5 * jnp.sum(w_old * w_old, axis=1)
+        logq_new = -0.5 * jnp.sum(z * z, axis=1)
+        logr = (lpi_new - lpi_old) + (logq_old - logq_new)
+        ok = jnp.all(jnp.isfinite(bp), axis=1) & jnp.isfinite(logr)
+        logu = jnp.log(jr.uniform(k1, (cm.P,), cm.cdtype))
+        acc = ok & (logr > logu)
+        return x1, jnp.where(acc[:, None], bp, b1)
+
+    t_body(full_tf_draw, "full tf-factored MH draw")
+
+    def cur_mh(x1, b1, k1):
+        u1 = jb.b_matvec(cm, b1)
+        bn, un, acc = jb.draw_b_mh(cm, x1, b1, u1, k1)
+        return x1, bn
+
+    t_body(cur_mh, "current draw_b_mh (f32)")
+
+
+if __name__ == "__main__":
+    main()
