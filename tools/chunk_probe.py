@@ -1,0 +1,73 @@
+"""Where does steady-loop wall time go beyond the profiled blocks?
+
+Times, per steady chunk on the real device: the chunk dispatch call
+(fn(...) return), the xs conversion, the b_flat conversion, aux build —
+against the per-block sweep sums.  Usage: python tools/chunk_probe.py
+[--nchains 32] [--chunk 100]
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nchains", type=int, default=32)
+    ap.add_argument("--chunk", type=int, default=100)
+    ap.add_argument("--nchunks", type=int, default=4)
+    args = ap.parse_args()
+
+    import bench
+    import jax.numpy as jnp
+
+    from pulsar_timing_gibbsspec_tpu.sampler.jax_backend import JaxGibbsDriver
+
+    pta = bench.build_pta(45)
+    x0 = pta.initial_sample(np.random.default_rng(0))
+    drv = JaxGibbsDriver(pta, seed=1, common_rho=True,
+                         white_adapt_iters=300, chunk_size=args.chunk,
+                         nchains=args.nchains)
+    niter = 200 + args.chunk * (args.nchunks + 2)
+    cshape, bshape = drv.chain_shapes(niter)
+    chain = np.zeros(cshape)
+    bchain = np.zeros(bshape)
+    it = drv.run(x0, chain, bchain, 0, niter)
+    next(it)   # warmup + adaptation
+
+    # manual steady chunks with fine timing (mirrors run()'s loop body)
+    x = jnp.asarray(drv.x_cur, drv.cm.cdtype)
+    b_dev = jnp.asarray(drv.b)
+    ii = 220    # past warmup rows; absolute iteration index only keys RNG
+    fn = drv._chunk_fn(args.chunk)
+    for rep in range(args.nchunks):
+        t0 = time.time()
+        aux = drv._aux(chain, ii)
+        t1 = time.time()
+        x, b_dev, xs, bs = fn(x, b_dev, drv.key,
+                              jnp.asarray(ii, jnp.int32), aux,
+                              jnp.asarray(args.chunk, jnp.int32))
+        t2 = time.time()
+        xs_h = np.asarray(xs, dtype=np.float64)
+        t3 = time.time()
+        # run_chunk returns bs already flat+f32; mirror _writeback
+        bs_h = np.asarray(bs, np.float64)
+        t4 = time.time()
+        # force x/b to host too (dispatch may return before compute ends)
+        _ = np.asarray(x)[0, 0]
+        t5 = time.time()
+        print(f"chunk {rep}: aux {1e3*(t1-t0):7.1f} ms | dispatch+compute "
+              f"{1e3*(t2-t1):8.1f} ms | xs->host {1e3*(t3-t2):7.1f} ms | "
+              f"b_flat {1e3*(t4-t3):7.1f} ms | sync {1e3*(t5-t4):7.1f} ms "
+              f"| total {1e3*(t5-t0)/args.chunk:6.2f} ms/sweep")
+        ii += args.chunk
+
+
+if __name__ == "__main__":
+    main()
