@@ -202,11 +202,9 @@ def bench_config(orf, n_psr, niter, np_iters, adapt, nchains, profile):
         # the rho_k channels over chains, from this run's own chains;
         # docs/HD_MIXING.md carries the dense-vs-sequential comparison.
         from pulsar_timing_gibbsspec_tpu.ops.acf import integrated_act
-        from pulsar_timing_gibbsspec_tpu.sampler.blocks import BlockIndex
 
         if chain.ndim == 2:
             chain = chain[:, None, :]
-        idx = BlockIndex.build(pta.param_names)
         burn = min(len(chain) // 4, 200)
         acts = [integrated_act(np.ascontiguousarray(chain[burn:, c, k]))
                 for k in idx.rho for c in range(chain.shape[1])]

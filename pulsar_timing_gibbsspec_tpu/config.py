@@ -37,6 +37,16 @@ class Settings:
     #: back to host every chunk; also the checkpoint cadence)
     chunk_size: int = 100
 
+    #: dtype of the recorded per-sweep states shipped device->host:
+    #: "f32" (storage dtype, default) or "bf16" (halves the dominant
+    #: transfer for bandwidth-starved device links).  Rounds the RECORD
+    #: only: carries/checkpoints stay exact and resume is bitwise within
+    #: a run; models with red-MH DE jumps see the rounded rows in the DE
+    #: history, so their realized proposal stream differs from an
+    #: f32-record run at rounding level (stationarity unaffected) — see
+    #: jax_backend.JaxGibbsDriver for the full statement
+    record_precision: str = os.environ.get("PTGIBBS_RECORD", "f32")
+
     #: number of grid points for the numerical rho_k conditional CDF
     #: (reference uses 1000, pulsar_gibbs.py:228)
     rho_grid_size: int = 1000

@@ -1315,7 +1315,7 @@ class JaxGibbsDriver:
                  red_adapt_iters=2000, red_steps=20, chunk_size=None,
                  pad_pulsars=None, mesh=None, warmup_sweeps=50,
                  warmup_white_steps=16, white_steps_max=64, nchains=1,
-                 exact_every=EXACT_EVERY):
+                 exact_every=EXACT_EVERY, record_precision=None):
         settings.apply()
         import jax
         import jax.random as jr
@@ -1335,6 +1335,24 @@ class JaxGibbsDriver:
         self.red_adapt_iters = red_adapt_iters
         self.red_steps = red_steps
         self.chunk_size = chunk_size or settings.chunk_size
+        #: dtype of the recorded per-sweep states shipped device->host.
+        #: "f32" (default) records in the storage dtype; "bf16" halves the
+        #: dominant device-to-host payload again for bandwidth-starved
+        #: links (e.g. a tunneled device) at ~0.4% relative quantization
+        #: of the RECORD only — the sweep carry and checkpoints are exact,
+        #: resume stays bitwise within a run, and the sampled process is
+        #: identical to the f32-record run except that DE-jump history
+        #: (refreshed from recorded chain rows past DE_DELAY) sees the
+        #: rounded rows: the difference proposal stays symmetric, so
+        #: stationarity is untouched while the realized proposal stream
+        #: differs at rounding level.  Tested in
+        #: tests/test_jax_backend.py::test_record_precision_bf16.
+        rp = record_precision or settings.record_precision
+        if rp not in ("f32", "bf16"):
+            raise ValueError(f"record_precision must be 'f32' or 'bf16', "
+                             f"got {rp!r}")
+        import jax.numpy as _jnp
+        self.rdtype = _jnp.bfloat16 if rp == "bf16" else self.cm.dtype
         self.warmup_sweeps = warmup_sweeps
         self.warmup_white_steps = warmup_white_steps
         self.exact_every = int(exact_every)
@@ -1924,9 +1942,16 @@ class JaxGibbsDriver:
             # (nb_total) layout: the pad-column drop happens on device, so
             # the dominant transfer ships only real columns, and the host
             # writeback is a dtype cast instead of a 40 MB fancy gather
-            bs_flat = bs.astype(cm.dtype)[
+            bs_flat = bs.astype(self.rdtype)[
                 :, :, jnp.asarray(self._b_pi), jnp.asarray(self._b_ci)]
-            return x_end, b_end, xs, bs_flat
+            # the x record ships in the record dtype too: at C=64 the f64
+            # (chunk, C, nx) stack is 28.2 MB/chunk — 43% of the b payload
+            # — over the ~18 MB/s tunnel (tools/chunk_probe.py), and the
+            # recorded hyperparameters carry f32 statistical content for
+            # the same reason the b record does.  The carry/resume path
+            # reads x_end (selected from the pre-cast stack above), so
+            # checkpoints and trailing chunks never see the rounding.
+            return x_end, b_end, xs.astype(self.rdtype), bs_flat
 
         return jax.jit(run_chunk)
 

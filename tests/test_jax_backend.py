@@ -730,3 +730,49 @@ def test_draw_b_conditional_accuracy(pta8):
                 np.asarray(Sigma[ii], np.float64)))[:S.shape[0]]
             gwid = g.gwid[ii]
             assert np.max(np.abs(var_j[gwid] / np.diag(Cov)[gwid] - 1)) < 1e-4
+
+
+# ---------------------------------------------------------------------------
+# bf16 record option (transfer diet for bandwidth-starved device links)
+# ---------------------------------------------------------------------------
+
+def test_record_precision_bf16(j1713, tmp_path):
+    """record_precision="bf16" rounds ONLY the record: the sampled process
+    (here white MH + conditionals; no DE history in this model) is bitwise
+    identical to the f32-record run, the recorded chain agrees with the
+    f32 record to bf16 quantization, and resume stays bitwise within a
+    bf16 run."""
+    import ml_dtypes
+
+    pta = model_general([j1713], tm_svd=True, red_var=False,
+                        white_vary=True, common_psd="spectrum",
+                        common_components=5)
+    x0 = pta.initial_sample(np.random.default_rng(5))
+    kw = dict(backend="jax", seed=31, progress=False, white_adapt_iters=100,
+              chunk_size=20, nchains=2)
+    g32 = PulsarBlockGibbs(pta, **kw)
+    c32 = g32.sample(x0, outdir=str(tmp_path / "f32"), niter=100)
+    g16 = PulsarBlockGibbs(pta, record_precision="bf16", **kw)
+    c16 = g16.sample(x0, outdir=str(tmp_path / "bf16"), niter=100)
+
+    # the process itself is unchanged: final carries bitwise equal
+    np.testing.assert_array_equal(g16._backend.x_cur, g32._backend.x_cur)
+    # record agrees to bf16 quantization (exact equality would be broken
+    # by f64->f32->bf16 double rounding on ~2^-16 of entries, so compare
+    # against the bf16 rounding of the f32 record with 1-ulp slack)
+    ref = np.asarray(c32, np.float32).astype(ml_dtypes.bfloat16)
+    got = np.asarray(c16, np.float32).astype(ml_dtypes.bfloat16)
+    close = np.isclose(got.astype(np.float64), ref.astype(np.float64),
+                       rtol=2.0 ** -7, atol=1e-30)
+    assert close.mean() > 0.9999, f"bf16 record disagrees: {1-close.mean():.2e}"
+
+    # resume is bitwise within a bf16 run
+    ga = PulsarBlockGibbs(pta, record_precision="bf16", **kw)
+    ga.sample(x0, outdir=str(tmp_path / "split"), niter=60, save_every=20)
+    gb = PulsarBlockGibbs(pta, record_precision="bf16", **kw)
+    resumed = gb.sample(x0, outdir=str(tmp_path / "split"), niter=100,
+                        resume=True, save_every=20)
+    np.testing.assert_array_equal(resumed, c16)
+
+    with pytest.raises(ValueError, match="record_precision"):
+        PulsarBlockGibbs(pta, record_precision="f16", **kw)

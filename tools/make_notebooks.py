@@ -1,0 +1,360 @@
+"""Author + execute the demo notebooks (the reference ships ``.ipynb``).
+
+The reference's user layer is three notebooks — ``clean_demo.ipynb``,
+``singlepulsar_sim_A2e-15_gamma4.333.ipynb``, ``pta_gibbs_freespec.ipynb``
+— whose flows the ``examples/*.py`` scripts already reproduce.  This tool
+emits the same demos in notebook form with executed outputs committed, so
+a reference user lands on the artifact shape they expect.  Cells are
+authored here (single source of truth), executed on CPU via nbclient, and
+written to ``notebooks/``.
+
+Usage: ``python tools/make_notebooks.py [--no-exec] [--only NAME]``
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+PREAMBLE = '''\
+import os, sys
+# CPU-pinned for hermetic execution; delete this line on a TPU host and
+# the same cells run on the accelerator unchanged.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, "..")
+import numpy as np
+REFDATA = os.environ.get("PTGIBBS_REFDATA", "/root/reference/simulated_data")
+'''
+
+CLEAN_DEMO = [
+    ("md", """\
+# Clean demo — single-pulsar free-spectrum Gibbs run
+
+Notebook form of the reference's `clean_demo.ipynb` (cells 3-9): load a
+pulsar, build the `model_general` free-spectrum model with varying
+per-backend white noise, run the blocked Gibbs sampler, and summarize the
+posterior.  The reference notebook loads a NANOGrav 9-yr pulsar it does
+not ship; the 45-pulsar simulated corpus stands in (point `PTGIBBS_REFDATA`
+elsewhere, or pass an enterprise attribute snapshot through
+`load_enterprise_snapshot` — see `examples/clean_demo.py --npz`)."""),
+    ("code", PREAMBLE),
+    ("md", """\
+**Load the pulsar** (reference cell 3: `Pulsar(par, tim)`), injecting a
+GWB power law so the spectrum has known structure to recover."""),
+    ("code", '''\
+from pulsar_timing_gibbsspec_tpu.data import load_pulsar
+
+psr = load_pulsar(f"{REFDATA}/J1713+0747.par", f"{REFDATA}/J1713+0747.tim",
+                  inject=dict(log10_A=np.log10(2e-15), gamma=13.0 / 3.0,
+                              nmodes=30))
+print(psr.name, f"{len(psr.toas)} TOAs,",
+      f"{psr.Mmat.shape[1]} timing-model columns")'''),
+    ("md", """\
+**Build the model** (reference cell 5): SVD-stabilized timing model,
+varying per-backend EFAC/EQUAD white noise, 10-bin common free spectrum
+— the exact `model_general` kwarg surface of the reference's
+`model_definition.py:18-32`."""),
+    ("code", '''\
+from pulsar_timing_gibbsspec_tpu import model_general
+
+pta = model_general([psr], tm_svd=True, red_var=False, white_vary=True,
+                    common_psd="spectrum", common_components=10)
+for name in pta.param_names:
+    print(name)'''),
+    ("md", """\
+**Run the blocked Gibbs sampler** (reference cells 7-9).  `backend="jax"`
+is the compiled device path — identical code runs on TPU; the `numpy`
+backend is the f64 oracle it is KS-tested against."""),
+    ("code", '''\
+from pulsar_timing_gibbsspec_tpu import PulsarBlockGibbs
+
+NITER = 1500
+gibbs = PulsarBlockGibbs(pta, backend="jax", seed=0)
+x0 = gibbs.initial_sample(np.random.default_rng(0))
+chain = gibbs.sample(x0, outdir="./chains_clean_demo", niter=NITER)
+chain.shape'''),
+    ("md", "**Posterior summary** (reference cell 9's corner-plot data)."),
+    ("code", '''\
+burn = NITER // 5
+print(f"{'parameter':<42s} {'median':>9s} {'16%':>9s} {'84%':>9s}")
+for k, name in enumerate(gibbs.param_names):
+    q16, q50, q84 = np.quantile(chain[burn:, k], [0.16, 0.5, 0.84])
+    print(f"{name:<42s} {q50:9.3f} {q16:9.3f} {q84:9.3f}")'''),
+    ("code", '''\
+import matplotlib
+matplotlib.use("Agg")
+import matplotlib.pyplot as plt
+%matplotlib inline
+
+from pulsar_timing_gibbsspec_tpu.sampler.blocks import BlockIndex
+
+idx = BlockIndex.build(pta.param_names)
+fig, ax = plt.subplots(figsize=(8, 4))
+ax.violinplot([chain[burn:, c] for c in idx.rho],
+              positions=np.arange(len(idx.rho)), widths=0.8,
+              showextrema=False)
+ax.set_xlabel("frequency bin")
+ax.set_ylabel(r"$\\log_{10}\\rho$")
+ax.set_title("common free spectrum, 10 bins (injected A=2e-15, $\\\\gamma$=13/3)")
+fig.tight_layout()'''),
+]
+
+SINGLEPULSAR_SIM = [
+    ("md", """\
+# Single-pulsar injection recovery — A=2e-15, $\\gamma$=13/3
+
+Notebook form of the reference's
+`singlepulsar_sim_A2e-15_gamma4.333.ipynb` (cells 7-16): inject a GWB
+power law into a simulated pulsar, recover the 30-bin free spectrum with
+the Gibbs sampler, and render the reference's headline violin plot
+against the injected line (its cell 16)."""),
+    ("code", PREAMBLE),
+    ("code", '''\
+from pulsar_timing_gibbsspec_tpu.data import load_pulsar
+
+LOG10_A, GAMMA, NMODES = np.log10(2e-15), 13.0 / 3.0, 30
+psr = load_pulsar(f"{REFDATA}/J1713+0747.par", f"{REFDATA}/J1713+0747.tim",
+                  inject=dict(log10_A=LOG10_A, gamma=GAMMA,
+                              nmodes=NMODES, seed=42))
+print(psr.name, len(psr.toas), "TOAs")'''),
+    ("md", """\
+**Model** (reference cell 7): constant EFAC=1 white noise, 30-bin common
+spectrum, SVD timing model."""),
+    ("code", '''\
+from pulsar_timing_gibbsspec_tpu import PulsarBlockGibbs, model_general
+
+pta = model_general([psr], tm_svd=True, red_var=False, white_vary=False,
+                    common_psd="spectrum", common_components=NMODES)
+NITER = 2000
+gibbs = PulsarBlockGibbs(pta, backend="jax", seed=1)
+x0 = gibbs.initial_sample(np.random.default_rng(1))
+chain = gibbs.sample(x0, outdir="./chains_injection", niter=NITER)
+chain.shape'''),
+    ("md", """\
+**Injected line**: per-bin $\\log_{10}\\rho$ from the injected power law
+(the notebook's overlay, cell 16)."""),
+    ("code", '''\
+from pulsar_timing_gibbsspec_tpu.models.psd import powerlaw
+from pulsar_timing_gibbsspec_tpu.sampler.blocks import BlockIndex
+
+sig = next(s for s in pta.model(0).signals if "gw" in s.name)
+f, df = sig.freqs[::2], sig._df[::2]
+inj = 0.5 * np.log10(powerlaw(f, df, log10_A=LOG10_A, gamma=GAMMA))
+
+idx = BlockIndex.build(pta.param_names)
+burn = NITER // 5
+qs = np.quantile(chain[burn:, idx.rho], [0.05, 0.95], axis=0)
+within = np.mean((inj >= qs[0]) & (inj <= qs[1]))
+print(f"injected power law inside the 90% band in {100*within:.0f}% of bins")'''),
+    ("code", '''\
+import matplotlib
+matplotlib.use("Agg")
+import matplotlib.pyplot as plt
+%matplotlib inline
+
+fig, ax = plt.subplots(figsize=(10, 4.5))
+ax.violinplot([chain[burn:, c] for c in idx.rho],
+              positions=np.arange(len(idx.rho)), widths=0.8,
+              showextrema=False)
+ax.plot(np.arange(len(idx.rho)), inj, "k--", lw=1.5,
+        label="injected A=2e-15, $\\\\gamma$=13/3")
+ax.set_xlabel("frequency bin")
+ax.set_ylabel(r"$\\log_{10}\\rho$")
+ax.legend()
+ax.set_title("30-bin free-spectrum recovery (violin = posterior per bin)")
+fig.tight_layout()'''),
+]
+
+PTA_FREESPEC = [
+    ("md", """\
+# PTA free-spectrum validation — Gibbs vs MH autocorrelation
+
+Notebook form of the reference's `pta_gibbs_freespec.ipynb`: a
+multi-pulsar common-spectrum Gibbs run (its cells 10-30), then the
+validation that is the method's selling point (cells 31-39) — the same
+posterior sampled by (a) the blocked Gibbs sampler and (b) adaptive
+random-walk MH on the b-marginalized likelihood (the role PTMCMCSampler
+plays in the reference), compared on per-channel integrated
+autocorrelation time.  The exact conditional $\\rho$ draw decorrelates in
+O(1) sweeps; the random walk takes O(100) steps."""),
+    ("code", PREAMBLE),
+    ("md", """\
+**Multi-pulsar CRN run** (reference cells 10-30): 8 pulsars, common
+free spectrum, uncorrelated across pulsars (the reference sampler's
+case; for sampled Hellings-Downs correlations — beyond the reference —
+see `examples/hd_pta_demo.py`)."""),
+    ("code", '''\
+from pulsar_timing_gibbsspec_tpu import model_general
+from pulsar_timing_gibbsspec_tpu.data import load_directory
+from pulsar_timing_gibbsspec_tpu.sampler.gibbs import PTABlockGibbs
+from pulsar_timing_gibbsspec_tpu.sampler.blocks import BlockIndex
+
+psrs = load_directory(REFDATA,
+                      inject=dict(log10_A=np.log10(2e-15),
+                                  gamma=13.0 / 3.0))[:8]
+pta = model_general(psrs, tm_svd=True, red_var=False, white_vary=False,
+                    common_psd="spectrum", common_components=10)
+NITER = 1000
+pg = PTABlockGibbs(pta, backend="jax", seed=0)
+x0 = pg.initial_sample(np.random.default_rng(0))
+pchain = pg.sample(x0, outdir="./chains_pta_freespec", niter=NITER)
+idx = BlockIndex.build(pta.param_names)
+burn = NITER // 5
+print(f"{'bin':>4s} {'median':>9s} {'16%':>9s} {'84%':>9s}")
+for j, k in enumerate(idx.rho):
+    q16, q50, q84 = np.quantile(pchain[burn:, k], [0.16, 0.5, 0.84])
+    print(f"{j:4d} {q50:9.2f} {q16:9.2f} {q84:9.2f}")'''),
+    ("md", """\
+**The validation** (reference cells 31-39), on a single pulsar so the MH
+chain is cheap: Gibbs and adaptive MH on the identical 10-bin
+free-spectrum posterior."""),
+    ("code", '''\
+from pulsar_timing_gibbsspec_tpu import PulsarBlockGibbs
+from pulsar_timing_gibbsspec_tpu.data import load_pulsar
+from pulsar_timing_gibbsspec_tpu.sampler.numpy_backend import NumpyGibbs
+
+psr = load_pulsar(f"{REFDATA}/J1713+0747.par", f"{REFDATA}/J1713+0747.tim",
+                  inject=dict(log10_A=np.log10(2e-15), gamma=13.0 / 3.0,
+                              nmodes=10))
+pta1 = model_general([psr], tm_svd=True, red_var=False, white_vary=False,
+                     common_psd="spectrum", common_components=10)
+idx1 = BlockIndex.build(pta1.param_names)
+x1 = pta1.initial_sample(np.random.default_rng(0))
+
+G_ITERS = 1500
+gibbs = PulsarBlockGibbs(pta1, backend="numpy", seed=3, progress=False)
+gchain = gibbs.sample(x1, outdir="./chains_act_nb", niter=G_ITERS)
+print("Gibbs done:", gchain.shape)'''),
+    ("code", '''\
+def adaptive_mh(lnpost, x0, niter, rng, adapt_every=200):
+    """Adaptive random-walk MH with the 2.38/sqrt(d) AM scaling — the
+    reference's PTMCMC stand-in."""
+    d = len(x0)
+    x, lp = x0.copy(), lnpost(x0)
+    L = np.linalg.cholesky(np.eye(d) * 0.01 ** 2)
+    chain, acc = np.zeros((niter, d)), 0
+    for ii in range(niter):
+        q = x + (2.38 / np.sqrt(d)) * (L @ rng.standard_normal(d))
+        lq = lnpost(q)
+        if np.log(rng.uniform()) < lq - lp:
+            x, lp, acc = q, lq, acc + 1
+        chain[ii] = x
+        if ii and ii % adapt_every == 0 and ii < niter // 2:
+            try:
+                L = np.linalg.cholesky(np.cov(chain[ii // 2:ii].T)
+                                       + 1e-10 * np.eye(d))
+            except np.linalg.LinAlgError:
+                pass
+    return chain, acc / niter
+
+M_ITERS = 12000
+# lnlike_fullmarg seeds the oracle's Gram cache itself on first call
+# (white noise is fixed here, so the cache stays valid throughout)
+oracle = NumpyGibbs(pta1, seed=4)
+
+def lnpost(x):
+    lp = pta1.get_lnprior(x)
+    if not np.isfinite(lp):
+        return -np.inf
+    # white noise is fixed (white_vary=False) so the cached Gram stays
+    # valid across evaluations; only rho moves, and it enters through phi
+    return oracle.lnlike_fullmarg(x) + lp
+
+mchain, rate = adaptive_mh(lnpost, x1, M_ITERS, np.random.default_rng(5))
+print(f"MH acceptance rate: {rate:.2f}")'''),
+    ("md", """\
+**Per-channel integrated autocorrelation times** (the reference's cell-39
+plot as a table + ACF figure)."""),
+    ("code", '''\
+from pulsar_timing_gibbsspec_tpu.ops.acf import integrated_act
+
+gb, mb = gchain[G_ITERS // 5:], mchain[M_ITERS // 5:]
+print(f"{'rho bin':>8s} {'Gibbs ACT':>10s} {'MH ACT':>10s} {'ratio':>7s}")
+ratios = []
+for j, k in enumerate(idx1.rho):
+    ga, ma = integrated_act(gb[:, k]), integrated_act(mb[:, k])
+    ratios.append(ma / ga)
+    print(f"{j:8d} {ga:10.1f} {ma:10.1f} {ma/ga:7.1f}")
+print(f"\\nmedian ACT ratio (MH/Gibbs): {np.median(ratios):.1f}x")'''),
+    ("code", '''\
+import matplotlib
+matplotlib.use("Agg")
+import matplotlib.pyplot as plt
+%matplotlib inline
+
+def acf(x, nlag):
+    x = x - x.mean()
+    c = np.correlate(x, x, "full")[len(x) - 1:][:nlag]
+    return c / c[0]
+
+k = idx1.rho[3]
+fig, ax = plt.subplots(figsize=(8, 4))
+ax.plot(acf(gb[:, k], 120), label="blocked Gibbs (exact conditional)")
+ax.plot(acf(mb[:, k], 120), label="adaptive random-walk MH")
+ax.axhline(0, color="k", lw=0.5)
+ax.set_xlabel("lag (iterations)")
+ax.set_ylabel("autocorrelation")
+ax.set_title(r"$\\rho_3$ chain autocorrelation — why blocked Gibbs")
+ax.legend()
+fig.tight_layout()'''),
+]
+
+NOTEBOOKS = {
+    "clean_demo": CLEAN_DEMO,
+    "singlepulsar_sim_A2e-15_gamma4.333": SINGLEPULSAR_SIM,
+    "pta_gibbs_freespec": PTA_FREESPEC,
+}
+
+
+def build(cells):
+    import nbformat
+
+    nb = nbformat.v4.new_notebook()
+    nb.metadata = {
+        "kernelspec": {"display_name": "Python 3", "language": "python",
+                       "name": "python3"},
+        "language_info": {"name": "python"},
+    }
+    for i, (kind, src) in enumerate(cells):
+        cell = (nbformat.v4.new_markdown_cell(src) if kind == "md"
+                else nbformat.v4.new_code_cell(src))
+        # nbformat's random cell ids would churn the diff on every
+        # regeneration; deterministic ids keep the artifact stable
+        cell["id"] = f"cell-{i}"
+        nb.cells.append(cell)
+    return nb
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--no-exec", action="store_true",
+                    help="write unexecuted notebooks (fast; no outputs)")
+    ap.add_argument("--only", default=None, choices=list(NOTEBOOKS),
+                    help="one notebook name")
+    args = ap.parse_args()
+
+    import nbformat
+
+    outdir = os.path.join(REPO, "notebooks")
+    os.makedirs(outdir, exist_ok=True)
+    names = [args.only] if args.only else list(NOTEBOOKS)
+    for name in names:
+        nb = build(NOTEBOOKS[name])
+        path = os.path.join(outdir, f"{name}.ipynb")
+        if not args.no_exec:
+            from nbclient import NotebookClient
+
+            print(f"executing {name} ...", flush=True)
+            client = NotebookClient(
+                nb, timeout=3600, kernel_name="python3",
+                resources={"metadata": {"path": outdir}})
+            client.execute()
+        nbformat.write(nb, path)
+        print(f"wrote {path}")
+
+
+if __name__ == "__main__":
+    sys.exit(main())
