@@ -257,10 +257,13 @@ def main(argv=None):
     if args.orf == "hd":
         # the sequential cross-pulsar conditional sweep is heavier per
         # sweep; fewer iterations and chains keep the wall-clock (and the
-        # compiled program) in check
+        # compiled program) in check.  HD chains peak at C=32 (measured
+        # r4: C=16 -> 169, C=32 -> 247, C=64 -> 120 samples/s; the CRN
+        # path, whose knee was the tunnel writeback, keeps scaling to 64
+        # — the HD knee's cause is untraced)
         hd = bench_config("hd", n_psr, max(100, niter // 4),
                           max(5, np_iters // 4), adapt,
-                          nchains if args.nchains else min(nchains, 16),
+                          nchains if args.nchains else min(nchains, 32),
                           profile=False)
     elif args.orf == "both":
         # own interpreter: the big correlated-ORF program has crashed the
@@ -269,11 +272,11 @@ def main(argv=None):
         import subprocess
 
         # honor an explicit --nchains verbatim; only the default is
-        # capped for the heavier HD program
+        # capped for the heavier HD program (C=32 knee, see above)
         cmd = [sys.executable, os.path.abspath(__file__), "--orf", "hd",
                "--niter", str(niter), "--numpy-iters", str(np_iters),
                "--nchains", str(nchains if args.nchains
-                                else min(nchains, 16)), "--no-profile"]
+                                else min(nchains, 32)), "--no-profile"]
         if args.quick:
             cmd.append("--quick")
         try:

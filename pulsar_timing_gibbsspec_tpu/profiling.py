@@ -204,6 +204,32 @@ def profile_blocks(driver, x, repeats=5, inner=50):
 
         out["b_refresh"] = _scan_time(vm(refresh1), x, b, inner, repeats)
 
+        # the every-sweep Metropolised draw and its N-axis-heavy core (the
+        # f32 Gram einsum): how much of full_sweep rides the padded TOA
+        # axis decides whether TOA-bucketing the hot einsums pays
+        def bmh1(x1, b1, k1):
+            u1 = jb.b_matvec(cm, b1)
+            bn, _, _ = jb.draw_b_mh(cm, x1, b1, u1, k1)
+            return x1, bn
+
+        out["b_mh"] = _scan_time(vm(bmh1), x, b, inner, repeats)
+
+        def gram1(x1, b1, k1):
+            N = cm.ndiag_fast(x1)
+            TN = cm.T / N[:, :, None]
+            TNT = jnp.einsum("pnb,pnc->pbc", TN, cm.T,
+                             preferred_element_type=cm.dtype,
+                             precision="highest")
+            return x1, b1 + 0.0 * TNT[:, : b1.shape[1], 0]
+
+        out["gram32"] = _scan_time(vm(gram1), x, b, inner, repeats)
+
+        def rsq1(x1, b1, k1):
+            r2 = jb.residual_sq(cm, b1)
+            return x1 + 0.0 * r2[0, 0], b1
+
+        out["residual_sq"] = _scan_time(vm(rsq1), x, b, inner, repeats)
+
     # the composed sweep, timed the same way (this is what the chunked
     # driver actually runs; t=1 exercises the Metropolised-b-draw branch),
     # plus the per-dispatch overhead for context

@@ -275,8 +275,7 @@ def lnlike_fullmarg_fn(cm: CompiledPTA, x, TNT, d):
     over pulsars; pads contribute exactly zero."""
     import jax.numpy as jnp
 
-    from ..ops.linalg import _batched_diag, precond_cholesky, precond_logdet, \
-        precond_solve
+    from ..ops.linalg import _batched_diag, jacobi_factor_mean
 
     N = cm.ndiag(x)
     phi = cm.phi(x)
@@ -288,9 +287,13 @@ def lnlike_fullmarg_fn(cm: CompiledPTA, x, TNT, d):
             cm, x, N, ke_rz(cm, N, jnp.asarray(cm.y))))
     logdet_phi = jnp.sum(jnp.log(phi), axis=-1)
     Sigma = TNT + _batched_diag(1.0 / phi)
-    L, dj = precond_cholesky(Sigma)
-    expval = precond_solve(L, dj, d)
-    logdet_sigma = precond_logdet(L, dj)
+    # matmul-scheduled factorization (same arithmetic as the native f64
+    # cholesky, which XLA lowers near-serially on TPU — see
+    # blocked_chol_inv); solves become matvecs with the explicit inverse
+    L, _, dj, expval = jacobi_factor_mean(Sigma, d)
+    logdet_sigma = (2.0 * jnp.sum(
+        jnp.log(jnp.diagonal(L, axis1=-2, axis2=-1)), axis=-1)
+        - 2.0 * jnp.sum(jnp.log(dj), axis=-1))
     return out + 0.5 * jnp.sum(
         jnp.sum(d * expval, axis=-1) - logdet_sigma - logdet_phi)
 
@@ -1184,7 +1187,7 @@ def draw_b_mh(cm: CompiledPTA, x, b, u, key):
     import jax.numpy as jnp
     import jax.random as jr
 
-    from ..ops.linalg import precond_cholesky, precond_sample, precond_solve
+    from ..ops.linalg import jacobi_factor_mean
 
     fdt = cm.dtype
     k1, k2 = jr.split(key)
@@ -1201,10 +1204,16 @@ def draw_b_mh(cm: CompiledPTA, x, b, u, key):
     phi32 = cm.phi(x, dtype=fdt)
     eye = jnp.eye(cm.Bmax, dtype=fdt)
     Sig = TNT + (1.0 / phi32)[:, :, None] * eye
-    L, dj = precond_cholesky(Sig, ridge=_PROP_RIDGE)
-    mean = precond_solve(L, dj, d)
+    # matmul-scheduled factorization with the explicit inverse: XLA's
+    # native batched cholesky + triangular solves lower to sequential
+    # small-slice loops on TPU and cost 12.6 ms at the (64, 45, 37, 37)
+    # bench shape vs 2.1 ms for blocked_chol_inv + matvecs
+    # (tools/chol_probe.py) — 75% of the whole steady sweep was this
+    # lowering (tools/sweep_probe.py: b_mh 13.5 ms of full_sweep 17.9)
+    L, Li, dj, mean = jacobi_factor_mean(Sig, d, ridge=_PROP_RIDGE)
     z = jr.normal(k1, (cm.P, cm.Bmax), fdt)
-    bp32 = precond_sample(L, dj, mean, z)
+    bp32 = mean + dj * jnp.einsum("pji,pj->pi", Li, z,
+                                  precision="highest")
     bp = bp32.astype(cm.cdtype)
     up = b_matvec(cm, bp)
     # ---- exact log-density ratio + proposal correction --------------------
@@ -1251,7 +1260,8 @@ def draw_b_refresh(cm: CompiledPTA, x, b, u, key):
     import jax.numpy as jnp
     import jax.random as jr
 
-    from ..ops.linalg import _batched_diag, tf_chol_factor
+    from ..ops.linalg import (_batched_diag, jacobi_factor_mean,
+                              tf_chol_factor)
 
     cdt = cm.cdtype
     k1, k2 = jr.split(key)
@@ -1259,12 +1269,11 @@ def draw_b_refresh(cm: CompiledPTA, x, b, u, key):
     TNT, d = tnt_d_seg(cm, N)
     phi = cm.phi(x)
     Sig = TNT + _batched_diag(1.0 / phi)
-    diag = jnp.diagonal(Sig, axis1=-2, axis2=-1)
-    dj = 1.0 / jnp.sqrt(diag)
-    A = Sig * dj[:, :, None] * dj[:, None, :]
-    L, Li = tf_chol_factor(A, ridge=_PROP_RIDGE)
-    w = jnp.einsum("...ij,...j->...i", Li, dj * d)
-    mean = dj * jnp.einsum("...ji,...j->...i", Li, w)
+    # tf_chol_factor applies _PROP_RIDGE to its f32 stage only and
+    # removes the distortion in the two-float correction — so the ridge
+    # rides the factor, not the helper
+    L, Li, dj, mean = jacobi_factor_mean(
+        Sig, d, factor=lambda A: tf_chol_factor(A, ridge=_PROP_RIDGE))
     z = jr.normal(k1, (cm.P, cm.Bmax), cdt)
     bp = mean + dj * jnp.einsum("...ji,...j->...i", Li, z)
     up = b_matvec(cm, bp)

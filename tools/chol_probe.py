@@ -1,0 +1,91 @@
+"""A/B the b_mh proposal's factor path on the real device.
+
+(a) XLA native ``jnp.linalg.cholesky`` + 3 ``solve_triangular`` (the
+    current ``precond_cholesky``/``precond_solve``/``precond_sample``), vs
+(b) matmul-scheduled ``blocked_chol_inv`` in f32 + explicit-inverse
+    matvecs,
+
+at the bench shape (C, P, B, B).  Decides whether the 13.5 ms ``b_mh``
+block (75% of the steady sweep at C=64, tools/sweep_probe.py) is the
+native small-batch factorization lowering.
+
+Usage: python tools/chol_probe.py [--nchains 64] [--B 37]
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nchains", type=int, default=64)
+    ap.add_argument("--P", type=int, default=45)
+    ap.add_argument("--B", type=int, default=37)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import jax.random as jr
+
+    from pulsar_timing_gibbsspec_tpu.ops.linalg import (blocked_chol_inv,
+                                                        precond_cholesky,
+                                                        precond_sample,
+                                                        precond_solve)
+    from pulsar_timing_gibbsspec_tpu.profiling import _scan_time
+
+    C, P, B = args.nchains, args.P, args.B
+    rng = np.random.default_rng(0)
+    M = rng.standard_normal((C, P, B, B))
+    A = np.einsum("cpij,cpkj->cpik", M, M) + 10.0 * np.eye(B)
+    A = jnp.asarray(A, jnp.float32)
+    d = jnp.asarray(rng.standard_normal((C, P, B)), jnp.float32)
+
+    # _scan_time wants body(x, b, key) -> (x, b); thread the data through b
+    def native(x, b, key):
+        L, dj = precond_cholesky(A + x * jnp.eye(B, dtype=jnp.float32))
+        mean = precond_solve(L, dj, d)
+        z = jr.normal(key, d.shape, jnp.float32)
+        s = precond_sample(L, dj, mean, z)
+        return x + 0.0 * s[0, 0, 0], b
+
+    def blocked(x, b, key):
+        Ax = A + x * jnp.eye(B, dtype=jnp.float32)
+        diag = jnp.diagonal(Ax, axis1=-2, axis2=-1)
+        dj = 1.0 / jnp.sqrt(diag)
+        An = Ax * dj[..., :, None] * dj[..., None, :]
+        L, Li = blocked_chol_inv(An)
+        w = jnp.einsum("...ij,...j->...i", Li, dj * d)
+        mean = dj * jnp.einsum("...ji,...j->...i", Li, w)
+        z = jr.normal(key, d.shape, jnp.float32)
+        s = mean + dj * jnp.einsum("...ji,...j->...i", Li, z)
+        return x + 0.0 * s[0, 0, 0], b
+
+    x = jnp.zeros((), jnp.float32)
+    b = jnp.zeros((), jnp.float32)
+    t_native = _scan_time(native, x, b, 20, 3)
+    t_blocked = _scan_time(blocked, x, b, 20, 3)
+    print(f"native cholesky+solves: {t_native*1e3:7.2f} ms")
+    print(f"blocked_chol_inv path:  {t_blocked*1e3:7.2f} ms")
+
+    # accuracy cross-check of the blocked f32 factor against native
+    L, dj = precond_cholesky(A)
+    mean_n = precond_solve(L, dj, d)
+    diag = jnp.diagonal(A, axis1=-2, axis2=-1)
+    djb = 1.0 / jnp.sqrt(diag)
+    An = A * djb[..., :, None] * djb[..., None, :]
+    Lb, Lib = blocked_chol_inv(An)
+    w = jnp.einsum("...ij,...j->...i", Lib, djb * d)
+    mean_b = djb * jnp.einsum("...ji,...j->...i", Lib, w)
+    rel = float(jnp.max(jnp.abs(mean_b - mean_n))
+                / (jnp.max(jnp.abs(mean_n)) + 1e-30))
+    print(f"max |mean_blocked - mean_native| / max|mean|: {rel:.2e}")
+
+
+if __name__ == "__main__":
+    main()

@@ -229,26 +229,9 @@ gibbs = PulsarBlockGibbs(pta1, backend="numpy", seed=3, progress=False)
 gchain = gibbs.sample(x1, outdir="./chains_act_nb", niter=G_ITERS)
 print("Gibbs done:", gchain.shape)'''),
     ("code", '''\
-def adaptive_mh(lnpost, x0, niter, rng, adapt_every=200):
-    """Adaptive random-walk MH with the 2.38/sqrt(d) AM scaling — the
-    reference's PTMCMC stand-in."""
-    d = len(x0)
-    x, lp = x0.copy(), lnpost(x0)
-    L = np.linalg.cholesky(np.eye(d) * 0.01 ** 2)
-    chain, acc = np.zeros((niter, d)), 0
-    for ii in range(niter):
-        q = x + (2.38 / np.sqrt(d)) * (L @ rng.standard_normal(d))
-        lq = lnpost(q)
-        if np.log(rng.uniform()) < lq - lp:
-            x, lp, acc = q, lq, acc + 1
-        chain[ii] = x
-        if ii and ii % adapt_every == 0 and ii < niter // 2:
-            try:
-                L = np.linalg.cholesky(np.cov(chain[ii // 2:ii].T)
-                                       + 1e-10 * np.eye(d))
-            except np.linalg.LinAlgError:
-                pass
-    return chain, acc / niter
+# the adaptive random-walk MH (2.38/sqrt(d) AM scaling — the reference's
+# PTMCMC stand-in) lives in the example script; one source of truth
+from examples.gibbs_vs_mh_act import adaptive_mh
 
 M_ITERS = 12000
 # lnlike_fullmarg seeds the oracle's Gram cache itself on first call

@@ -1,0 +1,45 @@
+"""Per-block sweep profile without the full bench: warm up the 45-pulsar
+CRN driver, then run profiling.profile_blocks at the requested chain width.
+
+Usage: python tools/sweep_probe.py [--nchains 64] [--niter 250]
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nchains", type=int, default=64)
+    ap.add_argument("--niter", type=int, default=250)
+    ap.add_argument("--adapt", type=int, default=300)
+    args = ap.parse_args()
+
+    import bench
+
+    from pulsar_timing_gibbsspec_tpu import profiling
+    from pulsar_timing_gibbsspec_tpu.sampler.jax_backend import JaxGibbsDriver
+
+    pta = bench.build_pta(45)
+    x0 = pta.initial_sample(np.random.default_rng(0))
+    drv = JaxGibbsDriver(pta, seed=1, common_rho=True,
+                         white_adapt_iters=args.adapt, chunk_size=100,
+                         nchains=args.nchains)
+    cshape, bshape = drv.chain_shapes(args.niter)
+    chain = np.zeros(cshape)
+    bchain = np.zeros(bshape)
+    for _ in drv.run(x0, chain, bchain, 0, args.niter):
+        pass
+    times = profiling.profile_blocks(drv, drv.x_cur, repeats=3, inner=20)
+    for k, v in sorted(times.items(), key=lambda kv: -kv[1]):
+        print(f"  {k:<16s} {v*1e3:8.2f} ms")
+
+
+if __name__ == "__main__":
+    main()
