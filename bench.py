@@ -93,14 +93,15 @@ def _raw_marks(marks):
     }
 
 
-def bench_jax(pta, x0, niter, adapt_iters, nchains, profile=False):
+def bench_jax(pta, x0, niter, adapt_iters, nchains, profile=False,
+              record="f32"):
     from pulsar_timing_gibbsspec_tpu.sampler.jax_backend import JaxGibbsDriver
 
     # >= ~8 post-compile chunk marks so the three windows are real
     chunk = max(10, min(100, niter // 8))
     drv = JaxGibbsDriver(pta, seed=1, common_rho=True,
                          white_adapt_iters=adapt_iters, chunk_size=chunk,
-                         nchains=nchains)
+                         nchains=nchains, record_precision=record)
     C = drv.C
     cshape, bshape = drv.chain_shapes(niter)
     chain = np.zeros(cshape)
@@ -162,7 +163,8 @@ def _retry_transport(fn):
     raise last
 
 
-def bench_config(orf, n_psr, niter, np_iters, adapt, nchains, profile):
+def bench_config(orf, n_psr, niter, np_iters, adapt, nchains, profile,
+                 record="f32"):
     from pulsar_timing_gibbsspec_tpu import profiling
     from pulsar_timing_gibbsspec_tpu.sampler.numpy_pta import NumpyPTAGibbs
 
@@ -176,7 +178,8 @@ def bench_config(orf, n_psr, niter, np_iters, adapt, nchains, profile):
         if len(idx.orf):
             x0[idx.orf] = 0.0
     jax_rate, windows, C, drv, prof, raw, chain = _retry_transport(
-        lambda: bench_jax(pta, x0, niter, adapt, nchains, profile=profile))
+        lambda: bench_jax(pta, x0, niter, adapt, nchains, profile=profile,
+                          record=record))
     g = NumpyPTAGibbs(pta, seed=2, white_adapt_iters=adapt)
     np_rate, np_windows, np_raw = bench_numpy(
         g, np.asarray(x0, np.float64), np_iters)
@@ -228,6 +231,11 @@ def main(argv=None):
     ap.add_argument("--profile", action="store_true",
                     help="deprecated (profile is on by default); kept so "
                     "older invocations still parse")
+    ap.add_argument("--record", choices=["f32", "bf16"], default="f32",
+                    help="dtype of the recorded chain shipped device->host "
+                    "(driver default f32; bf16 is the opt-in transfer diet "
+                    "for bandwidth-starved links — the JSON labels the "
+                    "mode so numbers are never silently mixed)")
     args = ap.parse_args(argv)
 
     import jax
@@ -253,7 +261,7 @@ def main(argv=None):
     crn = hd = None
     if args.orf in ("both", "crn"):
         crn = bench_config("crn", n_psr, niter, np_iters, adapt, nchains,
-                           profile)
+                           profile, record=args.record)
     if args.orf == "hd":
         # the sequential cross-pulsar conditional sweep is heavier per
         # sweep; fewer iterations and chains keep the wall-clock (and the
@@ -264,7 +272,7 @@ def main(argv=None):
         hd = bench_config("hd", n_psr, max(100, niter // 4),
                           max(5, np_iters // 4), adapt,
                           nchains if args.nchains else min(nchains, 32),
-                          profile=False)
+                          profile=False, record=args.record)
     elif args.orf == "both":
         # own interpreter: the big correlated-ORF program has crashed the
         # tunneled TPU worker before, and a worker crash kills the whole
@@ -276,7 +284,8 @@ def main(argv=None):
         cmd = [sys.executable, os.path.abspath(__file__), "--orf", "hd",
                "--niter", str(niter), "--numpy-iters", str(np_iters),
                "--nchains", str(nchains if args.nchains
-                                else min(nchains, 32)), "--no-profile"]
+                                else min(nchains, 32)), "--no-profile",
+               "--record", args.record]
         if args.quick:
             cmd.append("--quick")
         try:
@@ -300,6 +309,7 @@ def main(argv=None):
         "unit": "samples/s",
         "vs_baseline": head["vs_oracle"],
         "device_kind": jax.devices()[0].device_kind,
+        "record_precision": args.record,
         **{k: head[k] for k in ("sweeps_per_sec", "rate_windows", "nchains",
                                 "numpy_sweeps_per_sec",
                                 "numpy_rate_windows", "mfu", "raw",

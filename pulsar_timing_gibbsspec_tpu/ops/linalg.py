@@ -107,13 +107,9 @@ def mvn_conditional_draw(TNT, phiinv, d, z):
     Batched over leading dims; returns ``(b, mean)``.
     """
     Sigma = TNT + _batched_diag(phiinv)
-    diag = jnp.diagonal(Sigma, axis1=-2, axis2=-1)
-    dj = 1.0 / jnp.sqrt(diag)
-    A = Sigma * dj[..., :, None] * dj[..., None, :]
-    _, Li = blocked_chol_inv(A)
-    u = jnp.einsum("...ij,...j->...i", Li, dj * d)
-    mean = dj * jnp.einsum("...ji,...j->...i", Li, u)
-    samp = mean + dj * jnp.einsum("...ji,...j->...i", Li, z)
+    _, Li, dj, mean = jacobi_factor_mean(Sigma, d)
+    samp = mean + dj * jnp.einsum("...ji,...j->...i", Li, z,
+                                  precision="highest")
     return samp, mean
 
 

@@ -275,7 +275,8 @@ def lnlike_fullmarg_fn(cm: CompiledPTA, x, TNT, d):
     over pulsars; pads contribute exactly zero."""
     import jax.numpy as jnp
 
-    from ..ops.linalg import _batched_diag, jacobi_factor_mean
+    from ..ops.linalg import (_batched_diag, jacobi_factor_mean,
+                              precond_logdet)
 
     N = cm.ndiag(x)
     phi = cm.phi(x)
@@ -291,9 +292,7 @@ def lnlike_fullmarg_fn(cm: CompiledPTA, x, TNT, d):
     # cholesky, which XLA lowers near-serially on TPU — see
     # blocked_chol_inv); solves become matvecs with the explicit inverse
     L, _, dj, expval = jacobi_factor_mean(Sigma, d)
-    logdet_sigma = (2.0 * jnp.sum(
-        jnp.log(jnp.diagonal(L, axis1=-2, axis2=-1)), axis=-1)
-        - 2.0 * jnp.sum(jnp.log(dj), axis=-1))
+    logdet_sigma = precond_logdet(L, dj)
     return out + 0.5 * jnp.sum(
         jnp.sum(d * expval, axis=-1) - logdet_sigma - logdet_phi)
 

@@ -37,8 +37,51 @@ def main():
     for _ in drv.run(x0, chain, bchain, 0, args.niter):
         pass
     times = profiling.profile_blocks(drv, drv.x_cur, repeats=3, inner=20)
+
+    # refresh internals: which of the segmented Gram / two-float factor /
+    # log-density pieces carries draw_b_refresh's cost
+    import jax
+    import jax.numpy as jnp
+    import jax.random as jr
+
+    from pulsar_timing_gibbsspec_tpu.ops.linalg import (_batched_diag,
+                                                        jacobi_factor_mean,
+                                                        tf_chol_factor)
+    from pulsar_timing_gibbsspec_tpu.profiling import _scan_time
+    from pulsar_timing_gibbsspec_tpu.sampler import jax_backend as jb
+
+    cm = drv.cm
+    C = drv.C
+    x = jnp.asarray(drv.x_cur, cm.cdtype)
+    b = jnp.asarray(drv.b)
+
+    def vm(single):
+        def body(x, b, k):
+            return jax.vmap(single)(x, b, jr.split(k, C))
+        return body
+
+    def seg1(x1, b1, k1):
+        TNT, d = jb.tnt_d_seg(cm, cm.ndiag_fast(x1))
+        return x1, b1 + 0.0 * TNT[:, : b1.shape[1], 0].astype(b1.dtype)
+
+    def tf1(x1, b1, k1):
+        TNT, d = jb.tnt_d_seg(cm, cm.ndiag_fast(x1))
+        Sig = TNT + _batched_diag(1.0 / cm.phi(x1))
+        L, Li, dj, mean = jacobi_factor_mean(
+            Sig, d, factor=lambda A: tf_chol_factor(A))
+        return x1, b1 + 0.0 * mean.astype(b1.dtype)
+
+    def lp1(x1, b1, k1):
+        u1 = jb.b_matvec(cm, b1)
+        lp = jb._logpi_b_per(cm, x1, b1, u1)
+        return x1 + 0.0 * lp[0], b1
+
+    times["refresh:tnt_d_seg"] = _scan_time(vm(seg1), x, b, 20, 3)
+    times["refresh:seg+tf_factor"] = _scan_time(vm(tf1), x, b, 20, 3)
+    times["refresh:logpi+matvec"] = _scan_time(vm(lp1), x, b, 20, 3)
+
     for k, v in sorted(times.items(), key=lambda kv: -kv[1]):
-        print(f"  {k:<16s} {v*1e3:8.2f} ms")
+        print(f"  {k:<22s} {v*1e3:8.2f} ms")
 
 
 if __name__ == "__main__":
