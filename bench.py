@@ -15,7 +15,7 @@ the in-repo float64 NumPy oracle (reference semantics, single CPU, one
 chain) measured on the same model in the same process; the north-star
 target is >= 20x.
 
-Measurement: the steady phase is split into three equal windows and the
+Measurement: the steady phase is split into five equal windows and the
 per-window rates are reported (``rate_windows``); the headline uses the
 *median* window so one tunnel hiccup can neither inflate nor sink the
 number (the TPU tunnel shows ~3x run-to-run variance).  The artifact also
@@ -97,7 +97,7 @@ def bench_jax(pta, x0, niter, adapt_iters, nchains, profile=False,
               record="f32"):
     from pulsar_timing_gibbsspec_tpu.sampler.jax_backend import JaxGibbsDriver
 
-    # >= ~8 post-compile chunk marks so the three windows are real
+    # >= ~8 post-compile chunk marks so the five windows are real
     chunk = max(10, min(100, niter // 8))
     drv = JaxGibbsDriver(pta, seed=1, common_rho=True,
                          white_adapt_iters=adapt_iters, chunk_size=chunk,
@@ -249,12 +249,14 @@ def main(argv=None):
     # steady loop and found ~half the wall time was the (chunk, C, P,
     # Bmax) f64 b-record's device-to-host transfer over the ~18 MB/s
     # tunnel (42.6 MB/chunk at C=32), which scales linearly with C and
-    # saturated the link while the chip idled.  After casting the
-    # recorded b to its f32 storage dtype on device (halving the
-    # payload) and replacing the periodic 148.7 ms f64 exact draw with
-    # the 27 ms two-float Metropolised refresh, the knee moved:
-    # C=32 -> 982, C=64 -> 1542 samples/s (median-of-5 windows,
-    # BENCH raw marks carry the per-window times)
+    # saturated the link while the chip idled.  After the transfer diet
+    # (f32 records for both x and b, pad columns dropped on device) and
+    # the compute work (two-float refresh replacing the 148.7 ms f64
+    # exact draw; blocked matmul factorization replacing XLA's native
+    # batched cholesky in the per-sweep draw, tools/chol_probe.py),
+    # C=64 measures 56 sweeps/s = 3592 samples/s when the tunnel
+    # cooperates — at that point the f32 record transfer is again the
+    # binding constraint (~52 MB/chunk; --record bf16 halves it)
     nchains = args.nchains or (4 if args.quick else 64)
     profile = not args.no_profile
 

@@ -1115,10 +1115,14 @@ def lnlike_orf_fn(cm: CompiledPTA, b):
 #: a pulsar's coefficients unmoved (driver kwarg ``exact_every``;
 #: stationarity is exact at ANY period — the Hastings accept corrects
 #: both proposals — so the period trades only worst-case stickiness
-#: against the refresh cost, measured ~27 ms vs the ~11 ms every-sweep
-#: body at C=32 on one v5e chip; the pure-f64 draw this slot used to run
-#: cost 148.7 ms)
-EXACT_EVERY = 8
+#: against the refresh cost, ~45 ms at C=64 vs the ~10 ms every-sweep
+#: body; the pure-f64 draw this slot used to run cost 148.7 ms).  The
+#: period was MEASURED, not argued: per-coordinate chain ACT over every
+#: hyperparameter channel and every recorded b coefficient is flat
+#: across exact_every in {4, 8, 16} on the 45-pulsar bench model
+#: (docs/EXACT_EVERY.md, tools/exact_every_probe.py), so the default
+#: takes the cheaper end
+EXACT_EVERY = 16
 #: correlated-ORF arrays up to this many total coefficients use the
 #: dense joint b-draw (best mixing: one exact draw of everything);
 #: larger arrays use the sequential pulsar-wise conditional sweep —

@@ -68,7 +68,8 @@ def main():
         TNT, d = jb.tnt_d_seg(cm, cm.ndiag_fast(x1))
         Sig = TNT + _batched_diag(1.0 / cm.phi(x1))
         L, Li, dj, mean = jacobi_factor_mean(
-            Sig, d, factor=lambda A: tf_chol_factor(A))
+            Sig, d, factor=lambda A: tf_chol_factor(
+                A, ridge=jb._PROP_RIDGE))
         return x1, b1 + 0.0 * mean.astype(b1.dtype)
 
     def lp1(x1, b1, k1):
