@@ -268,9 +268,14 @@ def main(argv=None):
         # the sequential cross-pulsar conditional sweep is heavier per
         # sweep; fewer iterations and chains keep the wall-clock (and the
         # compiled program) in check.  HD chains peak at C=32 (measured
-        # r4: C=16 -> 169, C=32 -> 247, C=64 -> 120 samples/s; the CRN
-        # path, whose knee was the tunnel writeback, keeps scaling to 64
-        # — the HD knee's cause is untraced)
+        # r4: C=16 -> 169, C=32 -> 247, C=64 -> 120 samples/s).  Traced
+        # (tools/sweep_probe.py --orf hd): the whole sweep is the
+        # sequential cross-pulsar b-draw, and its device time jumps
+        # 119 -> 529 ms from C=32 to C=64 — per-chain cost DOUBLES.
+        # Not HBM capacity (compiled temp 1.5 -> 2.3 GB of 16); the
+        # per-step (C, B, B) two-float working set crossing VMEM-friendly
+        # tiling past C~32 is the consistent explanation.  The CRN path,
+        # whose knee was the tunnel writeback, keeps scaling to 64.
         hd = bench_config("hd", n_psr, max(100, niter // 4),
                           max(5, np_iters // 4), adapt,
                           nchains if args.nchains else min(nchains, 32),

@@ -1,7 +1,11 @@
 """Per-block sweep profile without the full bench: warm up the 45-pulsar
-CRN driver, then run profiling.profile_blocks at the requested chain width.
+driver, then run profiling.profile_blocks at the requested chain width.
+``--orf hd`` profiles the correlated-ORF sweep (the sequential
+cross-pulsar b-draw) instead of the CRN-only blocks — the entry point
+behind the HD chain-width knee trace in docs/HD_MIXING.md.
 
 Usage: python tools/sweep_probe.py [--nchains 64] [--niter 250]
+                                   [--orf {crn,hd,...}]
 """
 
 from __future__ import annotations
@@ -19,6 +23,9 @@ def main():
     ap.add_argument("--nchains", type=int, default=64)
     ap.add_argument("--niter", type=int, default=250)
     ap.add_argument("--adapt", type=int, default=300)
+    ap.add_argument("--orf", default="crn",
+                    help="crn | hd | ... — hd profiles the sequential "
+                    "cross-pulsar draw instead of the CRN-only blocks")
     args = ap.parse_args()
 
     import bench
@@ -26,8 +33,13 @@ def main():
     from pulsar_timing_gibbsspec_tpu import profiling
     from pulsar_timing_gibbsspec_tpu.sampler.jax_backend import JaxGibbsDriver
 
-    pta = bench.build_pta(45)
+    pta = bench.build_pta(45, orf=args.orf)
     x0 = pta.initial_sample(np.random.default_rng(0))
+    if args.orf != "crn":
+        from pulsar_timing_gibbsspec_tpu.sampler.blocks import BlockIndex
+        ix = BlockIndex.build(pta.param_names)
+        if len(ix.orf):
+            x0[ix.orf] = 0.0
     drv = JaxGibbsDriver(pta, seed=1, common_rho=True,
                          white_adapt_iters=args.adapt, chunk_size=100,
                          nchains=args.nchains)
@@ -38,6 +50,14 @@ def main():
         pass
     times = profiling.profile_blocks(drv, drv.x_cur, repeats=3, inner=20)
 
+    if args.orf == "crn":
+        _crn_refresh_internals(drv, times)
+
+    for k, v in sorted(times.items(), key=lambda kv: -kv[1]):
+        print(f"  {k:<22s} {v*1e3:8.2f} ms")
+
+
+def _crn_refresh_internals(drv, times):
     # refresh internals: which of the segmented Gram / two-float factor /
     # log-density pieces carries draw_b_refresh's cost
     import jax
@@ -80,9 +100,6 @@ def main():
     times["refresh:tnt_d_seg"] = _scan_time(vm(seg1), x, b, 20, 3)
     times["refresh:seg+tf_factor"] = _scan_time(vm(tf1), x, b, 20, 3)
     times["refresh:logpi+matvec"] = _scan_time(vm(lp1), x, b, 20, 3)
-
-    for k, v in sorted(times.items(), key=lambda kv: -kv[1]):
-        print(f"  {k:<22s} {v*1e3:8.2f} ms")
 
 
 if __name__ == "__main__":
