@@ -2138,7 +2138,14 @@ class JaxGibbsDriver:
                 xs, bs = xs[:n], bs[:n]
             if pending is not None:
                 # start both host copies in flight together before the
-                # blocking conversions (the b-record is the big payload)
+                # blocking conversions (the b-record is the big payload).
+                # Measured A/B (r4): issuing copy_to_host_async EARLIER —
+                # right at dispatch, on the not-yet-computed arrays — cut
+                # throughput 52 -> 34 sweeps/s under an identical tunnel:
+                # on this backend an async copy enqueued behind in-flight
+                # compute serializes the next chunk's execution against
+                # the previous transfer.  Keep the copies here, one
+                # iteration after dispatch, where the arrays are ready.
                 for arr in (pending[2], pending[3]):
                     try:
                         arr.copy_to_host_async()
