@@ -1119,9 +1119,11 @@ def lnlike_orf_fn(cm: CompiledPTA, b):
 #: body; the pure-f64 draw this slot used to run cost 148.7 ms).  The
 #: period was MEASURED, not argued: per-coordinate chain ACT over every
 #: hyperparameter channel and every recorded b coefficient is flat
-#: across exact_every in {4, 8, 16} on the 45-pulsar bench model
-#: (docs/EXACT_EVERY.md, tools/exact_every_probe.py), so the default
-#: takes the cheaper end
+#: across exact_every in {4, 8, 16, 32} on the 45-pulsar bench model
+#: (docs/EXACT_EVERY.md, tools/exact_every_probe.py); the default takes
+#: 16 — half the refresh cost of 8, with the 32 row showing a further
+#: halving still costs nothing at typical states (16 keeps margin for
+#: the rare ill-conditioned states the refresh exists to bound)
 EXACT_EVERY = 16
 #: correlated-ORF arrays up to this many total coefficients use the
 #: dense joint b-draw (best mixing: one exact draw of everything);
