@@ -34,6 +34,10 @@ class ParFile:
     values: dict          # key -> float value (numeric entries only)
     fitted: list          # keys flagged for fitting ("1" in the fit column)
     raw: dict             # key -> list of raw string fields
+    #: JUMP lines, one token list each (tempo2 allows many JUMP entries;
+    #: a dict keyed by "JUMP" would keep only the last) — flag form
+    #: ``-flag value offset [fit]`` or MJD form ``MJD t1 t2 offset [fit]``
+    jumps: list = dataclasses.field(default_factory=list)
 
     def __getitem__(self, key):
         return self.values[key]
@@ -58,7 +62,7 @@ def parse_par(path) -> ParFile:
     sexagesimal values are converted to radians; ELONG/ELAT degrees to
     radians.
     """
-    values, fitted, raw = {}, [], {}
+    values, fitted, raw, jumps = {}, [], {}, []
     name = Path(path).stem
     for line in Path(path).read_text().splitlines():
         toks = line.split()
@@ -66,6 +70,11 @@ def parse_par(path) -> ParFile:
             continue
         key = toks[0].upper()
         raw[key] = toks[1:]
+        if key == "JUMP" and len(toks) > 1:
+            # repeated lines, non-numeric second field — collected whole
+            # for design_matrix (flag-selected / MJD-windowed offsets)
+            jumps.append(toks[1:])
+            continue
         if key in ("PSRJ", "PSRB", "PSR") and len(toks) > 1:
             name = toks[1]
             continue
@@ -84,7 +93,8 @@ def parse_par(path) -> ParFile:
         # fit flag: a bare "1" in column 3 (not an uncertainty like "1.5e-3")
         if len(toks) >= 3 and toks[2] == "1":
             fitted.append(key)
-    return ParFile(name=name, values=values, fitted=fitted, raw=raw)
+    return ParFile(name=name, values=values, fitted=fitted, raw=raw,
+                   jumps=jumps)
 
 
 def _sexagesimal_to_rad(tok: str, hours: bool) -> float:

@@ -146,8 +146,13 @@ def rho_log_pdf_grid(tau, other, grid):
     """log conditional density of one pulsar's free-spectrum contribution on
     the rho grid: ``r - e^r`` with ``r = log tau - log(other + rho)``
     (reference ``pulsar_gibbs.py:229-230``)."""
-    logratio = (np.log(tau)[:, None]
-                - np.logaddexp(np.log(other)[:, None], np.log(grid)[None, :]))
+    # tau = 0 (a zeroed coefficient pair) is a legal input whose density
+    # limit is exp(-inf) = 0: take log(0) = -inf silently rather than
+    # warning through every oracle grid draw
+    with np.errstate(divide="ignore"):
+        logratio = (np.log(tau)[:, None]
+                    - np.logaddexp(np.log(other)[:, None],
+                                   np.log(grid)[None, :]))
     return logratio - np.exp(logratio)
 
 

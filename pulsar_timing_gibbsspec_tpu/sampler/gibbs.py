@@ -105,6 +105,10 @@ class _GibbsBase:
         else:
             cshape = (niter, npar)
             bshape = (niter, self._backend.nb_total)
+        # with record_every=k > 1 (jax backend) the chain files hold the
+        # thinned record — fewer rows than niter sweeps
+        total_rows = cshape[0]
+        rec_k = int(getattr(self._backend, "record_every", 1))
         chain = np.zeros(cshape)
         bchain = np.zeros(bshape)
         start = 0
@@ -113,7 +117,7 @@ class _GibbsBase:
             got = store.load_resume()
             if got is not None:
                 prev_c, prev_b, upto, adapt = got
-                upto = min(upto, niter)
+                upto = min(upto, total_rows)
                 if prev_c.shape[1:] != chain.shape[1:]:
                     raise RuntimeError(
                         f"{outdir}: cannot resume — saved chain rows have "
@@ -139,17 +143,28 @@ class _GibbsBase:
         t0 = time.time()
         iterator = self._backend.run(x, chain, bchain, start, niter)
         last_saved = start
+        # save_every is in SWEEPS (the reference's unit); yields count
+        # recorded rows, so the row-space interval shrinks by k — the
+        # crash-loss window must not silently stretch with thinning
+        save_rows = max(1, save_every // rec_k)
         for upto in iterator:
-            if upto - last_saved >= save_every or upto >= niter:
+            if upto - last_saved >= save_rows or upto >= total_rows:
                 store.save(chain, bchain, upto,
                            adapt_state=self._backend.adapt_state())
                 el = time.time() - t0
                 done = upto - start
-                rate = done / el if el > 0 else float("nan")
+                # yields count recorded ROWS; each row is record_every
+                # sweeps, so the sweep rate scales back up by k
+                rate = done * rec_k / el if el > 0 else float("nan")
+                # "iter" stays in sweep units (comparable to niter); the
+                # jax backend tracks the exact counter under thinning
+                it_s = int(getattr(self._backend, "_it_cur", upto))
                 store.log_metrics({
-                    "iter": int(upto), "niter": int(niter),
+                    "iter": it_s, "niter": int(niter),
+                    "rows": int(upto) if rec_k > 1 else None,
                     "elapsed_s": round(el, 3),
                     "sweeps_per_s": round(rate, 3),
+                    "record_every": rec_k if rec_k > 1 else None,
                     "backend": self.backend_name,
                     "nchains": int(getattr(self._backend, "C", 1)),
                     "aclength_white": getattr(
@@ -159,12 +174,12 @@ class _GibbsBase:
                 })
                 last_saved = upto
                 if self.progress:
-                    print(f"\r[{self.backend_name}] {upto}/{niter} sweeps "
-                          f"({rate:.1f}/s)", end="", flush=True)
+                    print(f"\r[{self.backend_name}] {upto}/{total_rows} "
+                          f"rows ({rate:.1f} sweeps/s)", end="", flush=True)
         if self.progress:
             print()
         if hdf5:
-            store.export_hdf5(chain, bchain, niter,
+            store.export_hdf5(chain, bchain, total_rows,
                               extra_attrs={"backend": self.backend_name})
         self.chain = chain
         self.bchain = bchain
@@ -205,10 +220,25 @@ class PTABlockGibbs(_GibbsBase):
                               **opts)
 
 
+def _reject_jax_only_opts(opts):
+    """Targeted error for device-record options reaching the f64 oracle:
+    the numpy backends record every sweep at full precision by design, so
+    a silent accept would misrepresent what was run and a bare TypeError
+    would not name the option."""
+    for opt in ("record_precision", "record_every"):
+        if opt in opts:
+            raise ValueError(
+                f"{opt!r} is a jax-backend option (it controls the "
+                "device->host record transfer); the numpy oracle backend "
+                "records every sweep in float64 — drop the option or use "
+                "backend='jax'")
+
+
 class _NumpySingleDriver:
     """Adapter: NumpyGibbs sweeps -> the facade's run/adapt-state protocol."""
 
     def __init__(self, pta, hypersample, ecorrsample, redsample, seed, opts):
+        _reject_jax_only_opts(opts)
         self.g = NumpyGibbs(pta, hypersample=hypersample,
                             ecorrsample=ecorrsample, redsample=redsample,
                             seed=seed, **opts)
@@ -239,6 +269,7 @@ class _NumpyPTADriver:
     def __init__(self, pta, hypersample, ecorrsample, redsample, seed, opts):
         from .numpy_pta import NumpyPTAGibbs
 
+        _reject_jax_only_opts(opts)
         self.g = NumpyPTAGibbs(pta, hypersample=hypersample,
                                ecorrsample=ecorrsample,
                                redsample=redsample, seed=seed, **opts)

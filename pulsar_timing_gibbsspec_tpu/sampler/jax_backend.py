@@ -350,18 +350,28 @@ def draw_b_hd_sequential(cm: CompiledPTA, x, b, key):
     diag(pinv_p with (G^-1)_pp/rho on the gw columns)`` depends only on
     ``x`` — never on the other pulsars' coefficients, which enter only
     the linear term.  So all P factorizations run as ONE batched
-    matmul-scheduled blocked Cholesky before the scan (the same fast
-    kernel as the CRN b-draw), and the sequential scan is left with
-    gathers + three (Bmax,Bmax) matvecs per step.  On one v5e at
-    nchains=8 this cuts the 45-pulsar HD b-draw from ~174 ms (per-step
-    f64 factorizations inside the scan) to the batched-factor cost plus
-    a latency-bound scan.
+    two-float MXU factorization before the scan (``tf_chol_factor``, the
+    CRN refresh's proposal kernel; see the inline note on its accepted
+    O(1e-5) congruence error).
+
+    The scan itself carries *no* (Bmax, Bmax) work (r5; the r4
+    chain-width knee).  The step-p draw is ``dj (Li^T (Li (dj (d_p -
+    scatter(cross_p))) + z_p))``; only the scatter term depends on the
+    other pulsars, so it splits into a per-sweep constant ``base_p``
+    (batched matvecs before the scan) minus ``Corr_p @ cross_p`` where
+    ``Corr_p = dj ⊙ (Li^T Li)[:, gw cols] ⊙ dj[gw cols]`` is a
+    (Bmax, 2K) slice of the conditional covariance — one batched
+    (B, B) @ (B, 2K) matmul before the scan.  Each scan step is then a
+    (K, P) einsum for ``cross`` plus one (Bmax, 2K) matvec: the r4 trace
+    (119 -> 529 ms per b-draw from C=32 to C=64, the per-step (C, B, B)
+    f64 working set crossing VMEM tiling) collapses to a latency-bound
+    scan, and the chain axis keeps scaling past 32.
     """
     import jax
     import jax.numpy as jnp
     import jax.random as jr
 
-    from ..ops.linalg import blocked_chol_inv
+    from ..ops.linalg import tf_chol_factor, tf_mm
 
     cdt = cm.cdtype
     B, P, K = cm.Bmax, cm.P, cm.K
@@ -391,38 +401,67 @@ def draw_b_hd_sequential(cm: CompiledPTA, x, b, key):
     diag = jnp.diagonal(Sigma, axis1=-2, axis2=-1)
     dj = 1.0 / jnp.sqrt(diag)                      # (P, B)
     A = Sigma * dj[:, :, None] * dj[:, None, :]
-    _, Li = blocked_chol_inv(A)                    # (P, B, B)
+    # two-float MXU factorization (r5): the f64 blocked factor is the
+    # sweep floor at these widths (the CRN exact draw's same-shape
+    # factorization measures ~400 ms at C=64), while tf_chol_factor's
+    # congruence error ||Li A Li^T - I|| ~ B*eps_f32 ~ 8e-6 is
+    # condition-INDEPENDENT — the same kernel the CRN refresh uses as a
+    # Metropolised proposal with measured acceptance 0.9999, i.e. the
+    # draw it produces is statistically indistinguishable from the exact
+    # conditional at the 1e-4 level per draw.  Unlike CRN there is no
+    # Hastings correction here, so the stationary law carries that
+    # O(1e-5)-relative covariance perturbation; the same accepted-error
+    # class as the un-Metropolised segmented Gram above (KS-validated
+    # against the f64 oracle in tests/test_jax_backend.py).
+    _, Li = tf_chol_factor(A)                      # (P, B, B)
     kz, kp = jr.split(key)
     z = jr.normal(kz, (P, B), cdt)
 
-    def gather_a(b):
-        """(P, K, 2) GW coefficients from the padded b array."""
-        a_s = jnp.take_along_axis(b, gsin, axis=1)
-        a_c = jnp.take_along_axis(b, gcos, axis=1)
-        return jnp.stack([a_s, a_c], axis=-1)
+    # hoist ALL (B, B) work out of the scan (see docstring): the step-p
+    # draw is base_p - Corr_p @ cross_p with
+    #   base_p = dj (Li^T (Li (dj d_p) + z_p))          (per-sweep const)
+    #   Corr_p = dj ⊙ (Li^T Li)[:, cols_p] ⊙ dj[cols_p]  (B, 2K)
+    # cols = [sin cols, cos cols]; out-of-range pad indices (the scatter's
+    # old mode="drop") become zeroed Corr columns instead of clamped reads
+    w = jnp.einsum("pij,pj->pi", Li, dj * d, precision="highest")
+    base = dj * jnp.einsum("pji,pj->pi", Li, w + z, precision="highest")
+    cols = jnp.concatenate([gsin, gcos], axis=1)   # (P, 2K)
+    valid = ((cols >= 0) & (cols < B)).astype(cdt)  # (P, 2K)
+    ccl = jnp.clip(cols, 0, B - 1)
+    djc = jnp.take_along_axis(dj, ccl, axis=1) * valid
+    Lic = jnp.take_along_axis(
+        Li, ccl[:, None, :], axis=2) * djc[:, None, :]          # (P, B, 2K)
+    Corr = dj[:, :, None] * tf_mm(
+        jnp.swapaxes(Li, -1, -2), Lic)                          # (P, B, 2K)
 
-    def step(b, p):
-        a = gather_a(b) * live_mask[:, None, None]
+    def gather_a(brow, p):
+        """(K, 2) GW coefficients of one pulsar row of the padded b."""
+        return jnp.stack([brow[gsin[p]], brow[gcos[p]]], axis=-1)
+
+    a0_s = jnp.take_along_axis(b, gsin, axis=1)
+    a0_c = jnp.take_along_axis(b, gcos, axis=1)
+    a0 = jnp.stack([a0_s, a0_c], axis=-1) * live_mask[:, None, None]
+
+    def step(carry, p):
+        b, a = carry                               # (P, B), (P, K, 2)
         g_row = Ginv[:, p, :]                      # (K, P)
         gpp = Ginv[:, p, p]                        # (K,)
         cross = (jnp.einsum("kq,qkf->kf", g_row, a)
                  - gpp[:, None] * a[p]) / rho[:, None]   # (K, 2)
-        d_p = d[p]
-        d_p = d_p.at[gsin[p]].add(-cross[:, 0], mode="drop")
-        d_p = d_p.at[gcos[p]].add(-cross[:, 1], mode="drop")
-        u = Li[p] @ (dj[p] * d_p)
-        mean = dj[p] * (Li[p].T @ u)
-        bp = mean + dj[p] * (Li[p].T @ z[p])
+        cvec = jnp.concatenate([cross[:, 0], cross[:, 1]])       # (2K,)
+        bp = base[p] - Corr[p] @ cvec
         # pad pulsars keep their inert coords; real rows update
-        b = b.at[p].set(jnp.where(live_mask[p] > 0, bp, b[p]))
-        return b, None
+        bnew = jnp.where(live_mask[p] > 0, bp, b[p])
+        b = b.at[p].set(bnew)
+        a = a.at[p].set(gather_a(bnew, p) * live_mask[p])
+        return (b, a), None
 
     # random update order per sweep: a fixed scan order makes the "last"
     # pulsars condition on fresher neighbors every sweep while the first
     # pulsars always move against stale state — permuting symmetrizes the
     # information flow across sweeps (random-scan Gibbs, still exact) and
     # measurably improves rho_k mixing (docs/HD_MIXING.md)
-    b, _ = jax.lax.scan(step, b, jr.permutation(kp, P))
+    (b, _), _ = jax.lax.scan(step, (b, a0), jr.permutation(kp, P))
     return b
 
 
@@ -995,8 +1034,13 @@ def rho_update(cm: CompiledPTA, x, b, key):
                     - jnp.logaddexp(lother[:, :, None],
                                     jnp.log(grid)[None, None, :]))
         logpdf = logratio - jnp.exp(logratio)
-        logpdf = jnp.sum(jnp.asarray(cm.psr_mask, fdt)[:, None, None]
-                         * logpdf, axis=0)
+        # mask by WHERE, not multiply: a pad pulsar with an exactly-zero
+        # coefficient pair has log tau = -inf, and 0 * -inf = NaN would
+        # silently send every rho_k to the grid floor (argmax of a NaN
+        # row is index 0) — a finite chain no _check_finite can flag
+        logpdf = jnp.sum(jnp.where(
+            jnp.asarray(cm.psr_mask, fdt)[:, None, None] > 0,
+            logpdf, jnp.zeros((), fdt)), axis=0)
         gum = jr.gumbel(key, logpdf.shape, dtype=fdt)
         rhonew = grid[jnp.argmax(logpdf + gum, axis=-1)]
     return x.at[cm.rho_ix_x].set(
@@ -1329,7 +1373,8 @@ class JaxGibbsDriver:
                  red_adapt_iters=2000, red_steps=20, chunk_size=None,
                  pad_pulsars=None, mesh=None, warmup_sweeps=50,
                  warmup_white_steps=16, white_steps_max=64, nchains=1,
-                 exact_every=EXACT_EVERY, record_precision=None):
+                 exact_every=EXACT_EVERY, record_precision=None,
+                 record_every=1):
         settings.apply()
         import jax
         import jax.random as jr
@@ -1366,7 +1411,35 @@ class JaxGibbsDriver:
             raise ValueError(f"record_precision must be 'f32' or 'bf16', "
                              f"got {rp!r}")
         import jax.numpy as _jnp
-        self.rdtype = _jnp.bfloat16 if rp == "bf16" else self.cm.dtype
+        # "f32" means float32 storage — also under settings.precision=
+        # "f64" validation runs (it previously aliased cm.dtype and
+        # silently recorded f64 there).  Both record dtypes share f32's
+        # exponent range, so an f64 state beyond ~3.4e38 would record as
+        # inf and trip _check_finite — chain states never approach that
+        # (priors bound the hypers; b coefficients are O(residual))
+        self.rdtype = _jnp.bfloat16 if rp == "bf16" else _jnp.float32
+        #: on-device record thinning: ship every k-th sweep's state to the
+        #: host (reference records every iteration, pulsar_gibbs.py:658-659;
+        #: k=1 default keeps that).  The SAMPLED PROCESS is identical for
+        #: every k — per-sweep keys are pure in the iteration index and the
+        #: full-precision carry never passes through the record — only the
+        #: recorded rows (and so chain.npy's length) change.  The binding
+        #: constraint it relieves is the device->host record transfer
+        #: (~52 MB/chunk f32 at C=64 over the bench's ~18 MB/s tunnel —
+        #: tools/chunk_probe.py); with measured b-ACT medians ~2 sweeps
+        #: (docs/EXACT_EVERY.md), k up to ~ACT keeps the chain's ESS while
+        #: cutting the dominant payload by k.
+        self.record_every = int(record_every)
+        if self.record_every < 1:
+            raise ValueError("record_every must be >= 1")
+        if self.chunk_size % self.record_every:
+            # thinning offsets are static in the compiled chunk; a stride
+            # that does not divide the chunk would cycle through
+            # record_every distinct offsets — record_every fresh ~30 s
+            # compiles — instead of reusing one
+            raise ValueError(
+                f"record_every={self.record_every} must divide "
+                f"chunk_size={self.chunk_size}")
         self.warmup_sweeps = warmup_sweeps
         self.warmup_white_steps = warmup_white_steps
         self.exact_every = int(exact_every)
@@ -1402,6 +1475,16 @@ class JaxGibbsDriver:
                                    and bool(np.any(np.asarray(cm.red_rho_ix_x)
                                                    < cm.nx)))
         self.do_red_mh = len(cm.idx.red) > 0
+        if self.do_red_mh and self.record_every > 1:
+            # the DE jump history is refreshed from recorded chain rows
+            # addressed BY ITERATION INDEX (_de_hist_for); a thinned chain
+            # no longer carries those rows, and silently decimating the
+            # history would change the realized proposal stream with the
+            # thinning setting — loud-reject instead
+            raise ValueError(
+                "record_every > 1 is unavailable for models with a "
+                "red-hyper MH block: the DE jump history reads recorded "
+                "chain rows by iteration index; run with record_every=1")
         if self.do_red_mh and self.chunk_size > DE_DELAY - DE_Q:
             # a larger chunk could outrun the DE history delay (rows not
             # yet written at dispatch), and a silent seed-freeze fallback
@@ -1881,7 +1964,7 @@ class JaxGibbsDriver:
 
         return body
 
-    def _make_chunk(self, body, n):
+    def _make_chunk(self, body, n, rec_off=0):
         """Jitted scan of ``n`` sweeps, the single-chain ``body`` vmapped
         over the chains axis.
 
@@ -1952,11 +2035,22 @@ class JaxGibbsDriver:
                 n_keep >= n,
                 lambda: (x, b),
                 lambda: (row(xs), row(bs)))
+            # on-device record thinning: the transfer ships rows for
+            # iterations it0 + rec_off + j*record_every only.  run() picks
+            # rec_off so the recorded iterations satisfy it ≡ it_base
+            # (mod record_every) in ABSOLUTE iteration index — the set is
+            # then independent of the chunk grid, so checkpoints, resumes
+            # and chain extensions record the same iterations a single
+            # uninterrupted run would.  The full per-sweep stack still
+            # exists on device for the n_keep carry selection above, so
+            # thinning cannot touch the resumed process.
+            xs_rec = xs[rec_off::self.record_every]
+            bs_rec = bs[rec_off::self.record_every]
             # the recorded b goes to host already in the reference's flat
             # (nb_total) layout: the pad-column drop happens on device, so
             # the dominant transfer ships only real columns, and the host
             # writeback is a dtype cast instead of a 40 MB fancy gather
-            bs_flat = bs.astype(self.rdtype)[
+            bs_flat = bs_rec.astype(self.rdtype)[
                 :, :, jnp.asarray(self._b_pi), jnp.asarray(self._b_ci)]
             # the x record ships in the record dtype too: at C=64 the f64
             # (chunk, C, nx) stack is 28.2 MB/chunk — 43% of the b payload
@@ -1965,7 +2059,7 @@ class JaxGibbsDriver:
             # the same reason the b record does.  The carry/resume path
             # reads x_end (selected from the pre-cast stack above), so
             # checkpoints and trailing chunks never see the rounding.
-            return x_end, b_end, xs.astype(self.rdtype), bs_flat
+            return x_end, b_end, xs_rec.astype(self.rdtype), bs_flat
 
         return jax.jit(run_chunk)
 
@@ -1975,8 +2069,8 @@ class JaxGibbsDriver:
                 self._warmup_body(), n)
         return self._sweep_fns[("warmup", n)]
 
-    def _chunk_fn(self, n):
-        if n not in self._sweep_fns:
+    def _chunk_fn(self, n, rec_off=0):
+        if (n, rec_off) not in self._sweep_fns:
             if self.cm.orf_name != "crn" or self.cm.has_ke:
                 # correlated ORF: both bdraw variants reduce to the joint
                 # draw — a body pair would trace the large joint program
@@ -1986,8 +2080,9 @@ class JaxGibbsDriver:
                 bodies = self._sweep_body("exact")
             else:
                 bodies = (self._sweep_body("mh"), self._sweep_body("exact"))
-            self._sweep_fns[n] = self._make_chunk(bodies, n)
-        return self._sweep_fns[n]
+            self._sweep_fns[(n, rec_off)] = self._make_chunk(bodies, n,
+                                                             rec_off)
+        return self._sweep_fns[(n, rec_off)]
 
     # ---- facade protocol ----------------------------------------------------
 
@@ -1995,14 +2090,39 @@ class JaxGibbsDriver:
         """(..., P, Bmax) -> (..., nb_total) reference layout."""
         return np.asarray(b_arr, dtype=np.float64)[..., self._b_pi, self._b_ci]
 
+    def _rows_of(self, n):
+        """Recorded rows an offset-0 chunk of ``n`` sweeps ships."""
+        k = self.record_every
+        return (n + k - 1) // k
+
+    def _it_base(self, niter):
+        """First steady-loop iteration — the residue anchor of the thinned
+        record: steady rows hold iterations ≡ it_base (mod record_every),
+        independent of the chunk grid."""
+        W = min(self.warmup_sweeps, max(0, niter - 1))
+        if W > 0:
+            return W + 1
+        return 1 if niter <= 1 else 2
+
+    def _row_layout(self, niter):
+        """Total recorded rows of an ``niter``-sweep run: thinned warmup
+        rows + the post-warmup carry row + one row per recorded steady
+        iteration; equals ``niter`` at record_every=1."""
+        W = min(self.warmup_sweeps, max(0, niter - 1))
+        base = self._rows_of(W) + 1 if W > 0 else (1 if niter <= 1 else 2)
+        it0 = self._it_base(niter)
+        return base + max(0, -(-(niter - it0) // self.record_every))
+
     def chain_shapes(self, niter):
         """(chain_shape, bchain_shape) the run() writeback expects — the
         chains axis appears only for nchains > 1 so single-chain files keep
         the reference's 2-d layout.  The facade and bench allocate through
-        this so the layout lives in one place."""
+        this so the layout lives in one place.  With ``record_every=k > 1``
+        the row count is the thinned record length, not ``niter``."""
+        rows = self._row_layout(niter)
         if self.C == 1:
-            return (niter, self.cm.nx), (niter, self.nb_total)
-        return (niter, self.C, self.cm.nx), (niter, self.C, self.nb_total)
+            return (rows, self.cm.nx), (rows, self.nb_total)
+        return (rows, self.C, self.cm.nx), (rows, self.C, self.nb_total)
 
     def _squeeze(self, arr):
         """Drop the chains axis for nchains=1 so chain files keep the
@@ -2081,14 +2201,16 @@ class JaxGibbsDriver:
                 self._check_finite(xs_h, 0, "warmup state")
                 bs_h = self._squeeze(np.asarray(bs, np.float64))
                 self._check_finite(bs_h, 0, "warmup b coefficients")
-                chain[0:W] = xs_h
-                bchain[0:W] = bs_h
+                wr = self._rows_of(W)          # thinned warmup row count
+                chain[0:wr] = xs_h
+                bchain[0:wr] = bs_h
             else:
                 chain[0] = self._squeeze(np.asarray(
                     x, dtype=np.float64)[None])[0]
                 bchain[0] = self._squeeze(self._b_flat(self.b)[None])[0]
                 W = 0 if niter <= 1 else 1
-            row = max(W, 0)
+                wr = W
+            row = max(wr, 0)
             x_h = self._squeeze(np.asarray(x, dtype=np.float64)[None])
             b_h = self._squeeze(self._b_flat(self.b)[None])
             # the final warmup carry is not in xs (the scan records
@@ -2098,9 +2220,25 @@ class JaxGibbsDriver:
             chain[row if W else 0] = x_h[0]
             bchain[row if W else 0] = b_h[0]
             x = self._first_sweep(x)
-            ii = row + 1 if W else 1
+            ii = W + 1 if W else 1             # iterations consumed
+            rowc = row + 1 if W else 1         # host rows written
             self.x_cur = np.asarray(x, dtype=np.float64)
-            yield ii
+            self._it_cur = ii
+            yield rowc
+        else:
+            # resuming mid-run: ``start`` counts recorded ROWS; under
+            # thinning the iteration counter diverges from it and must be
+            # restored from the checkpoint (written as ``it_cur``)
+            rowc = start
+            if self.record_every > 1:
+                it = getattr(self, "_resume_it", None)
+                if it is None:
+                    raise RuntimeError(
+                        "resume with record_every > 1 needs the checkpoint "
+                        "iteration counter (adapt.npz 'it_cur'); this "
+                        "checkpoint predates it — resume with "
+                        "record_every=1 or start fresh")
+                ii = int(it)
         # double-buffered steady loop: dispatch chunk i+1 (async on device)
         # BEFORE converting chunk i's outputs, so host-side writeback and
         # the device-to-host transfer overlap device compute (on the
@@ -2109,19 +2247,21 @@ class JaxGibbsDriver:
         # Checkpoint consistency: the state yielded with chunk i's rows is
         # chunk i's own carry (x_end, b_end) — never the in-flight chunk's.
         b_dev = jnp.asarray(self.b)
-        pending = None          # (row, n, xs, bs, x_end, b_end)
+        pending = None          # (row, m, xs, bs, x_end, b_end, it_end)
 
-        def _writeback(row, n, xs, bs, x_end, b_end):
+        def _writeback(row, m, xs, bs, x_end, b_end, it_end):
             xs_h = self._squeeze(np.asarray(xs, dtype=np.float64))
             self._check_finite(xs_h, row, "chain state")
             bs_h = self._squeeze(np.asarray(bs, np.float64))
             self._check_finite(bs_h, row, "b coefficients")
-            chain[row:row + n] = xs_h
-            bchain[row:row + n] = bs_h
+            chain[row:row + m] = xs_h
+            bchain[row:row + m] = bs_h
             self.x_cur = np.asarray(x_end, dtype=np.float64)
             self.b = b_end
-            return row + n
+            self._it_cur = it_end
+            return row + m
 
+        it_base = self._it_base(niter)
         while ii < niter:
             n = min(self.chunk_size, niter - ii)
             # always run the full compiled chunk length: a trailing
@@ -2131,13 +2271,20 @@ class JaxGibbsDriver:
             # discarding them is bitwise-identical to an exact-length run,
             # including on resume: the final state is read from the
             # recorded pre-sweep states at position n.
-            fn = self._chunk_fn(self.chunk_size)
+            # Thinning offset: record iterations ≡ it_base (mod k) in
+            # absolute index.  Chunk starts stay on that residue (ctor
+            # enforces k | chunk_size), except when an old run's partial
+            # tail is extended — that resume pays one fresh compile for
+            # its off-residue chunk function.
+            off = (it_base - ii) % self.record_every
+            fn = self._chunk_fn(self.chunk_size, off)
             x, b_dev, xs, bs = fn(x, b_dev, self.key,
                                   jnp.asarray(ii, dtype=jnp.int32),
                                   self._aux(chain, ii),
                                   jnp.asarray(n, jnp.int32))
+            m = max(0, -(-(n - off) // self.record_every))
             if n < self.chunk_size:
-                xs, bs = xs[:n], bs[:n]
+                xs, bs = xs[:m], bs[:m]
             if pending is not None:
                 # start both host copies in flight together before the
                 # blocking conversions (the b-record is the big payload).
@@ -2154,8 +2301,9 @@ class JaxGibbsDriver:
                     except (AttributeError, RuntimeError):
                         pass
                 yield _writeback(*pending)
-            pending = (ii, n, xs, bs, x, b_dev)
+            pending = (rowc, m, xs, bs, x, b_dev, ii + n)
             ii += n
+            rowc += m
         if pending is not None:
             yield _writeback(*pending)
 
@@ -2207,6 +2355,11 @@ class JaxGibbsDriver:
         out = {"jax_key": np.asarray(jr.key_data(self.key)),
                "nchains": np.int64(self.C),
                "b_pad": np.asarray(self.b, dtype=np.float64),
+               # iteration counter at the last writeback: equals the row
+               # count at record_every=1, diverges under thinning — resume
+               # restores the sweep index (and so the PRNG stream) from it
+               "it_cur": np.int64(getattr(self, "_it_cur", 0)),
+               "record_every": np.int64(self.record_every),
                "x_cur": np.asarray(getattr(
                    self, "x_cur", np.zeros((self.C, self.cm.nx))))}
         for key in ("aclength_white", "cov_red", "red_hist",
@@ -2227,9 +2380,20 @@ class JaxGibbsDriver:
             raise RuntimeError(
                 f"resume checkpoint was written with nchains={got_c} but "
                 f"this sampler has nchains={self.C}; they must match")
+        got_k = int(state.pop("record_every", 1))
+        if got_k != self.record_every:
+            # a mismatch would silently misread the row cursor as an
+            # iteration counter (or vice versa), corrupting the chain and
+            # the PRNG alignment
+            raise RuntimeError(
+                f"resume checkpoint was written with record_every={got_k} "
+                f"but this sampler has record_every={self.record_every}; "
+                "they must match")
         self.key = jr.wrap_key_data(
             np.asarray(state["jax_key"], dtype=np.uint32))
         self.b = np.asarray(state["b_pad"], dtype=self.cm.cdtype)
+        if "it_cur" in state:
+            self._resume_it = int(state.pop("it_cur"))
         if "x_cur" in state:
             self.x_resume = np.asarray(state["x_cur"], dtype=np.float64)
         for key in ("aclength_white", "cov_red", "red_hist",

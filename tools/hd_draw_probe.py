@@ -1,0 +1,134 @@
+"""Component timing of the HD sequential b-draw at a given chain width,
+via the scan-amortized timer (``profiling._scan_time``) that cancels the
+~100 ms per-dispatch tunnel overhead: cumulative stages (gram+Sigma ->
++factor -> +precompute -> full draw) are timed separately and differenced,
+plus the two-float factorization as the candidate replacement for the f64
+blocked factor.  The breakdown behind the r5 restructure of
+``draw_b_hd_sequential``.
+
+Usage: python tools/hd_draw_probe.py [--nchains 32] [--inner 20]
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nchains", type=int, default=32)
+    ap.add_argument("--inner", type=int, default=20)
+    ap.add_argument("--repeats", type=int, default=5)
+    args = ap.parse_args()
+
+    import bench
+
+    import jax
+    import jax.numpy as jnp
+    import jax.random as jr
+
+    from pulsar_timing_gibbsspec_tpu.ops.linalg import (blocked_chol_inv,
+                                                        tf_chol_factor)
+    from pulsar_timing_gibbsspec_tpu.profiling import _scan_time
+    from pulsar_timing_gibbsspec_tpu.sampler import jax_backend as jb
+    from pulsar_timing_gibbsspec_tpu.sampler.blocks import BlockIndex
+    from pulsar_timing_gibbsspec_tpu.sampler.compiled import compile_pta
+
+    pta = bench.build_pta(45, orf="hd")
+    x0 = pta.initial_sample(np.random.default_rng(0))
+    ix = BlockIndex.build(pta.param_names)
+    if len(ix.orf):
+        x0[ix.orf] = 0.0
+    cm = compile_pta(pta)
+    C = args.nchains
+    cdt = cm.cdtype
+    B, P = cm.Bmax, cm.P
+    x = jnp.tile(jnp.asarray(x0, cdt)[None], (C, 1))
+    b = jnp.zeros((C, P, B), cdt)
+    print(f"C={C} P={P} B={B} K={cm.K} cdtype={np.dtype(cdt).name}",
+          file=sys.stderr)
+
+    def sigma_of(x1):
+        N = cm.ndiag_fast(x1)
+        TNT, d = jb.tnt_d_seg(cm, N)
+        phi = cm.phi(x1)
+        pinv = 1.0 / phi
+        rows_p = jnp.arange(P)[:, None]
+        rho = 10.0 ** (2.0 * jnp.asarray(x1, cdt)[cm.rho_ix_x])
+        Ginv = cm.orf_ginv_k(x1).astype(cdt)
+        prior = jnp.diagonal(Ginv, axis1=1, axis2=2).T / rho
+        pin = pinv.at[rows_p, jnp.asarray(cm.gw_sin_ix)].set(
+            prior, mode="drop")
+        pin = pin.at[rows_p, jnp.asarray(cm.gw_cos_ix)].set(
+            prior, mode="drop")
+        Sigma = TNT + pin[:, :, None] * jnp.eye(B, dtype=cdt)
+        return Sigma, d
+
+    def vm(single):
+        def body(x, b, k):
+            return jax.vmap(single)(x, b, jr.split(k, C))
+        return body
+
+    def t(name, single):
+        ms = _scan_time(vm(single), x, b, args.inner, args.repeats) * 1e3
+        print(f"{name:28s} {ms:9.2f} ms")
+        return ms
+
+    t("full draw", lambda x1, b1, k1: (
+        x1, jb.draw_b_hd_sequential(cm, x1, b1, k1)))
+
+    def s1(x1, b1, k1):
+        Sigma, d = sigma_of(x1)
+        return x1, b1 + 0.0 * (Sigma[:, :, 0] + d)
+
+    t("s1 gram+Sigma", s1)
+
+    def prec(Sigma):
+        diag = jnp.diagonal(Sigma, axis1=-2, axis2=-1)
+        dj = 1.0 / jnp.sqrt(diag)
+        return Sigma * dj[..., :, None] * dj[..., None, :], dj
+
+    def s2(x1, b1, k1):
+        Sigma, d = sigma_of(x1)
+        A, dj = prec(Sigma)
+        _, Li = blocked_chol_inv(A)
+        return x1, b1 + 0.0 * Li[:, :, 0]
+
+    t("s2 = s1 + f64 factor", s2)
+
+    def s2tf(x1, b1, k1):
+        Sigma, d = sigma_of(x1)
+        A, dj = prec(Sigma)
+        _, Li = tf_chol_factor(A)
+        return x1, b1 + 0.0 * Li[:, :, 0]
+
+    t("s2tf = s1 + tf factor", s2tf)
+
+    def s3(x1, b1, k1):
+        Sigma, d = sigma_of(x1)
+        A, dj = prec(Sigma)
+        _, Li = blocked_chol_inv(A)
+        z = jr.normal(k1, (P, B), cdt)
+        w = jnp.einsum("pij,pj->pi", Li, dj * d, precision="highest")
+        base = dj * jnp.einsum("pji,pj->pi", Li, w + z, precision="highest")
+        cols = jnp.concatenate([jnp.asarray(cm.gw_sin_ix),
+                                jnp.asarray(cm.gw_cos_ix)], axis=1)
+        ccl = jnp.clip(cols, 0, B - 1)
+        djc = jnp.take_along_axis(dj, ccl, axis=1)
+        Lic = jnp.take_along_axis(
+            Li, jnp.broadcast_to(ccl[:, None, :], (P, B, ccl.shape[1])),
+            axis=2) * djc[:, None, :]
+        Corr = dj[:, :, None] * jnp.einsum("pji,pjm->pim", Li, Lic,
+                                           precision="highest")
+        return x1, b1 + 0.0 * (base[:, :, None] + Corr)[:, :, 0]
+
+    t("s3 = s2 + base/Corr", s3)
+
+
+if __name__ == "__main__":
+    main()
