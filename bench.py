@@ -137,11 +137,13 @@ def bench_jax(pta, x0, niter, adapt_iters, nchains, profile=False,
             marks.append((done, time.time()))
     # marks count recorded ROWS; one row is record_every sweeps in the
     # steady loop, so sweep rates scale back up by the thinning factor
+    # (the raw marks are converted to sweep units too, so steady_sweeps
+    # and the headline rate stay mutually re-derivable)
     windows = [w * record_every for w in _window_rates(marks)]
     assert windows, "benchmark too short to measure a steady window"
     assert np.all(np.isfinite(chain)), "non-finite chain values"
     steady = float(np.median(windows))
-    raw = _raw_marks(marks)
+    raw = _raw_marks([(i * record_every, t) for i, t in marks])
     prof = None
     if profile:
         from pulsar_timing_gibbsspec_tpu import profiling
@@ -344,15 +346,17 @@ def main(argv=None):
     if args.orf == "hd":
         # the sequential cross-pulsar conditional sweep is heavier per
         # sweep; fewer iterations and chains keep the wall-clock (and the
-        # compiled program) in check.  HD chains peak at C=32 (measured
-        # r4: C=16 -> 169, C=32 -> 247, C=64 -> 120 samples/s).  Traced
-        # (tools/sweep_probe.py --orf hd): the whole sweep is the
-        # sequential cross-pulsar b-draw, and its device time jumps
-        # 119 -> 529 ms from C=32 to C=64 — per-chain cost DOUBLES.
-        # Not HBM capacity (compiled temp 1.5 -> 2.3 GB of 16); the
-        # per-step (C, B, B) two-float working set crossing VMEM-friendly
-        # tiling past C~32 is the consistent explanation.  The CRN path,
-        # whose knee was the tunnel writeback, keeps scaling to 64.
+        # compiled program) in check.  r5 moved the r4 chain-width knee:
+        # the f64 blocked factorization (81 of the 132 ms C=32 b-draw)
+        # became the two-float MXU factor and the 45-step scan's
+        # (C, B, B) matvecs were hoisted into pre-scan batched matmuls —
+        # b-draw now 35 ms at C=32, 100 ms at C=64 (tools/sweep_probe.py
+        # --orf hd, tools/hd_draw_probe.py; docs/HD_MIXING.md r5
+        # section).  C=32 still maximizes samples/s (727 vs 564 at C=64:
+        # per-chain cost still grows ~1.4x, two-float VMEM working
+        # sets), so the default stays 32 — ~2.9x faster per sweep than
+        # r4.  The CRN path, whose knee was the tunnel writeback, keeps
+        # scaling to 64.
         hd = bench_config("hd", n_psr, max(100, niter // 4),
                           max(5, np_iters // 4), adapt,
                           nchains if args.nchains else min(nchains, 32),

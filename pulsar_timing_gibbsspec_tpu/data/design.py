@@ -184,8 +184,3 @@ def _degenerate_keep(M: np.ndarray, rtol: float = 1e-10) -> list:
         if s[-1] > rtol * s[0]:
             keep.append(j)
     return keep
-
-
-def _drop_degenerate(M: np.ndarray, rtol: float = 1e-10) -> np.ndarray:
-    """Back-compat wrapper over :func:`_degenerate_keep`."""
-    return M[:, _degenerate_keep(M, rtol)]

@@ -38,6 +38,8 @@ chain adaptation state), so C chains multiply posterior samples/sec by
 
 from __future__ import annotations
 
+import os
+
 import numpy as np
 
 from ..config import settings
@@ -297,7 +299,7 @@ def lnlike_fullmarg_fn(cm: CompiledPTA, x, TNT, d):
         jnp.sum(d * expval, axis=-1) - logdet_sigma - logdet_phi)
 
 
-def draw_b_fn(cm: CompiledPTA, x, key, b=None):
+def draw_b_fn(cm: CompiledPTA, x, key, b=None, exact=False):
     """b | everything: batched preconditioned-Cholesky Gaussian draw
     (reference ``update_b``, ``pulsar_gibbs.py:489-520``).
 
@@ -323,7 +325,15 @@ def draw_b_fn(cm: CompiledPTA, x, key, b=None):
             return draw_b_joint(cm, x, key)
         if b is None:
             b = jnp.zeros((cm.P, cm.Bmax), cm.cdtype)
-        return draw_b_hd_sequential(cm, x, b, key)
+        # exact=True selects the f64 blocked factorization: the warmup /
+        # initial draws run there — warmup states (prior-drawn rho, b
+        # interpolating the data) push the conditional systems past the
+        # two-float factor's breakdown margins (observed as seed-dependent
+        # NaN warmup chains on TPU), while the ~10x cost only ever applies
+        # to the few dozen warmup sweeps
+        if HD_SCALABLE_KERNEL == "pulsar":
+            return draw_b_hd_sequential(cm, x, b, key, exact=exact)
+        return draw_b_hd_freqblock(cm, x, b, key, exact=exact)
     N = cm.ndiag_fast(x)
     TNT, d = tnt_d_x(cm, x, N)
     phi = cm.phi(x)
@@ -332,7 +342,7 @@ def draw_b_fn(cm: CompiledPTA, x, key, b=None):
     return b
 
 
-def draw_b_hd_sequential(cm: CompiledPTA, x, b, key):
+def draw_b_hd_sequential(cm: CompiledPTA, x, b, key, exact=False):
     """Correlated-ORF b-draw as a sequential pulsar-wise Gibbs sweep —
     the scalable alternative to :func:`draw_b_joint` (whose dense
     ``(P Bmax)^2`` program is capped at ``HD_DENSE_MAX`` coefficients).
@@ -371,7 +381,7 @@ def draw_b_hd_sequential(cm: CompiledPTA, x, b, key):
     import jax.numpy as jnp
     import jax.random as jr
 
-    from ..ops.linalg import tf_chol_factor, tf_mm
+    from ..ops.linalg import blocked_chol_inv, tf_chol_factor, tf_mm
 
     cdt = cm.cdtype
     B, P, K = cm.Bmax, cm.P, cm.K
@@ -412,8 +422,10 @@ def draw_b_hd_sequential(cm: CompiledPTA, x, b, key):
     # Hastings correction here, so the stationary law carries that
     # O(1e-5)-relative covariance perturbation; the same accepted-error
     # class as the un-Metropolised segmented Gram above (KS-validated
-    # against the f64 oracle in tests/test_jax_backend.py).
-    _, Li = tf_chol_factor(A)                      # (P, B, B)
+    # against the f64 oracle in tests/test_jax_backend.py).  exact=True
+    # (warmup/init, see draw_b_fn) takes the f64 blocked factor instead.
+    _, Li = (blocked_chol_inv(A) if exact
+             else tf_chol_factor(A))               # (P, B, B)
     kz, kp = jr.split(key)
     z = jr.normal(kz, (P, B), cdt)
 
@@ -431,8 +443,10 @@ def draw_b_hd_sequential(cm: CompiledPTA, x, b, key):
     djc = jnp.take_along_axis(dj, ccl, axis=1) * valid
     Lic = jnp.take_along_axis(
         Li, ccl[:, None, :], axis=2) * djc[:, None, :]          # (P, B, 2K)
-    Corr = dj[:, :, None] * tf_mm(
-        jnp.swapaxes(Li, -1, -2), Lic)                          # (P, B, 2K)
+    LiT = jnp.swapaxes(Li, -1, -2)
+    Corr = dj[:, :, None] * (
+        jnp.einsum("pij,pjm->pim", LiT, Lic, precision="highest")
+        if exact else tf_mm(LiT, Lic))                          # (P, B, 2K)
 
     def gather_a(brow, p):
         """(K, 2) GW coefficients of one pulsar row of the padded b."""
@@ -450,8 +464,13 @@ def draw_b_hd_sequential(cm: CompiledPTA, x, b, key):
                  - gpp[:, None] * a[p]) / rho[:, None]   # (K, 2)
         cvec = jnp.concatenate([cross[:, 0], cross[:, 1]])       # (2K,)
         bp = base[p] - Corr[p] @ cvec
-        # pad pulsars keep their inert coords; real rows update
-        bnew = jnp.where(live_mask[p] > 0, bp, b[p])
+        # pad pulsars keep their inert coords; real rows update.  The
+        # finite guard (tf path) skips a pulsar whose two-float factor
+        # broke down instead of poisoning the chain (draw_b_mh's ok-mask
+        # contract; warmup/init run exact=True so this only backstops
+        # rare steady-state excursions)
+        ok = jnp.all(jnp.isfinite(bp))
+        bnew = jnp.where((live_mask[p] > 0) & ok, bp, b[p])
         b = b.at[p].set(bnew)
         a = a.at[p].set(gather_a(bnew, p) * live_mask[p])
         return (b, a), None
@@ -463,6 +482,211 @@ def draw_b_hd_sequential(cm: CompiledPTA, x, b, key):
     # measurably improves rho_k mixing (docs/HD_MIXING.md)
     (b, _), _ = jax.lax.scan(step, (b, a0), jr.permutation(kp, P))
     return b
+
+
+def draw_b_hd_freqblock(cm: CompiledPTA, x, b, key, exact=False):
+    """Correlated-ORF b-draw as TWO-BLOCK Gibbs: per-pulsar non-GW
+    coordinates given the GW coefficients (one batched draw), then a
+    ``lax.scan`` over the K frequencies drawing each frequency's GW
+    coefficients JOINTLY across all pulsars (a (2P, 2P) system per
+    step).  The ALTERNATIVE scalable kernel (``PTGIBBS_HD_KERNEL=freq``)
+    — built expecting per-frequency joint draws to recover dense mixing,
+    then measured ~2x WORSE-mixing than the pulsar-wise sweep (toy
+    freq/dense ACT ratio 2.71 vs pulsar/dense 1.38, docs/HD_MIXING.md:
+    the dominant coupling is gw <-> own timing model, which only the
+    per-pulsar joint draw resolves within one conditional).  Kept
+    selectable for the P >> K regime its scan shape is right for.
+
+    The shape argument for TPU (why it was worth building and keeping)
+    vs the pulsar-wise sweep (:func:`draw_b_hd_sequential`):
+
+    - the sequential axis is K (10 on the bench model), not P (45):
+      scan latency shrinks 4.5x and STAYS constant as the array grows —
+      more pulsars widen the batched/jointly-drawn dimensions instead
+      of lengthening the scan (the scaling direction a PTA framework
+      actually faces);
+    - the cross-pulsar correlations of frequency k — the quadratic form
+      ``taut_k`` the rho_k conditional consumes (:func:`rho_update`) —
+      are sampled jointly within one sweep instead of relaxed
+      pulsar-by-pulsar.  (Measured, this does NOT dominate: the gw <->
+      timing-model coupling left to two-block alternation costs more
+      ACT than the joint cross-pulsar draw saves — see the kernel
+      decision above.)
+
+    Both blocks are exact conditionals of the same joint law the dense
+    draw samples (prior per (frequency, phase): ``N(0, rho_k G)`` across
+    pulsars — reference ``pta_gibbs.py:533`` assumes phi block-diagonal
+    and never finished this), so the sweep is a valid Gibbs kernel; the
+    factorizations use the two-float MXU factor with the same accepted
+    O(1e-5) congruence-error class as :func:`draw_b_hd_sequential`
+    (KS-validated against the f64 oracle and the dense draw).
+
+    Block 1 (non-GW | GW) runs the full-size per-pulsar system with the
+    GW rows/columns projected out (identity rows in their place) so one
+    batched (P, B, B) factorization serves every pulsar; the drawn
+    values on GW slots are discarded.  Block 2 assembles, per frequency
+    k, the joint system over m groups of P coordinates — GW sin/cos
+    across pulsars, PLUS (for models with intrinsic red noise) each
+    pulsar's red sin/cos at the paired frequency index: the red Fourier
+    columns are near-collinear with the same-frequency GW columns
+    (almost the same sinusoids on the same TOAs), and a block split that
+    separates the two mixes catastrophically along the (red_k - gw_k)
+    ridge — measured z ~ 14 bias-level disagreement with the f64 oracle
+    at test lengths before red was folded in.  Gibbs blocks may overlap
+    (the red coords are also in block 1): every draw is an exact
+    conditional, so invariance is preserved and the double update only
+    helps mixing.  ``Q_k``'s m x m block structure: per-pulsar TNT
+    sub-blocks ``diag(T_ij)`` everywhere, plus ``Ginv_k / rho_k`` on
+    the two GW diagonal blocks (cross-pulsar coupling) and the diagonal
+    red prior ``1/phi`` on the red diagonal blocks.  Pad pulsars carry
+    zero TNT and the decoupled identity rows of ``Ginv``, so they draw
+    inert values that the masked ``taut`` reduction never sees.
+    """
+    import jax
+    import jax.numpy as jnp
+    import jax.random as jr
+
+    from ..ops.linalg import blocked_chol_inv, tf_chol_factor
+
+    factor = blocked_chol_inv if exact else tf_chol_factor
+    cdt = cm.cdtype
+    B, P, K = cm.Bmax, cm.P, cm.K
+    N = cm.ndiag_fast(x)
+    TNT, d = (tnt_d_seg(cm, N) if not cm.has_ke
+              else tnt_d_x(cm, x, N))                   # (P, B, B), (P, B)
+    phi = cm.phi(x)
+    pinv = 1.0 / phi                                    # (P, B)
+    rows_p = jnp.arange(P)[:, None]
+    rho = 10.0 ** (2.0 * jnp.asarray(x, cdt)[cm.rho_ix_x])        # (K,)
+    Ginv = cm.orf_ginv_k(x).astype(cdt)                 # (K, P, P)
+    gsin = jnp.asarray(cm.gw_sin_ix)
+    gcos = jnp.asarray(cm.gw_cos_ix)
+    cols = jnp.concatenate([gsin, gcos], axis=1)        # (P, 2K)
+    valid = ((cols >= 0) & (cols < B)).astype(cdt)
+    ccl = jnp.clip(cols, 0, B - 1)
+    # GW-slot indicator (P, B): .max absorbs clipped duplicates
+    gwm = jnp.zeros((P, B), cdt).at[rows_p, ccl].max(valid)
+    nm = 1.0 - gwm                                      # non-GW indicator
+
+    kz1, kz2, kp = jr.split(key, 3)
+
+    # ---- block 1: non-GW | GW --------------------------------------------
+    # full-size system with GW rows/cols replaced by identity: one batched
+    # factorization, GW slots of the draw discarded afterwards
+    Sigma = TNT + (pinv * nm)[:, :, None] * jnp.eye(B, dtype=cdt)
+    Sn = Sigma * nm[:, :, None] * nm[:, None, :] \
+        + gwm[:, :, None] * jnp.eye(B, dtype=cdt)
+    ge = b * gwm                                        # embedded GW coords
+    rhs = nm * (d - jnp.einsum("pij,pj->pi", TNT, ge, precision="highest"))
+    diag = jnp.diagonal(Sn, axis1=-2, axis2=-1)
+    dj = 1.0 / jnp.sqrt(diag)
+    A = Sn * dj[:, :, None] * dj[:, None, :]
+    _, Li = factor(A)
+    z = jr.normal(kz1, (P, B), cdt)
+    w = jnp.einsum("pij,pj->pi", Li, dj * rhs, precision="highest")
+    bn = dj * jnp.einsum("pji,pj->pi", Li, w + z, precision="highest")
+    # two-float breakdown guard (same contract as draw_b_mh's ok-mask):
+    # a NaN factor row skips that pulsar's update for the sweep instead
+    # of poisoning the chain
+    ok1 = jnp.all(jnp.isfinite(bn), axis=1, keepdims=True)
+    b = jnp.where((gwm > 0) | ~ok1, b, bn)
+
+    # ---- block 2: per-frequency joint draw across pulsars -----------------
+    # m coordinate groups of P: gw sin, gw cos (+ red sin, red cos at the
+    # paired frequency index when the model has intrinsic red columns)
+    rsin = (jnp.asarray(cm.red_sin_ix) if cm.red_sin_ix is not None
+            else jnp.zeros((P, 0), jnp.int32))
+    rcos = (jnp.asarray(cm.red_cos_ix) if cm.red_cos_ix is not None
+            else jnp.zeros((P, 0), jnp.int32))
+    Kr = int(rsin.shape[1])
+    # shared-column models have no separate red columns to fold in (and
+    # folding them would double-count the duplicate index)
+    m = 4 if (Kr > 0 and not cm.red_shares_gw) else 2
+    zs = jr.normal(kz2, (K, m * P), cdt)
+    eyeP = jnp.eye(P, dtype=cdt)
+    pr_arange = jnp.arange(P)
+
+    def step(b, k):
+        gcols = [jnp.take(gsin, k, axis=1), jnp.take(gcos, k, axis=1)]
+        vals = [((c >= 0) & (c < B)).astype(cdt) for c in gcols]
+        if m == 4:
+            kr = jnp.minimum(k, Kr - 1)
+            in_r = (k < Kr).astype(cdt)
+            for rarr in (rsin, rcos):
+                c = jnp.take(rarr, kr, axis=1)
+                gcols.append(c)
+                vals.append(((c >= 0) & (c < B)).astype(cdt) * in_r)
+        c4 = jnp.clip(jnp.stack(gcols, axis=1), 0, B - 1)     # (P, m)
+        v4 = jnp.stack(vals, axis=1)                          # (P, m)
+        # TNT rows/sub-blocks at the block's columns
+        Tr = jnp.take_along_axis(TNT, c4[:, :, None], axis=1) \
+            * v4[:, :, None]                                  # (P, m, B)
+        T4 = jnp.take_along_axis(Tr, c4[:, None, :].repeat(m, 1), axis=2) \
+            * v4[:, None, :]                                  # (P, m, m)
+        Dg = Ginv[k] / rho[k]                                 # (P, P)
+        blocks = []
+        for i in range(m):
+            row = []
+            for j in range(m):
+                blk = jnp.zeros((P, P), cdt).at[pr_arange, pr_arange].set(
+                    T4[:, i, j])
+                if i == j:
+                    if i < 2:
+                        # cross-pulsar GW prior; decoupled unit rows for
+                        # pulsars without this frequency
+                        vi = v4[:, i]
+                        blk = blk + Dg * vi[:, None] * vi[None, :] \
+                            + (1.0 - vi) * eyeP
+                    else:
+                        # diagonal red prior (or unit row when invalid)
+                        pri = jnp.take_along_axis(
+                            pinv, c4[:, i][:, None], 1)[:, 0]
+                        blk = blk + jnp.diag(
+                            jnp.where(v4[:, i] > 0, pri, 1.0))
+                row.append(blk)
+            blocks.append(jnp.concatenate(row, axis=1))
+        Q = jnp.concatenate(blocks, axis=0)                   # (mP, mP)
+        # rhs: data projection minus coupling to every OTHER coordinate
+        a4 = jnp.take_along_axis(b, c4, axis=1) * v4          # (P, m)
+        coup = jnp.einsum("pib,pb->pi", Tr, b, precision="highest")
+        self_c = jnp.einsum("pij,pj->pi", T4, a4)
+        dk = jnp.take_along_axis(d, c4, axis=1) * v4
+        r = (dk - coup + self_c).T.reshape(m * P)             # group-major
+        qdiag = jnp.diagonal(Q)
+        qj = 1.0 / jnp.sqrt(qdiag)
+        Aq = Q * qj[:, None] * qj[None, :]
+        _, Lq = factor(Aq)
+        wq = Lq @ (qj * r)
+        anew = (qj * (Lq.T @ (wq + zs[k]))).reshape(m, P)     # (m, P)
+        # breakdown guard: a non-finite joint draw (two-float factor
+        # breakdown at an extreme warmup state) skips this frequency's
+        # update for the sweep instead of poisoning the chain
+        okk = jnp.all(jnp.isfinite(anew))
+        for i in range(m):
+            vi = v4[:, i]
+            ci = c4[:, i]
+            old = b[pr_arange, ci]
+            b = b.at[pr_arange, ci].set(
+                jnp.where((vi > 0) & okk, anew[i], old))
+        return b, None
+
+    b, _ = jax.lax.scan(step, b, jr.permutation(kp, K))
+    return b
+
+
+#: scalable correlated-ORF kernel past HD_DENSE_MAX: "pulsar" (sequential
+#: pulsar-wise sweep — production) or "freq" (two-block frequency-joint).
+#: Measured (docs/HD_MIXING.md): the pulsar kernel mixes BETTER (toy
+#: ACT ratio vs the dense joint draw 1.38 vs the freq kernel's 2.71) —
+#: each pulsar's draw resolves the strong gw <-> timing-model coupling
+#: within one conditional, which dominates the cross-pulsar coupling the
+#: freq kernel resolves instead.  The freq kernel's K-length scan (vs P)
+#: is kept as the scaling alternative for futures where P >> K.
+HD_SCALABLE_KERNEL = os.environ.get("PTGIBBS_HD_KERNEL", "pulsar")
+if HD_SCALABLE_KERNEL not in ("pulsar", "freq"):
+    raise ValueError(
+        f"PTGIBBS_HD_KERNEL={HD_SCALABLE_KERNEL!r}: the scalable "
+        "correlated-ORF kernel must be 'pulsar' (production) or 'freq'")
 
 
 def draw_b_joint(cm: CompiledPTA, x, key):
@@ -1532,7 +1756,7 @@ class JaxGibbsDriver:
         # b passed through so large correlated-ORF models can take the
         # sequential conditional path (a no-op for the others)
         self._jit_draw_b_b = jax.jit(
-            jax.vmap(lambda x, k, b: draw_b_fn(cm, x, k, b)))
+            jax.vmap(lambda x, k, b: draw_b_fn(cm, x, k, b, exact=True)))
         self._jit_draw_b = lambda x, keys: self._jit_draw_b_b(
             x, keys, jax.numpy.asarray(self.b))
 
@@ -1956,7 +2180,9 @@ class JaxGibbsDriver:
             # current state, so acceptance stays ~1 even far from
             # stationarity, at a fraction of the f64 draw's cost
             if cm.orf_name != "crn" or cm.has_ke:
-                b = draw_b_fn(cm, x, k[4], b)
+                # exact=True: warmup states sit past the two-float
+                # factor's breakdown margins (see draw_b_fn)
+                b = draw_b_fn(cm, x, k[4], b, exact=True)
                 u = b_matvec(cm, b)
             else:
                 b, u, _ = draw_b_refresh(cm, x, b, u, k[4])

@@ -302,7 +302,11 @@ class NumpyGibbs:
         xnew = xs.copy()
         tau = self._gw_tau()
         if self.red_sig is None:
-            # exact truncated inverse-CDF (vHV2014; reference :215-216)
+            # exact truncated inverse-CDF (vHV2014; reference :215-216).
+            # tau = 0 (a zeroed coefficient pair) is a legal input whose
+            # 0/0 limit is the prior draw; clamp like the device path
+            # (jax_backend.rho_update) instead of warning through
+            tau = np.maximum(tau, self.rhomin * 1e-6)
             hi = 1.0 - np.exp(tau / self.rhomax - tau / self.rhomin)
             eta = self.rng.uniform(0.0, hi)
             rhonew = tau / (tau / self.rhomax - np.log1p(-eta))

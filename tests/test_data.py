@@ -78,7 +78,7 @@ def _write_nanograv_style(tmp_path):
     # continuous in-band frequency spread, as real sub-banded NANOGrav
     # TOAs carry: on a few-point frequency grid DM (1/nu^2), FD1 (log nu),
     # FD2 (log^2 nu), the offset and any band-tied JUMP indicator are
-    # exactly collinear — a real degeneracy _drop_degenerate would
+    # exactly collinear — a real degeneracy _degenerate_keep would
     # (correctly) remove
     for i, m in enumerate(mjds):
         freq = (rng.uniform(1100.0, 1800.0) if i % 2 == 0

@@ -42,18 +42,21 @@ def act_table(chain, cols, names, burn):
     return out
 
 
-def run_chain(pta, x0, seed, niter, outdir, force_sequential=False):
+def run_chain(pta, x0, seed, niter, outdir, kernel="dense"):
+    """kernel: "dense" (joint draw) | "freq" | "pulsar" (scalable paths,
+    forced past HD_DENSE_MAX)."""
     from pulsar_timing_gibbsspec_tpu.sampler import jax_backend as jb
     from pulsar_timing_gibbsspec_tpu.sampler.gibbs import PTABlockGibbs
 
-    old = jb.HD_DENSE_MAX
+    old, oldk = jb.HD_DENSE_MAX, jb.HD_SCALABLE_KERNEL
     try:
-        if force_sequential:
+        if kernel != "dense":
             jb.HD_DENSE_MAX = 0
+            jb.HD_SCALABLE_KERNEL = kernel
         g = PTABlockGibbs(pta, backend="jax", seed=seed, progress=False)
         return g.sample(x0, outdir=outdir, niter=niter)
     finally:
-        jb.HD_DENSE_MAX = old
+        jb.HD_DENSE_MAX, jb.HD_SCALABLE_KERNEL = old, oldk
 
 
 def main():
@@ -86,10 +89,9 @@ def main():
             x0[idx.orf] = 0.0
         cols = list(idx.rho) + list(idx.orf)
         burn = max(300, args.niter // 10)
-        for mode, force in (("dense", False), ("sequential", True)):
-            chain = run_chain(pta, x0, 61 if force else 60, args.niter,
-                              f"{args.outdir}/{orf}_{mode}",
-                              force_sequential=force)
+        for seed, mode in enumerate(("dense", "freq", "pulsar")):
+            chain = run_chain(pta, x0, 60 + seed, args.niter,
+                              f"{args.outdir}/{orf}_{mode}", kernel=mode)
             assert np.all(np.isfinite(chain))
             results[f"toy3_{orf}_{mode}"] = act_table(
                 chain, cols, names, burn)
@@ -104,11 +106,12 @@ def main():
         idx = BlockIndex.build(names)
         x0 = pta.initial_sample(np.random.default_rng(4))
         burn = max(200, args.full_niter // 10)
-        chain = run_chain(pta, x0, 62, args.full_niter,
-                          f"{args.outdir}/full45")
-        assert np.all(np.isfinite(chain))
-        results["full45_hd_sequential"] = act_table(
-            chain, list(idx.rho), names, burn)
+        for mode in ("freq", "pulsar"):
+            chain = run_chain(pta, x0, 62, args.full_niter,
+                              f"{args.outdir}/full45_{mode}", kernel=mode)
+            assert np.all(np.isfinite(chain))
+            results[f"full45_hd_{mode}"] = act_table(
+                chain, list(idx.rho), names, burn)
 
     # ---- report ----------------------------------------------------------
     lines = [
@@ -117,35 +120,45 @@ def main():
         "Per-channel Sokal integrated ACT (sweeps/effective sample; lower "
         "is better), measured on CPU f64 chains "
         f"(toy: 3 pulsars, {args.niter} sweeps; the size where the dense "
-        "joint draw still compiles).  The sequential pulsar-wise "
-        "conditional sweep is the scalable path used past "
-        "``HD_DENSE_MAX``; since r4 it randomizes the pulsar update order "
-        "each sweep (random-scan Gibbs).",
+        "joint draw still compiles).  Two scalable kernels run past "
+        "``HD_DENSE_MAX``: ``pulsar`` (production: the sequential "
+        "pulsar-wise conditional sweep, random-scan order — it resolves "
+        "the dominant gw <-> timing-model coupling within each pulsar "
+        "draw) and ``freq`` (two-block sweep with per-frequency "
+        "cross-pulsar joint draws, intrinsic-red columns folded into "
+        "each frequency block; a K-length scan instead of P).",
         "",
     ]
     for orf in ("hd", "bin_orf"):
         dn = results[f"toy3_{orf}_dense"]
-        sq = results[f"toy3_{orf}_sequential"]
+        fr = results[f"toy3_{orf}_freq"]
+        sq = results[f"toy3_{orf}_pulsar"]
         lines += [f"## toy 3-pulsar, orf={orf}", "",
-                  "| channel | dense ACT | sequential ACT | ratio |",
-                  "|---|---|---|---|"]
+                  "| channel | dense ACT | freq ACT | pulsar ACT |"
+                  " freq/dense | pulsar/dense |",
+                  "|---|---|---|---|---|---|"]
         for name in dn:
-            r = sq[name] / dn[name]
-            lines.append(f"| `{name}` | {dn[name]:.2f} | {sq[name]:.2f} "
-                         f"| {r:.2f} |")
-        med = np.median([sq[n] / dn[n] for n in dn])
-        lines += ["", f"median sequential/dense ACT ratio: **{med:.2f}**",
-                  ""]
-        results[f"toy3_{orf}_ratio_median"] = float(med)
-    if "full45_hd_sequential" in results:
-        acts = list(results["full45_hd_sequential"].values())
-        lines += ["## 45-pulsar, orf=hd, sequential (the real-size path)",
+            lines.append(
+                f"| `{name}` | {dn[name]:.2f} | {fr[name]:.2f} "
+                f"| {sq[name]:.2f} | {fr[name] / dn[name]:.2f} "
+                f"| {sq[name] / dn[name]:.2f} |")
+        medf = np.median([fr[n] / dn[n] for n in dn])
+        medp = np.median([sq[n] / dn[n] for n in dn])
+        lines += ["", f"median freq/dense ACT ratio: **{medf:.2f}**; "
+                  f"median pulsar/dense ACT ratio: **{medp:.2f}**", ""]
+        results[f"toy3_{orf}_freq_ratio_median"] = float(medf)
+        results[f"toy3_{orf}_pulsar_ratio_median"] = float(medp)
+    for mode in ("freq", "pulsar"):
+        if f"full45_hd_{mode}" not in results:
+            continue
+        acts = list(results[f"full45_hd_{mode}"].values())
+        lines += [f"## 45-pulsar, orf=hd, {mode} kernel (real size)",
                   "",
                   f"rho_k ACT over {len(acts)} bins: median "
                   f"{np.median(acts):.2f}, max {np.max(acts):.2f} "
                   f"({args.full_niter} sweeps)", ""]
-        results["full45_rho_act_median"] = float(np.median(acts))
-        results["full45_rho_act_max"] = float(np.max(acts))
+        results[f"full45_rho_act_median_{mode}"] = float(np.median(acts))
+        results[f"full45_rho_act_max_{mode}"] = float(np.max(acts))
     lines += [
         "Generated by `tools/hd_mixing_probe.py`.  bench.py divides the "
         "measured HD sweeps/sec by the median rho ACT to report "

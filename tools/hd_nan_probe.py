@@ -1,0 +1,170 @@
+"""Locate the first non-finite state of the scalable HD warmup at the
+45-pulsar scale: step the warmup body one sweep at a time, checking
+finiteness of (x, b) after each, then dissect the failing draw — which
+block (non-GW draw / which frequency step) produced the first NaN and
+what the local conditioning looked like.
+
+Usage: [JAX_PLATFORMS=cpu] python tools/hd_nan_probe.py [--nchains 2]
+       [--kernel freq|pulsar] [--nsweeps 60]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nchains", type=int, default=2)
+    ap.add_argument("--kernel", default="freq")
+    ap.add_argument("--nsweeps", type=int, default=60)
+    args = ap.parse_args()
+
+    import bench
+
+    import jax
+    import jax.numpy as jnp
+    import jax.random as jr
+
+    from pulsar_timing_gibbsspec_tpu.sampler import jax_backend as jb
+    from pulsar_timing_gibbsspec_tpu.sampler.blocks import BlockIndex
+    from pulsar_timing_gibbsspec_tpu.sampler.jax_backend import JaxGibbsDriver
+
+    jb.HD_SCALABLE_KERNEL = args.kernel
+    pta = bench.build_pta(45, orf="hd")
+    x0 = pta.initial_sample(np.random.default_rng(0))
+    ix = BlockIndex.build(pta.param_names)
+    if len(ix.orf):
+        x0[ix.orf] = 0.0
+    drv = JaxGibbsDriver(pta, seed=1, common_rho=True,
+                         white_adapt_iters=300, nchains=args.nchains)
+    cm = drv.cm
+    C = drv.C
+    body = drv._warmup_body()
+    vbody = jax.jit(jax.vmap(body, in_axes=(0, 0, 0, None)))
+    x = jnp.asarray(np.tile(np.asarray(x0)[None], (C, 1)), cm.cdtype)
+    key = jr.key(7)
+    b = jax.vmap(lambda k1: jb.draw_b_fn(cm, jnp.asarray(x0, cm.cdtype),
+                                         k1))(jr.split(key, C))
+    u = jax.vmap(lambda b1: jb.b_matvec(cm, b1))(b)
+    aux = drv._aux()
+    carry = (x, b, u)
+    prev = None
+    for t in range(args.nsweeps):
+        kt = jr.fold_in(key, t)
+        keys = jax.vmap(lambda c: jr.fold_in(kt, c))(jnp.arange(C))
+        prev = tuple(np.asarray(v, np.float64) for v in carry[:2])
+        carry, _ = vbody(carry, keys, aux, jnp.asarray(t, jnp.int32))
+        xh = np.asarray(carry[0], np.float64)
+        bh = np.asarray(carry[1], np.float64)
+        okx, okb = np.isfinite(xh).all(), np.isfinite(bh).all()
+        if not (okx and okb):
+            print(f"first non-finite at sweep {t}: x ok={okx} b ok={okb}")
+            bad = ~np.isfinite(bh)
+            cc, pp, bbix = np.where(bad)
+            print("bad b entries: chains", sorted(set(cc.tolist()))[:5],
+                  "pulsars", sorted(set(pp.tolist()))[:10],
+                  "cols", sorted(set(bbix.tolist()))[:20])
+            # dissect: rerun just the b draw from the pre-sweep state
+            xprev = jnp.asarray(prev[0], cm.cdtype)
+            bprev = jnp.asarray(prev[1], cm.cdtype)
+            # the warmup body draws b LAST with k[4]; reproduce per chain
+            for c in range(C):
+                k = jr.split(keys[c], 8)
+                bnew = jb.draw_b_fn(cm, carry[0][c], k[4], bprev[c])
+                fin = bool(np.isfinite(np.asarray(bnew)).all())
+                if not fin:
+                    _dissect(cm, carry[0][c], bprev[c], k[4])
+                    break
+            return
+    print(f"all {args.nsweeps} sweeps finite at C={C} "
+          f"kernel={args.kernel}")
+
+
+def _dissect(cm, x, b, key):
+    import jax.numpy as jnp
+    import jax.random as jr
+
+    from pulsar_timing_gibbsspec_tpu.ops.linalg import tf_chol_factor
+    from pulsar_timing_gibbsspec_tpu.sampler import jax_backend as jb
+
+    print("dissecting failing draw...")
+    cdt = cm.cdtype
+    B, P, K = cm.Bmax, cm.P, cm.K
+    N = cm.ndiag_fast(x)
+    TNT, d = jb.tnt_d_seg(cm, N)
+    phi = cm.phi(x)
+    pinv = 1.0 / phi
+    rho = 10.0 ** (2.0 * jnp.asarray(x, cdt)[cm.rho_ix_x])
+    print("rho range:", float(rho.min()), float(rho.max()))
+    Ginv = cm.orf_ginv_k(x).astype(cdt)
+    gsin = jnp.asarray(cm.gw_sin_ix)
+    gcos = jnp.asarray(cm.gw_cos_ix)
+    cols = jnp.concatenate([gsin, gcos], axis=1)
+    valid = ((cols >= 0) & (cols < B)).astype(cdt)
+    ccl = jnp.clip(cols, 0, B - 1)
+    rows_p = jnp.arange(P)[:, None]
+    gwm = jnp.zeros((P, B), cdt).at[rows_p, ccl].max(valid)
+    nm = 1.0 - gwm
+    Sigma = TNT + (pinv * nm)[:, :, None] * jnp.eye(B, dtype=cdt)
+    Sn = Sigma * nm[:, :, None] * nm[:, None, :] \
+        + gwm[:, :, None] * jnp.eye(B, dtype=cdt)
+    diag = jnp.diagonal(Sn, axis1=-2, axis2=-1)
+    dj = 1.0 / jnp.sqrt(diag)
+    A = Sn * dj[:, :, None] * dj[:, None, :]
+    _, Li = tf_chol_factor(A)
+    print("block1 Li finite:", bool(np.isfinite(np.asarray(Li)).all()))
+    evs = np.linalg.eigvalsh(np.asarray(A, np.float64))
+    print("block1 A lambda_min per-pulsar min:", float(evs.min()))
+
+    # per-frequency systems
+    rsin = jnp.asarray(cm.red_sin_ix)
+    rcos = jnp.asarray(cm.red_cos_ix)
+    Kr = int(rsin.shape[1])
+    m = 4 if Kr else 2
+    for k in range(K):
+        gc = [np.asarray(jnp.take(gsin, k, axis=1)),
+              np.asarray(jnp.take(gcos, k, axis=1))]
+        if m == 4:
+            kr = min(k, Kr - 1)
+            gc += [np.asarray(rsin[:, kr]), np.asarray(rcos[:, kr])]
+        c4 = np.clip(np.stack(gc, 1), 0, B - 1)
+        v4 = np.stack([(g >= 0) & (g < B) for g in gc], 1).astype(float)
+        TNTh = np.asarray(TNT, np.float64)
+        Tr = np.take_along_axis(TNTh, c4[:, :, None], axis=1) \
+            * v4[:, :, None]
+        T4 = np.take_along_axis(Tr, np.repeat(c4[:, None, :], m, 1),
+                                axis=2) * v4[:, None, :]
+        Dg = np.asarray(Ginv[k], np.float64) / float(rho[k])
+        Q = np.zeros((m * P, m * P))
+        pr = np.asarray(pinv, np.float64)
+        for i in range(m):
+            for j in range(m):
+                blk = np.diag(T4[:, i, j])
+                if i == j:
+                    if i < 2:
+                        vi = v4[:, i]
+                        blk = blk + Dg * np.outer(vi, vi) \
+                            + np.diag(1.0 - vi)
+                    else:
+                        pri = np.take_along_axis(pr, c4[:, i][:, None],
+                                                 1)[:, 0]
+                        blk = blk + np.diag(np.where(v4[:, i] > 0, pri,
+                                                     1.0))
+                Q[i * P:(i + 1) * P, j * P:(j + 1) * P] = blk
+        qj = 1.0 / np.sqrt(np.diagonal(Q))
+        Aq = Q * qj[:, None] * qj[None, :]
+        ev = np.linalg.eigvalsh(Aq)
+        _, Lq = tf_chol_factor(jnp.asarray(Aq, cdt))
+        print(f"k={k}: lambda_min={ev.min():.3e} lambda_max={ev.max():.3e}"
+              f" tf finite={bool(np.isfinite(np.asarray(Lq)).all())}")
+
+
+if __name__ == "__main__":
+    main()
