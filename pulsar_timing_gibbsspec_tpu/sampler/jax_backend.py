@@ -130,9 +130,13 @@ def tnt_d_seg(cm: CompiledPTA, Nvec, seg_len=GRAM_SEG_LEN):
         pad = nseg * m - N
         Ta = jnp.pad(Ta, ((0, 0), (0, pad), (0, 0)))
         TNa = jnp.pad(TNa, ((0, 0), (0, pad), (0, 0)))
-    G32 = jnp.einsum("psnb,psnc->spbc", TNa.reshape(P, nseg, m, B1),
+    # output order psbc (segment axis where the operands carry it): the
+    # spbc form made XLA materialize a transposed operand copy scratch
+    # of (nseg, C, P, Nmax, B1) — tiling-padded 3.4x, 15.8 GB at C=128,
+    # THE out-of-memory term of wide-chain compiles
+    G32 = jnp.einsum("psnb,psnc->psbc", TNa.reshape(P, nseg, m, B1),
                      Ta.reshape(P, nseg, m, B1), precision="highest")
-    G = jnp.sum(G32.astype(cm.cdtype), axis=0)
+    G = jnp.sum(G32.astype(cm.cdtype), axis=1)
     return G[:, :cm.Bmax, :cm.Bmax], G[:, :cm.Bmax, cm.Bmax]
 
 
@@ -1207,6 +1211,40 @@ def _rho_grid(cm: CompiledPTA, lo, hi):
                                 settings.rho_grid_size, dtype=cm.dtype)
 
 
+#: red-marginalization grid size for the partially-collapsed common-rho
+#: draw (log-spaced over [red_rhomin, red_rhomax]; ~0.1 dex spacing over
+#: the 6-decade prior — the integrand varies on O(1)-dex scales)
+RHO_COLLAPSE_J = 64
+#: opt-in switch for the partially-collapsed draw — measured
+#: net-negative at the bench scale, see _rho_collapsed_applies
+RHO_COLLAPSE = os.environ.get("PTGIBBS_RHO_COLLAPSE", "") == "1"
+
+
+def _rho_collapsed_applies(cm: CompiledPTA) -> bool:
+    """Static predicate: the partially-collapsed common-rho draw applies
+    to CRN models whose per-pulsar free-spectrum red shares the common
+    Fourier columns.
+
+    OPT-IN (``PTGIBBS_RHO_COLLAPSE=1``), measured net-negative on the
+    45-pulsar bench and therefore off by default: collapsing red out of
+    the rho draw cut the common-rho ACT only 49 -> 38 sweeps while its
+    quadrature cost took the sweep from 63.5 to 45.3/s — ess_per_sec
+    75.7 vs 83.2 uncollapsed.  The experiment's real yield is the
+    diagnosis: with red marginalized the ACT barely moved, and the f64
+    oracle (reference blocking) measures ~27 on a chain long enough to
+    resolve it — the funnel is rho <-> b (the coefficients' total power
+    re-drawn against the prior variance they inform, relative step
+    ~1/sqrt(2P) per sweep), intrinsic to the vHV Gibbs blocking on BOTH
+    backends, not the red/common degeneracy this move targets."""
+    # sampled red slots only (red_rho_ix_x < nx): Constant-red models
+    # must keep the conditional draw — marginalizing a FIXED amplitude
+    # over its prior (with no compensating redraw) would target the
+    # wrong posterior
+    return (RHO_COLLAPSE and cm.orf_name == "crn"
+            and cm.red_kind == "free_spectrum" and cm.red_shares_gw
+            and bool(np.any(np.asarray(cm.red_rho_ix_x) < cm.nx)))
+
+
 def rho_update(cm: CompiledPTA, x, b, key):
     """Free-spectrum conditional draw of the common (GW) log10_rho block.
 
@@ -1214,7 +1252,20 @@ def rho_update(cm: CompiledPTA, x, b, key):
     (vHV2014, reference ``pulsar_gibbs.py:215-216``).  Otherwise: per-pulsar
     log-PDF grids summed over the pulsar axis (== the PDF product of
     ``pta_gibbs.py:205``; the sum turns into a ``psum`` over ICI when the
-    pulsar axis is sharded) then Gumbel-max sampled (``:233-234``)."""
+    pulsar axis is sharded) then Gumbel-max sampled (``:233-234``).
+
+    ``PTGIBBS_RHO_COLLAPSE=1`` (opt-in) replaces the shared-column
+    free-spectrum draw with a PARTIALLY-COLLAPSED one: rho_k drawn with
+    the per-pulsar red amplitudes INTEGRATED OUT over their log-uniform
+    prior (a log-spaced ``RHO_COLLAPSE_J``-point quadrature — the same
+    grid-resolution error class as the grid draws themselves), the
+    sweep body redrawing red | rho immediately after
+    (:func:`red_conditional_update`): together an exact blocked draw of
+    (rho, red) | b.  Off by default — measured net-negative; see
+    :func:`_rho_collapsed_applies` for the numbers and for what the
+    experiment actually established (the funnel is rho <-> b, shared
+    with the reference's identical blocking)."""
+    import jax
     import jax.numpy as jnp
     import jax.random as jr
 
@@ -1249,6 +1300,43 @@ def rho_update(cm: CompiledPTA, x, b, key):
         hi = -jnp.expm1(t / cm.rhomax - t / cm.rhomin)
         eta = hi * jr.uniform(k1, t.shape, dtype=cm.cdtype)
         rhonew = t / (t / cm.rhomax - jnp.log1p(-eta))
+    elif _rho_collapsed_applies(cm):
+        grid = _rho_grid(cm, cm.rhomin, cm.rhomax)
+        fdt = cm.dtype
+        grid32 = grid.astype(fdt)
+        lgrid = jnp.log(grid32)
+        ltau = jnp.log(tau).astype(fdt)                 # (P, K)
+        redg = 10.0 ** jnp.linspace(
+            np.log10(cm.red_rhomin), np.log10(cm.red_rhomax),
+            RHO_COLLAPSE_J, dtype=fdt)
+        # (p, k) slots where a SAMPLED red amplitude shares the column
+        # (per-slot: heterogeneous mode counts leave high-k slots of
+        # short-red pulsars red-free, and Constant red params must not
+        # be marginalized — both carry the nx sentinel in red_rho_ix_x)
+        Kr = cm.red_rho_ix_x.shape[1]
+        n = min(cm.K, Kr)
+        samp = jnp.asarray(cm.red_rho_ix_x) < cm.nx      # (P, Kr)
+        ap = jnp.zeros((cm.P, cm.K), bool).at[:, :n].set(samp[:, :n])
+        pmask = jnp.asarray(cm.psr_mask, fdt) > 0
+
+        def per_k(args):
+            ltk, apk = args                             # (P,), (P,)
+            # marginal factor: logsumexp over the red quadrature
+            lr = ltk[:, None, None] - jnp.log(
+                grid32[None, :, None] + redg[None, None, :])
+            lm = jax.nn.logsumexp(lr - jnp.exp(lr), axis=-1) \
+                - jnp.log(jnp.asarray(RHO_COLLAPSE_J, fdt))  # (P, R)
+            # no-red slots keep the plain conditional factor
+            lp = ltk[:, None] - lgrid[None, :]
+            plain = lp - jnp.exp(lp)
+            lm = jnp.where(apk[:, None], lm, plain)
+            return jnp.sum(jnp.where(pmask[:, None], lm,
+                                     jnp.zeros((), fdt)), axis=0)
+
+        # lax.map over K bounds the (P, R, J) transient to one frequency
+        logpdf = jax.lax.map(per_k, (ltau.T, ap.T))     # (K, R)
+        gum = jr.gumbel(key, logpdf.shape, dtype=fdt)
+        rhonew = grid[jnp.argmax(logpdf + gum, axis=-1)]
     else:
         grid = _rho_grid(cm, cm.rhomin, cm.rhomax)
         fdt = cm.dtype
@@ -2084,6 +2172,14 @@ class JaxGibbsDriver:
                     cm.ecorr_par_ix,
                     cm.ecorr_nper, chol_e, ne, record=False,
                     mode=mode_e, asqrt=asq_e)
+            # partially-collapsed rho (shared-column free-spectrum red):
+            # rho is drawn with red marginalized, so the red conditional
+            # must follow IMMEDIATELY — together they form one exact
+            # blocked draw of (rho, red) | b (see rho_update).  All other
+            # models keep the reference's red-then-rho scan order.
+            collapsed = _rho_collapsed_applies(cm)
+            if collapsed and cm.K and len(cm.rho_ix_x):
+                x = rho_update(cm, x, b, k[3])
             if self.do_red_conditional:
                 x = red_conditional_update(cm, x, b, k[2])
             if self.do_tprocess:
@@ -2091,7 +2187,7 @@ class JaxGibbsDriver:
             if self.do_red_mh:
                 x = red_mh_block(cm, x, b, k[5], red_U, red_S,
                                  self.red_steps, hist=red_hist)
-            if cm.K and len(cm.rho_ix_x):
+            if not collapsed and cm.K and len(cm.rho_ix_x):
                 x = rho_update(cm, x, b, k[3])
             if self.do_orf_mh:
                 x, _ = mh_scan(cm, x, k[7], lnlike_orf_fn(cm, b),
@@ -2157,6 +2253,11 @@ class JaxGibbsDriver:
                     cm, x, k[1], ecorr_block_ll(cm, x, b, r),
                     cm.ecorr_par_ix,
                     cm.ecorr_nper, chol, nw, record=False)
+            # rho-first under the partially-collapsed draw (see the main
+            # sweep body): the red conditional must follow it immediately
+            collapsed = _rho_collapsed_applies(cm)
+            if collapsed and cm.K and len(cm.rho_ix_x):
+                x = rho_update(cm, x, b, k[3])
             if self.do_red_conditional:
                 x = red_conditional_update(cm, x, b, k[2])
             if self.do_tprocess:
@@ -2167,7 +2268,7 @@ class JaxGibbsDriver:
                                lambda q: lnlike_hyper_fn(cm, q, b,
                                                          phi_fn=phi_dyn),
                                cm.idx.red, self.red_steps)
-            if cm.K and len(cm.rho_ix_x):
+            if not collapsed and cm.K and len(cm.rho_ix_x):
                 x = rho_update(cm, x, b, k[3])
             if self.do_orf_mh:
                 x, _ = mh_scan(cm, x, k[7], lnlike_orf_fn(cm, b),

@@ -272,6 +272,30 @@ def test_record_every_thins_rows_and_matches_full(j1713, tmp_path):
                    resume=True, save_every=20)
 
 
+def test_rho_collapsed_matches_default(j1713, tmp_path, monkeypatch):
+    """The opt-in partially-collapsed rho draw (PTGIBBS_RHO_COLLAPSE;
+    red amplitudes marginalized by quadrature + rho-first sweep order)
+    must sample the same posterior as the default conditional scan —
+    measured net-negative on throughput at the bench scale but kept as
+    a correct kernel, so it stays covered."""
+    pta = model_general([j1713], tm_svd=True, red_var=True,
+                        red_psd="spectrum", red_components=5,
+                        white_vary=False, common_psd="spectrum",
+                        common_components=5)
+    x0 = pta.initial_sample(np.random.default_rng(6))
+    g0 = PulsarBlockGibbs(pta, backend="jax", seed=71, progress=False)
+    c0 = g0.sample(x0, outdir=str(tmp_path / "default"), niter=1500)
+    monkeypatch.setattr(jb, "RHO_COLLAPSE", True)
+    gc = PulsarBlockGibbs(pta, backend="jax", seed=72, progress=False)
+    assert jb._rho_collapsed_applies(gc._backend.cm)
+    cc = gc.sample(x0, outdir=str(tmp_path / "collapsed"), niter=1500)
+    assert np.all(np.isfinite(cc))
+    idx = BlockIndex.build(pta.param_names)
+    burn = 300
+    _assert_same_law(c0[burn:], cc[burn:],
+                     list(idx.rho) + list(idx.red_rho[:5]))
+
+
 def test_record_every_guards(j1713):
     """Loud rejects: non-divisor chunk, DE-history models, numpy backend
     (jax-only device-transfer options must not die as bare TypeErrors)."""
