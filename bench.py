@@ -129,18 +129,26 @@ def bench_jax(pta, x0, niter, adapt_iters, nchains, profile=False,
     cshape, bshape = drv.chain_shapes(niter)
     chain = np.zeros(cshape)
     bchain = np.zeros(bshape)
+    from pulsar_timing_gibbsspec_tpu import profiling
+
     it = drv.run(x0, chain, bchain, 0, niter)
     done = next(it)            # warmup + adaptation + compilation
     marks = []
     first = True
-    for done in it:
-        if first:
-            # first chunk includes the sweep-kernel compile; restart clock
-            marks = [(done, time.time())]
-            first = False
-        else:
-            # each chunk writeback is an honest device sync
-            marks.append((done, time.time()))
+    with profiling.recompile_counter() as rc:
+        for done in it:
+            if first:
+                # first chunk includes the sweep-kernel compile; restart
+                # the clock and zero the retrace counter with it
+                marks = [(done, time.time())]
+                rc.reset()
+                first = False
+            else:
+                # each chunk writeback is an honest device sync
+                marks.append((done, time.time()))
+    # compiles observed in the steady loop — must be 0; any retrace is
+    # a throughput regression BENCH_*.json should surface
+    n_retraces = rc.events
     # marks count recorded ROWS; one row is record_every sweeps in the
     # steady loop, so sweep rates scale back up by the thinning factor
     # (the raw marks are converted to sweep units too, so steady_sweeps
@@ -158,7 +166,7 @@ def bench_jax(pta, x0, niter, adapt_iters, nchains, profile=False,
         fl = profiling.sweep_flops(drv.cm, nchains=C)
         print(profiling.format_report(times, fl, steady), file=sys.stderr)
         prof = times
-    return steady, windows, C, drv, prof, raw, chain
+    return steady, windows, C, drv, prof, raw, chain, n_retraces
 
 
 def bench_numpy(gibbs, x0, niter, act_iters=0):
@@ -225,7 +233,8 @@ def bench_config(orf, n_psr, niter, np_iters, adapt, nchains, profile,
     if orf != "crn" and len(idx.orf):
         # parameterized/fixed correlated ORFs start at G = identity
         x0[idx.orf] = 0.0
-    jax_rate, windows, C, drv, prof, raw, chain = _retry_transport(
+    jax_rate, windows, C, drv, prof, raw, chain, n_retraces = \
+        _retry_transport(
         lambda: bench_jax(pta, x0, niter, adapt, nchains, profile=profile,
                           record=record, record_every=record_every))
     g = NumpyPTAGibbs(pta, seed=2, white_adapt_iters=adapt)
@@ -240,6 +249,7 @@ def bench_config(orf, n_psr, niter, np_iters, adapt, nchains, profile,
         "rate_windows": [round(w, 2) for w in windows],
         "nchains": C,
         "record_every": record_every,
+        "n_retraces": n_retraces,
         "numpy_sweeps_per_sec": round(np_rate, 3),
         "numpy_rate_windows": [round(w, 3) for w in np_windows],
         "vs_oracle": round(C * jax_rate / np_rate, 2),
@@ -282,7 +292,7 @@ def thinned_probe(orf, n_psr, niter, adapt, nchains, record, k=4):
     idx = BlockIndex.build(pta.param_names)
     if orf != "crn" and len(idx.orf):
         x0[idx.orf] = 0.0
-    rate, windows, C, drv, _, raw, chain = bench_jax(
+    rate, windows, C, drv, _, raw, chain, _ = bench_jax(
         pta, x0, niter, adapt, nchains, profile=False, record=record,
         record_every=k)
     act = _rho_act(chain, idx.rho, min(len(chain) // 4, 200))

@@ -1,0 +1,21 @@
+"""Static analysis (:mod:`.jaxlint`) and runtime guards (:mod:`.guards`)
+for JAX/TPU discipline.
+
+``python -m pulsar_timing_gibbsspec_tpu.analysis <paths>`` runs the
+linter; see :mod:`.jaxlint` for the rule catalogue and
+``docs/LINTING.md`` for the workflow.
+
+:mod:`.guards` is imported lazily (it needs jax); the linter itself is
+pure-stdlib so it works in environments without jax installed.
+"""
+
+from .jaxlint import (RULES, Violation, analyze_file, analyze_paths,
+                      analyze_source)
+from .baseline import (baseline_counts, compare_to_baseline, load_baseline,
+                       write_baseline)
+
+__all__ = [
+    "RULES", "Violation", "analyze_file", "analyze_paths", "analyze_source",
+    "baseline_counts", "compare_to_baseline", "load_baseline",
+    "write_baseline",
+]

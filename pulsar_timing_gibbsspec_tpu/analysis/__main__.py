@@ -1,0 +1,86 @@
+"""CLI: ``python -m pulsar_timing_gibbsspec_tpu.analysis [paths...]``.
+
+Exit status 0 when no violations beyond ``jaxlint_baseline.json``;
+1 otherwise.  ``--write-baseline`` accepts the current state as the new
+ratchet.  ``--ruff`` additionally runs the generic-Python linter (ruff,
+configured in ``pyproject.toml``) over the same paths when it is
+installed, so one command covers both layers.
+"""
+
+from __future__ import annotations
+
+import argparse
+import shutil
+import subprocess
+import sys
+from pathlib import Path
+
+from .baseline import (BASELINE_NAME, _rel, compare_to_baseline,
+                       load_baseline, write_baseline)
+from .jaxlint import analyze_paths, iter_py_files
+
+_PKG_ROOT = Path(__file__).resolve().parents[1]   # the package dir
+_REPO_ROOT = _PKG_ROOT.parent                      # holds the baseline
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="jaxlint",
+        description="AST-based JAX/TPU-discipline linter (rules R1-R6).")
+    ap.add_argument("paths", nargs="*",
+                    help="files or directories (default: the package)")
+    ap.add_argument("--baseline", default=None,
+                    help=f"baseline JSON (default: <repo>/{BASELINE_NAME})")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="accept current violations as the new baseline")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every violation, ignoring the baseline")
+    ap.add_argument("--ruff", action="store_true",
+                    help="also run ruff (generic lint) when installed")
+    args = ap.parse_args(argv)
+
+    paths = [Path(p) for p in args.paths] or [_PKG_ROOT]
+    root = _REPO_ROOT
+    bl_path = Path(args.baseline) if args.baseline else root / BASELINE_NAME
+
+    violations = analyze_paths(paths)
+
+    if args.write_baseline:
+        data = write_baseline(bl_path, violations, root)
+        n = sum(sum(r.values()) for r in data.values())
+        print(f"jaxlint: wrote baseline with {n} violation(s) "
+              f"across {len(data)} file(s) -> {bl_path}")
+        return 0
+
+    baseline = {} if args.no_baseline else load_baseline(bl_path)
+    analyzed = {_rel(str(f), root) for f in iter_py_files(paths)}
+    new, stale = compare_to_baseline(violations, baseline, root, analyzed)
+
+    rc = 0
+    if new:
+        rc = 1
+        print(f"jaxlint: {len(new)} non-baselined violation(s):",
+              file=sys.stderr)
+        for v in new:
+            print(f"  {v}", file=sys.stderr)
+    for f, rule, was, now in stale:
+        print(f"jaxlint: baseline for {f} {rule} is stale "
+              f"({was} -> {now}); run --write-baseline to ratchet down")
+    if rc == 0:
+        n_base = len(violations) - len(new)
+        print(f"jaxlint: OK ({len(violations)} violation(s), "
+              f"{n_base} baselined, 0 new)")
+
+    if args.ruff:
+        exe = shutil.which("ruff")
+        if exe is None:
+            print("jaxlint: ruff not installed; skipping generic lint",
+                  file=sys.stderr)
+        else:
+            r = subprocess.run([exe, "check", *map(str, paths)], check=False)
+            rc = rc or r.returncode
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,83 @@
+"""Baseline ratchet for :mod:`.jaxlint`.
+
+``jaxlint_baseline.json`` (repo root) records the accepted pre-existing
+violations as per-file, per-rule counts::
+
+    {"violations": {"pulsar_timing_gibbsspec_tpu/sampler/jax_backend.py":
+                        {"R4": 7}}}
+
+The CLI fails when any (file, rule) count *exceeds* its baselined value —
+new debt is rejected.  ``tests/test_jaxlint.py`` asserts *equality*, so
+fixing a baselined violation forces the baseline file down with it: the
+count can only shrink.  Regenerate after fixes with
+``python -m pulsar_timing_gibbsspec_tpu.analysis --write-baseline``.
+"""
+
+from __future__ import annotations
+
+import json
+from collections import Counter
+from pathlib import Path
+
+BASELINE_NAME = "jaxlint_baseline.json"
+
+
+def _rel(path: str, root: Path) -> str:
+    p = Path(path)
+    try:
+        return p.resolve().relative_to(root.resolve()).as_posix()
+    except ValueError:
+        return p.as_posix()
+
+
+def baseline_counts(violations, root: Path) -> dict:
+    """(file -> rule -> count) mapping for a violation list."""
+    counts: Counter = Counter(
+        (_rel(v.path, root), v.rule) for v in violations)
+    out: dict = {}
+    for (f, rule), n in sorted(counts.items()):
+        out.setdefault(f, {})[rule] = n
+    return out
+
+
+def load_baseline(path) -> dict:
+    p = Path(path)
+    if not p.exists():
+        return {}
+    return json.loads(p.read_text()).get("violations", {})
+
+
+def write_baseline(path, violations, root: Path) -> dict:
+    data = {"violations": baseline_counts(violations, root)}
+    Path(path).write_text(json.dumps(data, indent=2, sort_keys=True) + "\n")
+    return data["violations"]
+
+
+def compare_to_baseline(violations, baseline: dict, root: Path,
+                        analyzed_files=None):
+    """(new_violations, stale_entries).
+
+    ``new_violations``: violations beyond each (file, rule) baseline
+    count — these fail the build.  ``stale_entries``: baselined
+    (file, rule) pairs whose current count dropped below the baseline —
+    reported so the baseline gets ratcheted down.  ``analyzed_files``
+    (repo-relative posix paths) limits staleness reporting to files that
+    were actually analyzed, so linting a subset does not mistake
+    out-of-scope baseline entries for fixed ones.
+    """
+    current = baseline_counts(violations, root)
+    new = []
+    for v in violations:
+        f = _rel(v.path, root)
+        if current.get(f, {}).get(v.rule, 0) > \
+                baseline.get(f, {}).get(v.rule, 0):
+            new.append(v)
+    stale = []
+    for f, rules in baseline.items():
+        if analyzed_files is not None and f not in analyzed_files:
+            continue
+        for rule, n in rules.items():
+            cur = current.get(f, {}).get(rule, 0)
+            if cur < n:
+                stale.append((f, rule, n, cur))
+    return new, stale

@@ -1,0 +1,133 @@
+"""Runtime guards complementing the static :mod:`.jaxlint` pass.
+
+Three opt-in checks that catch at run time what the AST pass can only
+approximate:
+
+- :func:`no_transfers` — a context manager wiring
+  ``jax.transfer_guard("disallow")`` around compiled-sweep dispatch, so a
+  silent host↔device round-trip (the classic steady-state throughput
+  killer) raises instead of degrading.
+- :class:`RecompileCounter` / :func:`count_recompiles` — counts XLA
+  backend compiles via ``jax.monitoring`` duration events.  After warmup,
+  a steady sweep loop must report **zero**; any retrace is a regression
+  (:mod:`..profiling` re-exports this for ``bench.py``).
+- :func:`debug_nans` — scoped ``jax_debug_nans`` for CI runs chasing a
+  non-finite draw back to its primitive.
+
+All three are no-cost when unused: nothing is registered or toggled at
+import time except a single idle monitoring listener.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax
+
+#: jax.monitoring event recorded once per XLA backend compile.  Verified
+#: against jax 0.4.x: first call of a jitted fn fires >=1 of these, a
+#: cache hit fires none, a retrace (new avals) fires them again.
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+_active_counters: list = []
+_lock = threading.Lock()
+_listener_installed = False
+
+
+def _install_listener():
+    # jax.monitoring has no unregister-one API, so install a single
+    # module-level listener lazily and fan out to active counters.
+    global _listener_installed
+    with _lock:
+        if _listener_installed:
+            return
+
+        def _on_event(event, duration, **kwargs):
+            if _COMPILE_EVENT not in event:
+                return
+            with _lock:
+                for c in _active_counters:
+                    c._bump()
+
+        jax.monitoring.register_event_duration_secs_listener(_on_event)
+        _listener_installed = True
+
+
+class RecompileCounter:
+    """Counts XLA backend compiles while attached.
+
+    >>> with count_recompiles() as rc:
+    ...     f(x)          # warmup compile
+    ...     rc.reset()    # don't charge the warmup
+    ...     f(x)          # steady state
+    >>> rc.events         # 0 -> no retrace
+    """
+
+    def __init__(self):
+        self.events = 0
+
+    def _bump(self):
+        self.events += 1
+
+    def reset(self):
+        """Zero the count (e.g. after the expected warmup compile)."""
+        self.events = 0
+
+    @property
+    def retraced(self) -> bool:
+        return self.events > 0
+
+    def attach(self):
+        _install_listener()
+        with _lock:
+            if self not in _active_counters:
+                _active_counters.append(self)
+        return self
+
+    def detach(self):
+        with _lock:
+            if self in _active_counters:
+                _active_counters.remove(self)
+        return self
+
+
+@contextlib.contextmanager
+def count_recompiles():
+    """Context manager yielding an attached :class:`RecompileCounter`."""
+    rc = RecompileCounter().attach()
+    try:
+        yield rc
+    finally:
+        rc.detach()
+
+
+@contextlib.contextmanager
+def no_transfers(level: str = "disallow"):
+    """Forbid implicit host<->device transfers inside the block.
+
+    Wrap the *dispatch* of an already-compiled sweep (all arguments
+    device-resident) — not warmup, which legitimately transfers while
+    staging constants.  Explicit transfers (``jax.device_put``,
+    ``jnp.asarray(numpy_array)``, ``np.asarray(device_array)``) stay
+    allowed under ``"disallow"``; only implicit conversions raise.
+
+    ``level`` may be ``"disallow"`` (raise), ``"log"`` (warn, for
+    soak runs), or ``"allow"`` (temporarily opt back out inside an
+    enclosing guard).
+    """
+    with jax.transfer_guard(level):
+        yield
+
+
+@contextlib.contextmanager
+def debug_nans(enable: bool = True):
+    """Scoped ``jax_debug_nans``: re-runs the offending primitive
+    un-jitted and raises at the first non-finite output.  Expensive —
+    CI/debug only, never in benchmarked paths."""
+    prev = jax.config.jax_debug_nans
+    jax.config.update("jax_debug_nans", bool(enable))
+    try:
+        yield
+    finally:
+        jax.config.update("jax_debug_nans", prev)

@@ -1,0 +1,612 @@
+"""``jaxlint``: AST-based JAX/TPU-discipline analyzer.
+
+The compiled Gibbs sweep rests on convention-only invariants that nothing
+in the Python language enforces: PRNG keys are single-use, host NumPy must
+not leak into traced code, the TWO_FLOAT f64-emulation contract
+(``sampler/compiled.py``) forbids implicit dtypes in device allocations,
+and jit boundaries must not retrace per sweep.  A silent violation of any
+of these corrupts posteriors rather than crashing (the van Haasteren &
+Vallisneri 2014 conditional draws must be exact), so the rules are
+machine-checked here instead of reviewed by eye.
+
+Rules
+-----
+
+- **R1 prng-key-reuse** — the same key variable is consumed by two
+  ``jax.random.*`` draws with no intervening ``split``/``fold_in``/
+  reassignment.  Tracked per function scope with linear statement flow
+  (branches merge consumed-ness as a union; loop bodies are walked twice
+  so cross-iteration reuse is caught).
+- **R2 host-numpy-in-traced-code** — ``np.*`` calls (on non-constant
+  arguments), ``.item()``/``.tolist()``, or ``float()`` applied to values
+  inside *traced* functions: functions that are jit/vmap/pmap-decorated,
+  wrapped at a call site (``jax.jit(jax.vmap(f))``), passed to
+  ``lax.scan``/``cond``/``while_loop``/``fori_loop``/``switch``/``map``
+  bodies, or (transitively) called by name from such a function in the
+  same module.
+- **R3 implicit-dtype-in-device-code** — ``jnp.zeros/ones/full/empty/
+  asarray/array/eye/linspace`` in traced code without an explicit dtype
+  (keyword or positional) and without an immediate ``.astype(...)``:
+  the TWO_FLOAT contract requires every device allocation to state its
+  precision.
+- **R4 retrace-hazard** — (a) a ``jax.jit``-wrapped callable created and
+  invoked in one expression (fresh jit cache entry — and so a fresh
+  trace/compile — per call); (b) a Python scalar / dict literal passed
+  positionally to a callable assigned from ``jax.jit(...)`` that declares
+  no ``static_argnums``/``static_argnames`` (weak-type flips and literal
+  retraces).
+- **R5 tracer-leak-self-assign** — ``self.<attr> = ...`` inside a traced
+  function body: the attribute captures a tracer that outlives the trace.
+- **R6 debug-leftover** — ``jax.debug.print``/``jax.debug.breakpoint``/
+  ``breakpoint()`` anywhere in library code.
+
+Suppression: a trailing ``# jaxlint: disable=R1`` (comma-separated rules,
+or ``all``) on the violation's first source line suppresses it.
+Pre-existing violations live in ``jaxlint_baseline.json`` (see
+:mod:`.baseline`): new violations fail, the baseline only ratchets down.
+
+The analyzer is purely syntactic — it never imports the code it checks —
+so it is safe on modules with import-time side effects and needs no JAX
+installation.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from pathlib import Path
+
+RULES = {
+    "R1": "prng-key-reuse",
+    "R2": "host-numpy-in-traced-code",
+    "R3": "implicit-dtype-in-device-code",
+    "R4": "retrace-hazard",
+    "R5": "tracer-leak-self-assign",
+    "R6": "debug-leftover",
+}
+
+_PRAGMA_RE = re.compile(r"#\s*jaxlint:\s*disable=([A-Za-z0-9,\s]+)")
+
+#: jax transforms whose function argument becomes traced code
+_TRACING_WRAPPERS = {
+    "jax.jit", "jax.vmap", "jax.pmap", "jax.grad", "jax.value_and_grad",
+    "jax.checkpoint", "jax.remat", "jax.jacfwd", "jax.jacrev", "jax.hessian",
+    # bare names for un-importable contexts (fixtures, `from jax import *`)
+    "jit", "vmap", "pmap",
+}
+#: control-flow primitives -> positions of their traced body arguments
+_BODY_TAKERS = {
+    "jax.lax.scan": (0,),
+    "jax.lax.map": (0,),
+    "jax.lax.cond": (1, 2),
+    "jax.lax.while_loop": (0, 1),
+    "jax.lax.fori_loop": (2,),
+    "jax.lax.switch": (1,),          # a list of branches
+    "jax.lax.associative_scan": (0,),
+    "jax.lax.custom_linear_solve": (0, 1),
+}
+#: jax.random functions that do NOT consume a key's single use
+_KEY_NONCONSUMING = {"split", "fold_in", "key", "PRNGKey", "wrap_key_data",
+                     "key_data", "clone", "key_impl"}
+#: module basenames treated as jax.random when alias resolution fails
+#: (e.g. ``self._jr.split`` in the driver)
+_RANDOMISH_BASES = {"jr", "random", "jrandom"}
+
+#: jnp constructors R3 checks, mapped to the positional index that counts
+#: as an explicit dtype (None = keyword-only in practice)
+_DTYPE_CTORS = {
+    "zeros": 1, "ones": 1, "empty": 1, "full": 2,
+    "asarray": 1, "array": 1, "eye": None, "linspace": None,
+}
+#: np attributes that are compile-time constants, not host-array leaks
+_NP_CONST_ATTRS = {"pi", "e", "inf", "nan", "euler_gamma", "newaxis",
+                   "float32", "float64", "int32", "int64", "uint32",
+                   "bool_", "complex64", "complex128"}
+
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    path: str
+    line: int
+    rule: str
+    msg: str
+
+    def __str__(self):
+        return (f"{self.path}:{self.line}: {self.rule} "
+                f"[{RULES[self.rule]}] {self.msg}")
+
+
+def _pragma_rules(line: str):
+    m = _PRAGMA_RE.search(line)
+    if not m:
+        return set()
+    return {r.strip().upper() for r in m.group(1).split(",") if r.strip()}
+
+
+class _Module:
+    """One parsed module: alias map, parent links, traced-function set."""
+
+    def __init__(self, tree: ast.Module, path: str):
+        self.tree = tree
+        self.path = path
+        self.aliases = self._collect_aliases(tree)
+        self.parents: dict = {}
+        for node in ast.walk(tree):
+            for child in ast.iter_child_nodes(node):
+                self.parents[child] = node
+        self.defs_by_name: dict[str, list] = {}
+        self.all_defs: list = []
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.defs_by_name.setdefault(node.name, []).append(node)
+                self.all_defs.append(node)
+            elif isinstance(node, ast.Lambda):
+                self.all_defs.append(node)
+        self.traced: set = set()
+        self._mark_traced()
+
+    # -- alias resolution ---------------------------------------------------
+
+    @staticmethod
+    def _collect_aliases(tree):
+        """name -> dotted module path, from every import in the module
+        (function-local imports included: this repo imports jax lazily)."""
+        out = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    out[a.asname or a.name.split(".")[0]] = (
+                        a.name if a.asname else a.name.split(".")[0])
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    out[a.asname or a.name] = f"{node.module}.{a.name}"
+        # canonical shorthand: numpy/jax.numpy/jax.random keep their
+        # conventional spellings even if imported under other names
+        canon = {}
+        for name, target in out.items():
+            canon[name] = target
+        return canon
+
+    def qualname(self, node) -> str | None:
+        """Dotted name of an expression, alias-expanded ('jnp.zeros' ->
+        'jax.numpy.zeros'); None for non-name expressions."""
+        parts = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return None
+        base = self.aliases.get(node.id, node.id)
+        parts.append(base)
+        return ".".join(reversed(parts))
+
+    # -- traced-function discovery ------------------------------------------
+
+    def _mark_fn_arg(self, arg):
+        """Mark a function-valued argument (Name / Lambda / nested wrap /
+        list of branches) as traced."""
+        if isinstance(arg, ast.Lambda):
+            self.traced.add(arg)
+        elif isinstance(arg, ast.Name):
+            for d in self.defs_by_name.get(arg.id, []):
+                self.traced.add(d)
+        elif isinstance(arg, (ast.List, ast.Tuple)):
+            for el in arg.elts:
+                self._mark_fn_arg(el)
+        elif isinstance(arg, ast.Call):
+            q = self.qualname(arg.func)
+            if q in _TRACING_WRAPPERS or q == "functools.partial" \
+                    or q == "partial":
+                for a in arg.args:
+                    self._mark_fn_arg(a)
+
+    def _mark_traced(self):
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    target = dec.func if isinstance(dec, ast.Call) else dec
+                    q = self.qualname(target)
+                    if q in _TRACING_WRAPPERS:
+                        self.traced.add(node)
+                    elif q in ("functools.partial", "partial") and \
+                            isinstance(dec, ast.Call) and dec.args:
+                        if self.qualname(dec.args[0]) in _TRACING_WRAPPERS:
+                            self.traced.add(node)
+            elif isinstance(node, ast.Call):
+                q = self.qualname(node.func)
+                if q in _TRACING_WRAPPERS:
+                    for a in node.args:
+                        self._mark_fn_arg(a)
+                elif q in _BODY_TAKERS:
+                    for pos in _BODY_TAKERS[q]:
+                        if pos < len(node.args):
+                            self._mark_fn_arg(node.args[pos])
+        # transitive closure: a function called by name from traced code
+        # runs under the same trace (the module-level kernels in
+        # sampler/jax_backend.py are all reached this way)
+        changed = True
+        while changed:
+            changed = False
+            for fn in list(self.traced):
+                body = fn.body if isinstance(body := fn.body, list) else [body]
+                for stmt in body:
+                    for node in ast.walk(stmt):
+                        if isinstance(node, ast.Call) and \
+                                isinstance(node.func, ast.Name):
+                            for d in self.defs_by_name.get(node.func.id, []):
+                                if d not in self.traced:
+                                    self.traced.add(d)
+                                    changed = True
+
+    def traced_roots(self):
+        """Traced defs whose enclosing function is not itself traced (so
+        each traced subtree is visited exactly once)."""
+        out = []
+        for fn in self.traced:
+            p = self.parents.get(fn)
+            enclosed = False
+            while p is not None:
+                if p in self.traced:
+                    enclosed = True
+                    break
+                p = self.parents.get(p)
+            if not enclosed:
+                out.append(fn)
+        return out
+
+
+# ===========================================================================
+# rule implementations
+# ===========================================================================
+
+def _is_const_expr(node, mod: _Module) -> bool:
+    """Compile-time-constant expression: safe as a host computation even
+    inside traced code (XLA constant-folds it)."""
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, ast.UnaryOp):
+        return _is_const_expr(node.operand, mod)
+    if isinstance(node, ast.BinOp):
+        return (_is_const_expr(node.left, mod)
+                and _is_const_expr(node.right, mod))
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return all(_is_const_expr(e, mod) for e in node.elts)
+    q = mod.qualname(node)
+    if q and q.startswith("numpy."):
+        return q.split(".", 1)[1] in _NP_CONST_ATTRS
+    return False
+
+
+def _np_call_name(node: ast.Call, mod: _Module) -> str | None:
+    q = mod.qualname(node.func)
+    if q and q.startswith("numpy.") and not q.startswith("numpy.random."):
+        return q
+    return None
+
+
+def _jnp_call_name(node: ast.Call, mod: _Module) -> str | None:
+    q = mod.qualname(node.func)
+    if q and q.startswith("jax.numpy."):
+        return q[len("jax.numpy."):]
+    return None
+
+
+def _rand_call(node: ast.Call, mod: _Module) -> str | None:
+    """jax.random function name if this call is (or plausibly is) one."""
+    q = mod.qualname(node.func)
+    if q is None:
+        return None
+    if q.startswith("jax.random."):
+        return q[len("jax.random."):]
+    head, _, fn = q.rpartition(".")
+    if head and head.split(".")[-1] in _RANDOMISH_BASES:
+        return fn
+    return None
+
+
+class _Rule1KeyScan:
+    """Linear-flow key-consumption tracking within one function scope."""
+
+    def __init__(self, mod: _Module, report):
+        self.mod = mod
+        self.report = report
+        self.state: dict[str, bool] = {}
+
+    @staticmethod
+    def _terminates(stmts) -> bool:
+        """Whether a branch body unconditionally leaves the join point."""
+        return bool(stmts) and isinstance(
+            stmts[-1], (ast.Return, ast.Raise, ast.Continue, ast.Break))
+
+    @staticmethod
+    def _token(node) -> str | None:
+        if isinstance(node, (ast.Name, ast.Subscript, ast.Attribute)):
+            try:
+                return ast.unparse(node)
+            except Exception:
+                return None
+        return None
+
+    def _clear(self, token):
+        self.state.pop(token, None)
+        for t in [t for t in self.state
+                  if t.startswith(token + "[") or t.startswith(token + ".")]:
+            self.state.pop(t)
+
+    def _clear_target(self, target):
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for el in target.elts:
+                self._clear_target(el)
+        elif isinstance(target, ast.Starred):
+            self._clear_target(target.value)
+        else:
+            tok = self._token(target)
+            if tok:
+                self._clear(tok)
+
+    def _scan_expr(self, node):
+        if node is None:
+            return
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            fn = _rand_call(sub, self.mod)
+            if fn is None or not sub.args:
+                continue
+            tok = self._token(sub.args[0])
+            if tok is None:
+                continue
+            if fn in _KEY_NONCONSUMING:
+                if fn in ("split", "fold_in"):
+                    self.state[tok] = False
+                continue
+            if self.state.get(tok):
+                self.report(sub, "R1",
+                            f"key '{tok}' consumed again by jax.random.{fn} "
+                            "with no intervening split/reassignment")
+            self.state[tok] = True
+
+    def _walk(self, stmts):
+        for s in stmts:
+            self._stmt(s)
+
+    def _stmt(self, s):
+        if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.ClassDef)):
+            return                       # own scope, scanned separately
+        if isinstance(s, ast.If):
+            self._scan_expr(s.test)
+            before = dict(self.state)
+            self._walk(s.body)
+            after_body = dict(self.state)
+            body_exits = self._terminates(s.body)
+            self.state = dict(before)
+            self._walk(s.orelse)
+            if body_exits:
+                return          # only the else path reaches the join
+            if self._terminates(s.orelse):
+                self.state = after_body
+                return
+            # a key consumed on either branch may be consumed at the join
+            for tok in set(after_body) | set(self.state):
+                self.state[tok] = (after_body.get(tok, False)
+                                   or self.state.get(tok, False))
+        elif isinstance(s, (ast.For, ast.AsyncFor)):
+            self._scan_expr(s.iter)
+            self._clear_target(s.target)
+            # two passes: a draw consuming a loop-invariant key is reuse on
+            # the second iteration
+            self._walk(s.body)
+            self._clear_target(s.target)
+            self._walk(s.body)
+            self._walk(s.orelse)
+        elif isinstance(s, ast.While):
+            self._scan_expr(s.test)
+            self._walk(s.body)
+            self._walk(s.body)
+            self._walk(s.orelse)
+        elif isinstance(s, ast.Try):
+            self._walk(s.body)
+            for h in s.handlers:
+                self._walk(h.body)
+            self._walk(s.orelse)
+            self._walk(s.finalbody)
+        elif isinstance(s, (ast.With, ast.AsyncWith)):
+            for item in s.items:
+                self._scan_expr(item.context_expr)
+            self._walk(s.body)
+        elif isinstance(s, ast.Assign):
+            self._scan_expr(s.value)
+            for t in s.targets:
+                self._clear_target(t)
+        elif isinstance(s, ast.AnnAssign):
+            self._scan_expr(s.value)
+            self._clear_target(s.target)
+        elif isinstance(s, ast.AugAssign):
+            self._scan_expr(s.value)
+        else:
+            for child in ast.iter_child_nodes(s):
+                if isinstance(child, (ast.expr,)):
+                    self._scan_expr(child)
+
+    def run(self, body):
+        self.state = {}
+        self._walk(body)
+
+
+def _scan_traced_subtree(root, mod: _Module, report):
+    """R2/R3/R5 over one traced function's subtree (nested defs included —
+    they execute under the same trace)."""
+    body = root.body if isinstance(root.body, list) else [root.body]
+    for stmt in body:
+        for node in ast.walk(stmt):
+            # R5: stateful writes capture tracers beyond the trace
+            if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                for t in targets:
+                    if isinstance(t, ast.Attribute) and \
+                            isinstance(t.value, ast.Name) and \
+                            t.value.id == "self":
+                        report(node, "R5",
+                               f"assignment to self.{t.attr} inside traced "
+                               "code leaks a tracer into host state")
+            if not isinstance(node, ast.Call):
+                continue
+            # R2: host NumPy / host conversions on traced values
+            npq = _np_call_name(node, mod)
+            if npq is not None and not all(
+                    _is_const_expr(a, mod) for a in node.args):
+                report(node, "R2",
+                       f"host call {npq}(...) on non-constant arguments "
+                       "inside traced code")
+            if isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in ("item", "tolist") and not node.args:
+                report(node, "R2",
+                       f".{node.func.attr}() forces a host transfer inside "
+                       "traced code")
+            if isinstance(node.func, ast.Name) and node.func.id == "float" \
+                    and node.args and not _is_const_expr(node.args[0], mod):
+                report(node, "R2",
+                       "float(...) on a non-constant value inside traced "
+                       "code")
+            # R3: device allocations must state their dtype
+            jname = _jnp_call_name(node, mod)
+            if jname in _DTYPE_CTORS:
+                has_kw = any(k.arg == "dtype" for k in node.keywords)
+                pos = _DTYPE_CTORS[jname]
+                has_pos = pos is not None and len(node.args) > pos
+                parent = mod.parents.get(node)
+                cast_away = (isinstance(parent, ast.Attribute)
+                             and parent.attr == "astype")
+                if not (has_kw or has_pos or cast_away):
+                    report(node, "R3",
+                           f"jnp.{jname}(...) without an explicit dtype in "
+                           "device code (TWO_FLOAT contract: state the "
+                           "precision)")
+
+
+def _scan_r4(mod: _Module, report):
+    """Retrace hazards, module-wide."""
+    jitted: dict[str, bool] = {}   # call token -> has static argnums
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            q = mod.qualname(node.value.func)
+            if q == "jax.jit" or q == "jit":
+                static = any(k.arg in ("static_argnums", "static_argnames")
+                             for k in node.value.keywords)
+                for t in node.targets:
+                    try:
+                        jitted[ast.unparse(t)] = static
+                    except Exception:
+                        pass
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        # (a) immediately-invoked jit wrapper: a fresh cache entry per call
+        if isinstance(node.func, ast.Call):
+            q = mod.qualname(node.func.func)
+            if q in ("jax.jit", "jit"):
+                report(node, "R4",
+                       "jax.jit(...) created and invoked in one "
+                       "expression: a fresh trace/compile on every call")
+        # (b) literal scalars/dicts into a jitted callable
+        try:
+            tok = ast.unparse(node.func)
+        except Exception:
+            continue
+        if tok in jitted and not jitted[tok]:
+            for a in node.args:
+                bad = (isinstance(a, ast.Constant)
+                       and a.value is not None
+                       and not isinstance(a.value, bytes)) or \
+                      isinstance(a, ast.Dict)
+                if bad:
+                    kind = "dict" if isinstance(a, ast.Dict) else "scalar"
+                    report(a, "R4",
+                           f"Python {kind} literal passed positionally to "
+                           f"jitted callable '{tok}' without "
+                           "static_argnums (weak-type/retrace hazard)")
+
+
+def _scan_r6(mod: _Module, report):
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        q = mod.qualname(node.func)
+        if q and q.startswith("jax.debug."):
+            report(node, "R6", f"{q}(...) left in library code")
+        elif isinstance(node.func, ast.Name) and \
+                node.func.id == "breakpoint":
+            report(node, "R6", "breakpoint() left in library code")
+
+
+# ===========================================================================
+# per-file / per-tree analysis
+# ===========================================================================
+
+def analyze_source(src: str, path: str = "<string>") -> list[Violation]:
+    """All violations in one source string (pragmas applied)."""
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as exc:
+        return [Violation(path, exc.lineno or 0, "R6",
+                          f"file does not parse: {exc.msg}")]
+    mod = _Module(tree, path)
+    lines = src.splitlines()
+    raw: list[Violation] = []
+    seen = set()
+
+    def report(node, rule, msg):
+        key = (getattr(node, "lineno", 0), getattr(node, "col_offset", 0),
+               rule)
+        if key in seen:
+            return
+        seen.add(key)
+        raw.append(Violation(path, getattr(node, "lineno", 0), rule, msg))
+
+    # R1 over every function scope plus the module scope
+    scopes = [(mod.tree.body,)] + [
+        (d.body if isinstance(d.body, list) else [ast.Expr(d.body)],)
+        for d in mod.all_defs]
+    for (body,) in scopes:
+        _Rule1KeyScan(mod, report).run(body)
+    # R2/R3/R5 over traced subtrees
+    for root in mod.traced_roots():
+        _scan_traced_subtree(root, mod, report)
+    _scan_r4(mod, report)
+    _scan_r6(mod, report)
+
+    out = []
+    for v in raw:
+        line = lines[v.line - 1] if 0 < v.line <= len(lines) else ""
+        disabled = _pragma_rules(line)
+        if v.rule in disabled or "ALL" in disabled:
+            continue
+        out.append(v)
+    out.sort(key=lambda v: (v.line, v.rule))
+    return out
+
+
+def analyze_file(path) -> list[Violation]:
+    p = Path(path)
+    return analyze_source(p.read_text(), str(p))
+
+
+def iter_py_files(paths):
+    for p in paths:
+        p = Path(p)
+        if p.is_dir():
+            yield from sorted(p.rglob("*.py"))
+        elif p.suffix == ".py":
+            yield p
+
+
+def analyze_paths(paths) -> list[Violation]:
+    out = []
+    for f in iter_py_files(paths):
+        out.extend(analyze_file(f))
+    return out

@@ -135,7 +135,7 @@ def profile_blocks(driver, x, repeats=5, inner=50):
             driver.chol_white, driver.mode_white, driver.asqrt_white))
 
         def white1(x, b, k, chol, mw, aw):
-            r = jnp.asarray(cm.y) - jb.b_matvec(cm, b)
+            r = jnp.asarray(cm.y, cm.dtype) - jb.b_matvec(cm, b)
             xn, _ = jb.parallel_cov_mh_scan(
                 cm, x, k, jb.white_block_ll(cm, x, r, r * r),
                 cm.white_par_ix,
@@ -154,7 +154,7 @@ def profile_blocks(driver, x, repeats=5, inner=50):
             driver.chol_ecorr, driver.mode_ecorr, driver.asqrt_ecorr))
 
         def ecorr1(x, b, k, chol, me, ae):
-            r = jnp.asarray(cm.y) - jb.b_matvec(cm, b)
+            r = jnp.asarray(cm.y, cm.dtype) - jb.b_matvec(cm, b)
             xn, _ = jb.parallel_cov_mh_scan(
                 cm, x, k, jb.ecorr_block_ll(cm, x, b, r), cm.ecorr_par_ix,
                 cm.ecorr_nper, chol, ne, record=False, mode=me, asqrt=ae)
@@ -293,3 +293,21 @@ def trace(outdir: str):
         yield
     finally:
         jax.profiler.stop_trace()
+
+
+def recompile_counter():
+    """Attached :class:`~.analysis.guards.RecompileCounter` context manager.
+
+    Counts XLA backend compiles (via ``jax.monitoring``) inside the
+    block; after warmup a steady sweep loop must report zero.  Re-exported
+    here so benchmarking code (``bench.py``) gets the retrace counter from
+    the same module as the timers::
+
+        with recompile_counter() as rc:
+            warmup(); rc.reset()
+            run_steady_loop()
+        assert not rc.retraced, rc.events
+    """
+    from .analysis.guards import count_recompiles
+
+    return count_recompiles()

@@ -79,8 +79,8 @@ def tnt_d(cm: CompiledPTA, Nvec):
     benign f32 rounding of the stored basis (backward error)."""
     import jax.numpy as jnp
 
-    Ta = jnp.concatenate([jnp.asarray(cm.T),
-                          jnp.asarray(cm.y)[:, :, None]], axis=2)
+    Ta = jnp.concatenate([jnp.asarray(cm.T, cm.dtype),
+                          jnp.asarray(cm.y, cm.dtype)[:, :, None]], axis=2)
     TNa = Ta / Nvec.astype(cm.dtype)[:, :, None]
     G = jnp.einsum("pnb,pnc->pbc", TNa, Ta,
                    preferred_element_type=cm.cdtype)
@@ -120,8 +120,8 @@ def tnt_d_seg(cm: CompiledPTA, Nvec, seg_len=GRAM_SEG_LEN):
     unit noise contribute exactly zero to every segment."""
     import jax.numpy as jnp
 
-    Ta = jnp.concatenate([jnp.asarray(cm.T),
-                          jnp.asarray(cm.y)[:, :, None]], axis=2)
+    Ta = jnp.concatenate([jnp.asarray(cm.T, cm.dtype),
+                          jnp.asarray(cm.y, cm.dtype)[:, :, None]], axis=2)
     TNa = Ta / Nvec.astype(cm.dtype)[:, :, None]
     P, N, B1 = Ta.shape
     nseg = max(1, -(-N // int(seg_len)))
@@ -148,7 +148,7 @@ def ke_segsum(cm: CompiledPTA, vals):
     E = cm.ke_par_ix.shape[1]
     shape = (cm.P, E + 1) + vals.shape[2:]
     out = jnp.zeros(shape, vals.dtype)
-    return out.at[jnp.arange(cm.P)[:, None], jnp.asarray(cm.ke_eid)].add(vals)
+    return out.at[jnp.arange(cm.P)[:, None], jnp.asarray(cm.ke_eid, jnp.int32)].add(vals)
 
 
 def ke_weights(cm: CompiledPTA, x, Nvec):
@@ -179,8 +179,8 @@ def tnt_d_ke(cm: CompiledPTA, Nvec, w):
     import jax.numpy as jnp
 
     TNT, d = tnt_d(cm, Nvec)
-    Ta = jnp.concatenate([jnp.asarray(cm.T),
-                          jnp.asarray(cm.y)[:, :, None]], axis=2)
+    Ta = jnp.concatenate([jnp.asarray(cm.T, cm.dtype),
+                          jnp.asarray(cm.y, cm.dtype)[:, :, None]], axis=2)
     TNa = (Ta / Nvec.astype(cm.dtype)[:, :, None]).astype(cm.cdtype)
     V = ke_segsum(cm, TNa)[:, :-1]                   # (P, E, B+1)
     corr = jnp.einsum("peb,pe,pec->pbc", V, w.astype(cm.cdtype), V,
@@ -291,7 +291,7 @@ def lnlike_fullmarg_fn(cm: CompiledPTA, x, TNT, d):
         # kernel-ECORR: N is the Woodbury block matrix (TNT/d passed in
         # must come from tnt_d_x); correct logdet N and y^T N^-1 y
         out = out + jnp.sum(ke_ll_corr(
-            cm, x, N, ke_rz(cm, N, jnp.asarray(cm.y))))
+            cm, x, N, ke_rz(cm, N, jnp.asarray(cm.y, cm.dtype))))
     logdet_phi = jnp.sum(jnp.log(phi), axis=-1)
     Sigma = TNT + _batched_diag(1.0 / phi)
     # matmul-scheduled factorization (same arithmetic as the native f64
@@ -402,8 +402,8 @@ def draw_b_hd_sequential(cm: CompiledPTA, x, b, key, exact=False):
     rows_p = jnp.arange(P)[:, None]
     rho = 10.0 ** (2.0 * jnp.asarray(x, cdt)[cm.rho_ix_x])       # (K,)
     Ginv = cm.orf_ginv_k(x).astype(cdt)            # (K, P, P)
-    gsin = jnp.asarray(cm.gw_sin_ix)
-    gcos = jnp.asarray(cm.gw_cos_ix)
+    gsin = jnp.asarray(cm.gw_sin_ix, jnp.int32)
+    gcos = jnp.asarray(cm.gw_cos_ix, jnp.int32)
     live_mask = jnp.asarray(cm.psr_mask, cdt)
 
     # batched factorization of every pulsar's conditional precision:
@@ -563,8 +563,8 @@ def draw_b_hd_freqblock(cm: CompiledPTA, x, b, key, exact=False):
     rows_p = jnp.arange(P)[:, None]
     rho = 10.0 ** (2.0 * jnp.asarray(x, cdt)[cm.rho_ix_x])        # (K,)
     Ginv = cm.orf_ginv_k(x).astype(cdt)                 # (K, P, P)
-    gsin = jnp.asarray(cm.gw_sin_ix)
-    gcos = jnp.asarray(cm.gw_cos_ix)
+    gsin = jnp.asarray(cm.gw_sin_ix, jnp.int32)
+    gcos = jnp.asarray(cm.gw_cos_ix, jnp.int32)
     cols = jnp.concatenate([gsin, gcos], axis=1)        # (P, 2K)
     valid = ((cols >= 0) & (cols < B)).astype(cdt)
     ccl = jnp.clip(cols, 0, B - 1)
@@ -598,9 +598,9 @@ def draw_b_hd_freqblock(cm: CompiledPTA, x, b, key, exact=False):
     # ---- block 2: per-frequency joint draw across pulsars -----------------
     # m coordinate groups of P: gw sin, gw cos (+ red sin, red cos at the
     # paired frequency index when the model has intrinsic red columns)
-    rsin = (jnp.asarray(cm.red_sin_ix) if cm.red_sin_ix is not None
+    rsin = (jnp.asarray(cm.red_sin_ix, jnp.int32) if cm.red_sin_ix is not None
             else jnp.zeros((P, 0), jnp.int32))
-    rcos = (jnp.asarray(cm.red_cos_ix) if cm.red_cos_ix is not None
+    rcos = (jnp.asarray(cm.red_cos_ix, jnp.int32) if cm.red_cos_ix is not None
             else jnp.zeros((P, 0), jnp.int32))
     Kr = int(rsin.shape[1])
     # shared-column models have no separate red columns to fold in (and
@@ -751,7 +751,7 @@ def _mh_step(cm: CompiledPTA, lnlike, ind):
     scales = jnp.asarray(_SCALES, dtype=cm.cdtype)
     probs = jnp.asarray(_SCALE_P, dtype=cm.cdtype)
     prop = jnp.asarray(cm.prop_scale, dtype=cm.cdtype)
-    ind = jnp.asarray(ind)
+    ind = jnp.asarray(ind, jnp.int32)
 
     def step(carry, key):
         x, ll0, lp0 = carry
@@ -819,8 +819,8 @@ def parallel_cov_mh_scan(cm: CompiledPTA, x, key, ll_per_fn, par_ix, nper,
     fdt = cm.dtype
     scales = jnp.asarray(_SCALES, dtype=fdt)
     probs = jnp.asarray(_SCALE_P, dtype=fdt)
-    nper = jnp.asarray(nper)
-    par_ix = jnp.asarray(par_ix)
+    nper = jnp.asarray(nper, jnp.int32)
+    par_ix = jnp.asarray(par_ix, jnp.int32)
     W = par_ix.shape[1]
     wmask = (jnp.arange(W)[None, :] < nper[:, None]).astype(fdt)
     live = nper > 0
@@ -919,15 +919,15 @@ def laplace_newton_chol(cm: CompiledPTA, x, ll_per_fn, par_ix, nper,
 
     P, W = par_ix.shape
     cdt = cm.cdtype
-    par_ix = jnp.asarray(par_ix)
-    nper = jnp.asarray(nper)
+    par_ix = jnp.asarray(par_ix, jnp.int32)
+    nper = jnp.asarray(nper, jnp.int32)
     safe_ix = jnp.minimum(par_ix, cm.nx - 1)
     wmask = jnp.arange(W)[None, :] < nper[:, None]          # (P, W) bool
     live = nper > 0
 
     hw2 = jnp.asarray(_prior_halfwidth2(cm), cdt)[safe_ix]  # (P, W)
     vmax = jnp.max(jnp.where(wmask, hw2, 1e-30), axis=1)    # (P,)
-    pk = jnp.asarray(cm.pkind)[safe_ix]
+    pk = jnp.asarray(cm.pkind, jnp.int32)[safe_ix]
     a = jnp.asarray(cm.pa, cdt)[safe_ix]
     b_ = jnp.asarray(cm.pb, cdt)[safe_ix]
     lo = jnp.where(pk == 1, a - 8.0 * b_, a)
@@ -1158,7 +1158,7 @@ def red_mh_block(cm: CompiledPTA, x, b, key, U, S, nsteps, hist=None):
 
     def step(carry, key):
         x, ll0, lp0 = carry
-        k0, k1, k2, k3, k4, k5, k6 = jr.split(key, 7)
+        k0, k1, k2, k3, k4, k5, k6, k7, k8 = jr.split(key, 9)
         # SCAM branch: jump along one adapted covariance eigendirection
         j = jr.randint(k1, (), 0, d)
         stepsz = 2.38 * jnp.sqrt(S[j]) * jr.normal(k2, dtype=cm.cdtype)
@@ -1168,8 +1168,8 @@ def red_mh_block(cm: CompiledPTA, x, b, key, U, S, nsteps, hist=None):
         z_am = jr.normal(k6, (d,), dtype=cm.cdtype)
         q_am = x.at[rind].add(am_scale * (am_sqrt @ z_am))
         # single-site branch
-        scale = jr.choice(k1, scales, p=probs)
-        jj = rind[jr.randint(k2, (), 0, d)]
+        scale = jr.choice(k7, scales, p=probs)
+        jj = rind[jr.randint(k8, (), 0, d)]
         q_ss = x.at[jj].add(jr.normal(k3, dtype=cm.cdtype) * sigma * scale)
         r = jr.uniform(k0)
         if use_de:
@@ -1293,14 +1293,14 @@ def rho_scale_moves(cm: CompiledPTA, x, b, u, key):
     cdt = cm.cdtype
     fdt = cm.dtype
     B, P, K = cm.Bmax, cm.P, cm.K
-    gsin = jnp.asarray(cm.gw_sin_ix)
-    gcos = jnp.asarray(cm.gw_cos_ix)
+    gsin = jnp.asarray(cm.gw_sin_ix, jnp.int32)
+    gcos = jnp.asarray(cm.gw_cos_ix, jnp.int32)
     live = jnp.asarray(cm.psr_mask, cdt)
     redv = cm.red_phi(x)                                  # (P, K) aligned
     N = cm.ndiag_fast(x)
     toam = jnp.asarray(cm.toa_mask, fdt)
     invN = toam / N.astype(fdt)
-    y = jnp.asarray(cm.y)
+    y = jnp.asarray(cm.y, cm.dtype)
     lo = np.log(cm.rhomin)
     hi = np.log(cm.rhomax)
     pr_ar = jnp.arange(P)
@@ -1320,9 +1320,9 @@ def rho_scale_moves(cm: CompiledPTA, x, b, u, key):
         bc = b[pr_ar, ck] * vc
         # two-column matvec: this frequency's contribution to u = T b
         Ts = jnp.take_along_axis(
-            jnp.asarray(cm.T), sk[:, None, None], axis=2)[:, :, 0]
+            jnp.asarray(cm.T, cm.dtype), sk[:, None, None], axis=2)[:, :, 0]
         Tc = jnp.take_along_axis(
-            jnp.asarray(cm.T), ck[:, None, None], axis=2)[:, :, 0]
+            jnp.asarray(cm.T, cm.dtype), ck[:, None, None], axis=2)[:, :, 0]
         t = (Ts * bs.astype(fdt)[:, None] + Tc * bc.astype(fdt)[:, None])
         # white-likelihood delta for u -> u + delta * t
         delta = (jnp.exp(0.5 * z) - 1.0).astype(fdt)
@@ -1330,7 +1330,7 @@ def rho_scale_moves(cm: CompiledPTA, x, b, u, key):
         dll = (delta * jnp.sum(r * t * invN)
                - 0.5 * delta * delta * jnp.sum(t * t * invN))
         # prior delta: tau' = e^z tau against phi' = e^z rho + red
-        rix = jnp.asarray(cm.rho_ix_x)[k]
+        rix = jnp.asarray(cm.rho_ix_x, jnp.int32)[k]
         lrho = 2.0 * np.log(10.0) * jnp.asarray(x, cdt)[rix]  # ln rho
         rho = jnp.exp(lrho)
         tau = 0.5 * (bs * bs + bc * bc)                       # (P,)
@@ -1433,7 +1433,7 @@ def rho_update(cm: CompiledPTA, x, b, key):
         # be marginalized — both carry the nx sentinel in red_rho_ix_x)
         Kr = cm.red_rho_ix_x.shape[1]
         n = min(cm.K, Kr)
-        samp = jnp.asarray(cm.red_rho_ix_x) < cm.nx      # (P, Kr)
+        samp = jnp.asarray(cm.red_rho_ix_x, jnp.int32) < cm.nx      # (P, Kr)
         ap = jnp.zeros((cm.P, cm.K), bool).at[:, :n].set(samp[:, :n])
         pmask = jnp.asarray(cm.psr_mask, fdt) > 0
 
@@ -1637,7 +1637,7 @@ def _logpi_b_per(cm: CompiledPTA, x, b, u):
 
     fdt = cm.dtype
     N = cm.ndiag_fast(x)
-    t1 = ((-0.5 * u + jnp.asarray(cm.y)) * (u / N)
+    t1 = ((-0.5 * u + jnp.asarray(cm.y, cm.dtype)) * (u / N)
           * jnp.asarray(cm.toa_mask, fdt))
     phi32 = cm.phi(x, dtype=fdt)
     bb = b.astype(fdt)
@@ -1776,7 +1776,7 @@ def residual_sq(cm: CompiledPTA, b):
     deltas can resolve anyway."""
     import jax.numpy as jnp
 
-    r = jnp.asarray(cm.y) - jnp.einsum("pnb,pb->pn", cm.T,
+    r = jnp.asarray(cm.y, cm.dtype) - jnp.einsum("pnb,pb->pn", cm.T,
                                        b.astype(cm.dtype),
                                        precision="highest")
     return r * r
@@ -1804,7 +1804,7 @@ class JaxGibbsDriver:
                  pad_pulsars=None, mesh=None, warmup_sweeps=50,
                  warmup_white_steps=16, white_steps_max=64, nchains=1,
                  exact_every=EXACT_EVERY, record_precision=None,
-                 record_every=1):
+                 record_every=1, transfer_guard=False):
         settings.apply()
         import jax
         import jax.random as jr
@@ -1870,6 +1870,11 @@ class JaxGibbsDriver:
             raise ValueError(
                 f"record_every={self.record_every} must divide "
                 f"chunk_size={self.chunk_size}")
+        #: when True, every steady-loop chunk dispatch runs under
+        #: ``jax.transfer_guard("disallow")`` (analysis.guards.no_transfers)
+        #: so an implicit host<->device round-trip sneaking into the hot
+        #: path raises instead of silently serializing the sweep
+        self.transfer_guard = bool(transfer_guard)
         self.warmup_sweeps = warmup_sweeps
         self.warmup_white_steps = warmup_white_steps
         self.exact_every = int(exact_every)
@@ -2040,7 +2045,7 @@ class JaxGibbsDriver:
             self.key, k = jr.split(self.key)
 
             def rec_white(x, b, k, chol, mode, asq):
-                r = jax.numpy.asarray(cm.y) - b_matvec(cm, b)
+                r = jax.numpy.asarray(cm.y, cm.dtype) - b_matvec(cm, b)
                 return parallel_cov_mh_scan(
                     cm, x, k, white_block_ll(cm, x, r, r * r),
                     cm.white_par_ix,
@@ -2070,7 +2075,7 @@ class JaxGibbsDriver:
         if len(cm.idx.ecorr) and (cm.ec_cols.shape[1] or cm.has_ke):
             def lap_ec(x, b):
                 if cm.has_ke:
-                    r = jax.numpy.asarray(cm.y) - b_matvec(cm, b)
+                    r = jax.numpy.asarray(cm.y, cm.dtype) - b_matvec(cm, b)
                     curv = ecorr_ll_ke(cm, x, r)
                 else:
                     curv = lambda q: lnlike_ecorr_per(cm, q, b)
@@ -2086,7 +2091,7 @@ class JaxGibbsDriver:
             self.key, k = jr.split(self.key)
 
             def rec_ec(x, b, k, chol, mode, asq):
-                r = jax.numpy.asarray(cm.y) - b_matvec(cm, b)
+                r = jax.numpy.asarray(cm.y, cm.dtype) - b_matvec(cm, b)
                 return parallel_cov_mh_scan(
                     cm, x, k, ecorr_block_ll(cm, x, b, r), cm.ecorr_par_ix,
                     cm.ecorr_nper, chol, self.white_adapt_iters,
@@ -2204,6 +2209,18 @@ class JaxGibbsDriver:
 
     # ---- per-sweep kernel ---------------------------------------------------
 
+    def _dispatch_guard(self):
+        """Transfer guard for a compiled-chunk dispatch: active only when
+        the driver was built with ``transfer_guard=True``.  Arguments are
+        staged with explicit ``jnp.asarray`` (allowed under "disallow"),
+        so anything the guard trips on is a genuine implicit transfer."""
+        import contextlib
+
+        from ..analysis.guards import no_transfers
+
+        return no_transfers() if self.transfer_guard \
+            else contextlib.nullcontext()
+
     def _aux(self, chain=None, ii=None):
         """Per-chain adaptation state passed to the sweep body as explicit
         jit arguments (never closure constants: a cached chunk function
@@ -2276,7 +2293,7 @@ class JaxGibbsDriver:
             out = (x, b)
             k = jr.split(key, 9)
             # the cached u = T b makes the white residual free
-            r = jnp.asarray(cm.y) - u
+            r = jnp.asarray(cm.y, cm.dtype) - u
             if len(cm.idx.white) and nw:
                 x, _ = parallel_cov_mh_scan(
                     cm, x, k[0], white_block_ll(cm, x, r, r * r),
@@ -2347,7 +2364,7 @@ class JaxGibbsDriver:
             x, b, u = carry
             out = (x, b)
             k = jr.split(key, 9)
-            r = jax.numpy.asarray(cm.y) - u
+            r = jax.numpy.asarray(cm.y, cm.dtype) - u
             if len(cm.idx.white):
                 # Laplace proposal square roots recomputed at the current
                 # state each warmup sweep (W HVPs + a batched WxW eigh,
@@ -2501,7 +2518,8 @@ class JaxGibbsDriver:
             # the dominant transfer ships only real columns, and the host
             # writeback is a dtype cast instead of a 40 MB fancy gather
             bs_flat = bs_rec.astype(self.rdtype)[
-                :, :, jnp.asarray(self._b_pi), jnp.asarray(self._b_ci)]
+                :, :, jnp.asarray(self._b_pi, jnp.int32),
+                jnp.asarray(self._b_ci, jnp.int32)]
             # the x record ships in the record dtype too: at C=64 the f64
             # (chunk, C, nx) stack is 28.2 MB/chunk — 43% of the b payload
             # — over the ~18 MB/s tunnel (tools/chunk_probe.py), and the
@@ -2700,9 +2718,14 @@ class JaxGibbsDriver:
         pending = None          # (row, m, xs, bs, x_end, b_end, it_end)
 
         def _writeback(row, m, xs, bs, x_end, b_end, it_end):
-            xs_h = self._squeeze(np.asarray(xs, dtype=np.float64))
+            # a trailing short chunk records extra rows (the compiled
+            # chunk always runs full length); truncate HOST-side — an
+            # eager device xs[:m] would dispatch with a host scalar
+            # operand, an implicit transfer the transfer_guard mode
+            # (rightly) rejects
+            xs_h = self._squeeze(np.asarray(xs, dtype=np.float64))[:m]
             self._check_finite(xs_h, row, "chain state")
-            bs_h = self._squeeze(np.asarray(bs, np.float64))
+            bs_h = self._squeeze(np.asarray(bs, np.float64))[:m]
             self._check_finite(bs_h, row, "b coefficients")
             chain[row:row + m] = xs_h
             bchain[row:row + m] = bs_h
@@ -2728,13 +2751,16 @@ class JaxGibbsDriver:
             # its off-residue chunk function.
             off = (it_base - ii) % self.record_every
             fn = self._chunk_fn(self.chunk_size, off)
-            x, b_dev, xs, bs = fn(x, b_dev, self.key,
-                                  jnp.asarray(ii, dtype=jnp.int32),
-                                  self._aux(chain, ii),
-                                  jnp.asarray(n, jnp.int32))
+            # stage every argument BEFORE the dispatch with explicit
+            # device_put (jnp.asarray of a Python scalar is an IMPLICIT
+            # transfer and would trip the guard); the dispatch itself is
+            # then transfer-free under transfer_guard("disallow")
+            dput = self._jax.device_put
+            args = (x, b_dev, self.key, dput(np.int32(ii)),
+                    self._aux(chain, ii), dput(np.int32(n)))
+            with self._dispatch_guard():
+                x, b_dev, xs, bs = fn(*args)
             m = max(0, -(-(n - off) // self.record_every))
-            if n < self.chunk_size:
-                xs, bs = xs[:m], bs[:m]
             if pending is not None:
                 # start both host copies in flight together before the
                 # blocking conversions (the b-record is the big payload).

@@ -22,7 +22,8 @@ import time
 
 import numpy as np
 
-sys.path.insert(0, ".")
+if __name__ == "__main__":   # script bootstrap; no import side effects
+    sys.path.insert(0, ".")
 from tools.gram_probe import tnt_d_nseg  # noqa: E402
 
 

@@ -29,7 +29,8 @@ import time
 
 import numpy as np
 
-sys.path.insert(0, ".")
+if __name__ == "__main__":   # script bootstrap; no import side effects
+    sys.path.insert(0, ".")
 
 
 def tnt_d_nseg(cm, Nvec, nseg):
@@ -38,8 +39,8 @@ def tnt_d_nseg(cm, Nvec, nseg):
     names distinct so the probe sweep over nseg is unambiguous)."""
     import jax.numpy as jnp
 
-    Ta = jnp.concatenate([jnp.asarray(cm.T),
-                          jnp.asarray(cm.y)[:, :, None]], axis=2)
+    Ta = jnp.concatenate([jnp.asarray(cm.T, cm.dtype),
+                          jnp.asarray(cm.y, cm.dtype)[:, :, None]], axis=2)
     TNa = Ta / Nvec.astype(cm.dtype)[:, :, None]
     P, N, B1 = Ta.shape
     m = N // nseg

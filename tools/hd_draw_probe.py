@@ -16,7 +16,8 @@ import sys
 
 import numpy as np
 
-sys.path.insert(0, ".")
+if __name__ == "__main__":   # script bootstrap; no import side effects
+    sys.path.insert(0, ".")
 
 
 def main():

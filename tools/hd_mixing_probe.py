@@ -27,8 +27,9 @@ import sys
 
 import numpy as np
 
-sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if __name__ == "__main__":   # script bootstrap; no import side effects
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 REFDATA = os.environ.get("PTGIBBS_REFDATA", "/root/reference/simulated_data")
 

@@ -35,7 +35,8 @@ import sys
 
 import numpy as np
 
-sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+if __name__ == "__main__":   # script bootstrap; no import side effects
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 DAY = 86400.0
 REFDATA = os.environ.get("PTGIBBS_REFDATA", "/root/reference/simulated_data")

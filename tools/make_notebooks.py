@@ -23,8 +23,9 @@ PREAMBLE = '''\
 import os, sys
 # CPU-pinned for hermetic execution; delete this line on a TPU host and
 # the same cells run on the accelerator unchanged.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-sys.path.insert(0, "..")
+if __name__ == "__main__":   # script bootstrap; no import side effects
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    sys.path.insert(0, "..")
 import numpy as np
 REFDATA = os.environ.get("PTGIBBS_REFDATA", "/root/reference/simulated_data")
 '''

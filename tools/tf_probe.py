@@ -17,7 +17,8 @@ import time
 
 import numpy as np
 
-sys.path.insert(0, ".")
+if __name__ == "__main__":   # script bootstrap; no import side effects
+    sys.path.insert(0, ".")
 
 
 def main():
@@ -139,6 +140,7 @@ def main():
     t_body(factor_f64, "gram_seg + blocked_chol_inv f64")
 
     def full_tf_draw(x1, b1, k1):
+        k1, ku = jr.split(k1)
         u1 = jb.b_matvec(cm, b1)
         A, dj, d = build_A(x1)
         L, Li = tf_chol_factor(A)
@@ -154,7 +156,7 @@ def main():
         logq_new = -0.5 * jnp.sum(z * z, axis=1)
         logr = (lpi_new - lpi_old) + (logq_old - logq_new)
         ok = jnp.all(jnp.isfinite(bp), axis=1) & jnp.isfinite(logr)
-        logu = jnp.log(jr.uniform(k1, (cm.P,), cm.cdtype))
+        logu = jnp.log(jr.uniform(ku, (cm.P,), cm.cdtype))
         acc = ok & (logr > logu)
         return x1, jnp.where(acc[:, None], bp, b1)
 
