@@ -1,0 +1,26 @@
+"""Resilient sampling runtime: supervised runs, checkpoint integrity,
+divergence sentinels, deterministic fault injection.
+
+The sampler facade promises bit-exact resume (sampler/chains.py); this
+package defends that promise in production: ``integrity`` makes the
+checkpoint set verifiable (manifest + rotating .bak), ``sentinels``
+catches diverged/stuck chains before they reach disk, ``supervisor``
+retries transient failures with capped backoff and degrades jax ->
+numpy after repeated device faults, and ``faults`` injects every one of
+those failures deterministically so ``tests/test_chaos.py`` can prove
+recovery is bit-identical to an uninterrupted run.  See
+docs/RESILIENCE.md.
+"""
+
+from . import faults, integrity, sentinels, telemetry
+from .integrity import CheckpointError
+from .sentinels import ChainDivergence, SentinelMonitor
+from .supervisor import (SupervisorReport, backoff_delay, classify_failure,
+                         run_supervised)
+
+__all__ = [
+    "faults", "integrity", "sentinels", "telemetry",
+    "CheckpointError", "ChainDivergence", "SentinelMonitor",
+    "SupervisorReport", "backoff_delay", "classify_failure",
+    "run_supervised",
+]

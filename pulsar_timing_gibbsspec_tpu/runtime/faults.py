@@ -1,0 +1,169 @@
+"""Deterministic fault injection for the resilience chaos suite.
+
+Production code calls the seam hooks (:func:`fire`, :func:`mutate_rows`)
+at well-defined points; with nothing armed they are near-free no-ops
+(one list check).  Tests arm faults with :func:`inject` (or the
+:func:`injected` context manager) and the hooks then raise or corrupt
+deterministically at the requested recorded row, so every failure mode
+in ``docs/RESILIENCE.md`` is reproducible bit-for-bit.
+
+Seam points (``fire``):
+
+- ``"chainstore.between_replaces"`` — inside ``ChainStore.save``,
+  after ``chain.npy`` was replaced but before ``bchain.npy`` (the torn
+  checkpoint window); ``row`` is the checkpoint row count.
+- ``"chainstore.post_save"`` — after the full checkpoint set including
+  ``manifest.json`` hit disk (file-corruption kinds damage files here).
+- ``"sample.loop"`` — in the facade's sweep loop, after the newly
+  recorded rows passed the sentinels; ``row`` is the rows done so far.
+
+Fault kinds:
+
+- ``"crash"``          raise :class:`InjectedCrash` at a fire point
+  (simulated preemption / SIGKILL — the caller gets no chance to clean
+  up past that statement).
+- ``"xla_error"``      raise :class:`XlaRuntimeError` at a fire point
+  (stand-in for a device/runtime failure; the supervisor classifies it
+  by type name, same as the real ``jaxlib`` exception).
+- ``"nan_rows"``       overwrite recorded chain/bchain rows with NaN via
+  ``mutate_rows`` (simulated diverged chunk output).
+- ``"truncate_file"``  cut the target file to half its size at a fire
+  point with ``outdir`` (torn write / disk-full artifact).
+- ``"corrupt_file"``   overwrite a few bytes mid-file (bit rot).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+from dataclasses import dataclass
+
+import numpy as np
+
+
+class InjectedCrash(RuntimeError):
+    """Simulated hard kill (e.g. preemption between checkpoint replaces)."""
+
+
+class XlaRuntimeError(RuntimeError):
+    """Stand-in for ``jaxlib``'s XlaRuntimeError.
+
+    The supervisor's :func:`~..runtime.supervisor.classify_failure`
+    matches device failures by type NAME, so the injected and the real
+    exception take exactly the same recovery path without this module
+    importing jaxlib.
+    """
+
+
+@dataclass
+class _Fault:
+    kind: str
+    point: str | None = None    # required seam, None = any fire point
+    at_row: int | None = None   # trigger once row >= at_row
+    times: int = 1              # max firings before self-disarm
+    backend: str | None = None  # only fire for this backend name
+    path: str | None = None     # target file for file-damage kinds
+    fired: int = 0
+
+
+_armed: list[_Fault] = []
+_lock = threading.Lock()
+
+
+def inject(kind, point=None, at_row=None, times=1, backend=None, path=None):
+    """Arm a fault; returns the handle (remove with :func:`clear`)."""
+    f = _Fault(kind=kind, point=point, at_row=at_row, times=times,
+               backend=backend, path=path)
+    with _lock:
+        _armed.append(f)
+    return f
+
+
+def clear() -> None:
+    """Disarm every fault (tests call this in teardown)."""
+    with _lock:
+        _armed.clear()
+
+
+@contextlib.contextmanager
+def injected(kind, **kw):
+    """``with injected("crash", point=..., at_row=...):`` scoped arming."""
+    f = inject(kind, **kw)
+    try:
+        yield f
+    finally:
+        with _lock:
+            if f in _armed:
+                _armed.remove(f)
+
+
+def _take(point, row, backend, kinds):
+    """Armed faults matching (point, row, backend), consuming one firing
+    each; row-triggered faults fire at the first seam whose row reaches
+    ``at_row``."""
+    hits = []
+    with _lock:
+        for f in _armed:
+            if f.kind not in kinds or f.fired >= f.times:
+                continue
+            if f.point is not None and f.point != point:
+                continue
+            if f.at_row is not None and (row is None or row < f.at_row):
+                continue
+            if (f.backend is not None and backend is not None
+                    and f.backend != backend):
+                continue
+            f.fired += 1
+            hits.append(f)
+    return hits
+
+
+def fire(point, row=None, backend=None, outdir=None):
+    """Seam hook: raise / damage files per the armed faults.
+
+    A no-op (single truthiness check) when nothing is armed, so the hot
+    loop pays nothing for the seam in production.
+    """
+    if not _armed:
+        return
+    for f in _take(point, row, backend, ("truncate_file", "corrupt_file")):
+        if outdir is not None:
+            _damage(os.path.join(str(outdir), f.path or "chain.npy"), f.kind)
+    for f in _take(point, row, backend, ("crash", "xla_error")):
+        if f.kind == "crash":
+            raise InjectedCrash(
+                f"injected crash at {point} (row {row})")
+        raise XlaRuntimeError(
+            f"INTERNAL: injected device failure at {point} (row {row})")
+
+
+def _damage(path, kind):
+    if not os.path.exists(path):
+        return
+    size = os.path.getsize(path)
+    if kind == "truncate_file":
+        with open(path, "r+b") as fh:
+            fh.truncate(max(size // 2, 1))
+    else:                       # corrupt_file: flip bytes past the header
+        with open(path, "r+b") as fh:
+            fh.seek(max(size // 2, 0))
+            fh.write(b"\xde\xad\xbe\xef")
+
+
+def mutate_rows(chain, bchain, lo, hi, backend=None):
+    """NaN-poison recorded rows in ``[lo, hi)`` for armed ``nan_rows``
+    faults (simulates a diverged chunk landing in the host buffers)."""
+    if not _armed:
+        return
+    with _lock:
+        hits = [f for f in _armed
+                if f.kind == "nan_rows" and f.fired < f.times
+                and f.at_row is not None and lo <= f.at_row < hi
+                and (f.backend is None or backend is None
+                     or f.backend == backend)]
+        for f in hits:
+            f.fired += 1
+    for f in hits:
+        chain[f.at_row] = np.nan
+        bchain[f.at_row] = np.nan

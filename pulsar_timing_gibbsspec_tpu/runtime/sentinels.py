@@ -1,0 +1,191 @@
+"""Divergence sentinels: cheap health checks on the sampled chain.
+
+Three layers, cheapest first:
+
+- :func:`chunk_health` — on-device reductions folded into the jax
+  backend's compiled chunk (``_make_chunk``): per-chain all-finite
+  flags and a moved-fraction (the complement of a fully stuck / MH
+  acceptance-collapsed chain), a few scalars per chunk instead of
+  shipping judgment to the host.
+- :class:`SentinelMonitor` — host-side tracker of those reductions:
+  logs acceptance-collapse warnings through ``metrics.jsonl`` and
+  raises :class:`ChainDivergence` after ``stuck_chunks`` consecutive
+  fully-stuck chunks (a sampler wedged in a rejection loop).
+- :func:`check_rows` — backend-agnostic host check on newly recorded
+  rows (the facade runs it before rows can reach a checkpoint).
+
+Recovery is the supervisor's job: a divergence rewinds to the last
+checkpoint and replays; a divergence that REPEATS at the same point on
+a deterministic replay gets :func:`refold_checkpoint_key` — a fresh
+PRNG fold at the checkpoint — so the re-draw explores a different
+stream instead of deterministically reproducing the blow-up.
+"""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+
+import numpy as np
+
+from . import telemetry
+
+
+class ChainDivergence(FloatingPointError):
+    """A recorded stretch of chain failed a health check.
+
+    ``row`` is the first offending recorded row (absolute index);
+    ``what`` is a short tag (``"nonfinite"``, ``"stuck_chain"``).
+    Subclasses FloatingPointError so existing non-finite handling (and
+    the supervisor's failure taxonomy) treats both uniformly.
+    """
+
+    def __init__(self, msg, row=None, what=None):
+        super().__init__(msg)
+        self.row = row
+        self.what = what
+
+
+def chunk_health(xs, bs):
+    """On-device health reductions over a chunk's recorded stacks.
+
+    ``xs`` is (n, C, nx), ``bs`` (n, C, ...): returns per-chain scalars
+    — ``finite`` (C,) bool and ``move_frac`` (C,) float32, the fraction
+    of recorded steps where the chain state changed at all (a fully
+    stuck chain — MH acceptance collapsed to zero AND every conditional
+    frozen — scores 0.0).  Traced inside the jitted chunk, so the host
+    receives a handful of scalars, not a verdict-sized transfer.
+    """
+    import jax.numpy as jnp
+
+    fin = (jnp.all(jnp.isfinite(xs), axis=(0, 2))
+           & jnp.all(jnp.isfinite(bs),
+                     axis=tuple([0] + list(range(2, bs.ndim)))))
+    if xs.shape[0] > 1:
+        moved = jnp.mean(
+            jnp.any(xs[1:] != xs[:-1], axis=-1).astype(jnp.float32), axis=0)
+    else:
+        # a single recorded row carries no movement information
+        moved = jnp.ones((xs.shape[1],), jnp.float32)
+    return {"finite": fin, "move_frac": moved}
+
+
+class SentinelMonitor:
+    """Tracks per-chunk health across a run.
+
+    ``collapse_frac``: below this moved-fraction a chain is flagged as
+    acceptance-collapsed (warning event, run continues).
+    ``stuck_chunks``: after this many CONSECUTIVE fully-stuck chunks
+    (moved fraction exactly 0) a :class:`ChainDivergence` is raised —
+    replaying a wedged sampler forever is not progress.
+    """
+
+    def __init__(self, collapse_frac=0.02, stuck_chunks=3):
+        self.collapse_frac = float(collapse_frac)
+        self.stuck_chunks = int(stuck_chunks)
+        self.events = []
+        self.last = None
+        self._streak = None
+
+    def reset_run(self):
+        """Forget streak state at the start of a fresh run()/retry."""
+        self._streak = None
+
+    def observe(self, health, it):
+        """Fold one chunk's host-side health dict in; returns the new
+        warning events (also appended to ``self.events``)."""
+        fin = np.atleast_1d(np.asarray(health["finite"]))
+        mv = np.atleast_1d(np.asarray(health["move_frac"], np.float64))
+        self.last = {"finite_frac": float(fin.mean()),
+                     "move_frac_min": round(float(mv.min()), 4),
+                     "move_frac_mean": round(float(mv.mean()), 4)}
+        if self._streak is None or len(self._streak) != len(mv):
+            self._streak = np.zeros(len(mv), dtype=int)
+        stuck = mv <= 0.0
+        self._streak = np.where(stuck, self._streak + 1, 0)
+        events = []
+        low = (mv < self.collapse_frac) & ~stuck
+        if low.any():
+            events.append({"event": "mh_acceptance_collapse", "iter": int(it),
+                           "chains": np.where(low)[0].tolist(),
+                           "move_frac": [round(float(v), 4)
+                                         for v in mv[low]]})
+        if (self._streak >= self.stuck_chunks).any():
+            chains = np.where(self._streak >= self.stuck_chunks)[0].tolist()
+            telemetry.incr("sentinel_trips")
+            raise ChainDivergence(
+                f"chains {chains} recorded identical states for "
+                f"{self.stuck_chunks} consecutive chunks (iteration "
+                f"{it}): the sampler is wedged — rewind and re-draw",
+                row=int(it), what="stuck_chain")
+        if events:
+            telemetry.incr("sentinel_events", len(events))
+            self.events += events
+        return events
+
+
+def check_rows(chain, bchain, lo, hi):
+    """Backend-agnostic host sentinel on newly recorded rows [lo, hi):
+    raises :class:`ChainDivergence` on any non-finite value, naming the
+    first bad absolute row, BEFORE the rows can reach a checkpoint."""
+    if hi <= lo:
+        return
+    for nm, arr in (("chain", chain), ("bchain", bchain)):
+        seg = np.asarray(arr[lo:hi])
+        if seg.size == 0:
+            continue
+        flat = seg.reshape(len(seg), -1)
+        bad = ~np.isfinite(flat).all(axis=1)
+        if bad.any():
+            row = lo + int(np.argmax(bad))
+            telemetry.incr("sentinel_trips")
+            raise ChainDivergence(
+                f"non-finite {nm} state recorded at row {row}: the sweep "
+                "diverged — rows past the last checkpoint are discarded",
+                row=row, what="nonfinite")
+
+
+def refold_checkpoint_key(outdir, salt) -> bool:
+    """Perturb the checkpoint's PRNG state with ``salt`` (atomically,
+    manifest updated to match).
+
+    Used when a divergence reproduces on deterministic replay: the
+    rewound retry then re-draws the diverged stretch under a fresh
+    stream.  This intentionally breaks bit-exact resume from the refold
+    point on — that is the point.  Works on both backends' checkpoints
+    (jax ``jax_key`` via ``fold_in``; numpy ``rng_state`` via a
+    ``SeedSequence`` over the old packed state + salt).
+    """
+    apath = Path(outdir) / "adapt.npz"
+    if not apath.exists():
+        return False
+    with np.load(apath) as z:
+        state = {k: z[k] for k in z.files}
+    if "jax_key" in state:
+        import jax.random as jr
+
+        key = jr.wrap_key_data(np.asarray(state["jax_key"], np.uint32))
+        state["jax_key"] = np.asarray(jr.key_data(
+            jr.fold_in(key, int(salt))))
+    elif "rng_state" in state:
+        from ..sampler.blocks import rng_state_pack
+
+        ent = [int(salt)] + [int(v) for v in
+                             np.asarray(state["rng_state"], np.uint64)]
+        rng = np.random.default_rng(np.random.SeedSequence(ent))
+        state["rng_state"] = rng_state_pack(rng)
+    else:
+        return False
+    it = state.pop("iter")
+    tmp = apath.with_name("adapt.npz.tmp.npz")
+    np.savez(tmp, iter=it, **state)
+    os.replace(tmp, apath)
+    # the manifest tracks adapt.npz's hash: rewrite it (same row count)
+    # or the refolded checkpoint would itself be rejected on resume
+    from . import integrity
+
+    man = integrity.read_manifest(outdir)
+    if man is not None and not man.get("corrupt"):
+        integrity.write_manifest(outdir, man.get("rows", int(it)))
+    telemetry.incr("refolds")
+    return True

@@ -1,0 +1,217 @@
+"""Supervised sampling: retry loop, failure taxonomy, graceful degrade.
+
+``run_supervised`` drives ``gibbs.sample(resume=True)`` to completion
+through transient failures: each attempt resumes from the last verified
+checkpoint (the facade's finally-flush bounds the loss per failure to
+under ``save_every`` sweeps), retries are spaced by capped exponential
+backoff with deterministic jitter, and failures are classified so each
+class gets the right response instead of blind retry:
+
+- ``device``      XLA / runtime faults (preempted TPU, OOM): retry; after
+                  ``degrade_after`` consecutive ones the run degrades to
+                  the float64 numpy oracle and continues from the SAME
+                  checkpoint (slow beats dead).
+- ``corruption``  checkpoint failed verification beyond repair upstream:
+                  roll back to the ``.bak`` generation, then retry.
+- ``divergence``  sentinel-detected NaN/stuck chain: rewind (implicit —
+                  the poisoned rows never reached the checkpoint) and
+                  replay; if the SAME divergence reproduces on the
+                  deterministic replay, refold the checkpoint PRNG key
+                  so the re-draw takes a fresh stream.
+- ``crash``       injected/os-level kill artifacts: plain retry.
+- ``user``        bugs (shape errors, contract violations, tripped
+                  transfer guard): re-raised immediately — retrying a
+                  deterministic bug is denial of service on yourself.
+
+Each attempt runs under ``analysis.guards.count_recompiles`` so failure
+events in ``metrics.jsonl`` carry the compile count — a retry storm
+that also recompiles every time is a cache-miss bug, not flakiness.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from dataclasses import asdict, dataclass, field
+from pathlib import Path
+
+import numpy as np
+
+from . import faults, integrity, sentinels, telemetry
+
+
+def classify_failure(exc) -> str:
+    """Map an exception from ``sample()`` to a failure class:
+    ``device | corruption | divergence | crash | user | unknown``."""
+    if isinstance(exc, faults.InjectedCrash):
+        return "crash"
+    if isinstance(exc, integrity.CheckpointError):
+        return "corruption"
+    if isinstance(exc, FloatingPointError):    # includes ChainDivergence
+        return "divergence"
+    name = type(exc).__name__
+    low = str(exc).lower()
+    # jaxlib's XlaRuntimeError (and the injected stand-in) by NAME —
+    # importing jaxlib here would defeat the numpy-only degrade path
+    if "xlaruntimeerror" in name.lower() or name == "InternalError":
+        return "device"
+    if "transfer" in low and ("guard" in low or "disallow" in low):
+        # a tripped transfer guard (analysis.guards.no_transfers) is a
+        # code-discipline bug — retrying cannot fix it
+        return "user"
+    if isinstance(exc, (ValueError, TypeError, KeyError, IndexError,
+                        AttributeError, NotImplementedError,
+                        AssertionError)):
+        return "user"
+    if isinstance(exc, OSError):
+        return "crash"
+    if isinstance(exc, RuntimeError):
+        if any(t in low for t in ("xla", "device", "tpu", "out of memory",
+                                  "resource exhausted", "internal error")):
+            return "device"
+        return "user"        # resume-contract violations et al.
+    return "unknown"
+
+
+def backoff_delay(retry, base=0.5, cap=30.0, jitter=0.25, seed=0) -> float:
+    """Capped exponential backoff with deterministic jitter.
+
+    ``retry`` is 1-based; the jitter draw is a pure function of
+    (seed, retry) so tests — and post-mortems — can reproduce the exact
+    sleep schedule."""
+    d = min(float(cap), float(base) * (2.0 ** (retry - 1)))
+    u = np.random.default_rng([int(seed), int(retry)]).uniform(-jitter,
+                                                               jitter)
+    return max(0.0, d * (1.0 + float(u)))
+
+
+@dataclass
+class SupervisorReport:
+    """Outcome counters for one supervised run (mirrored to
+    ``metrics.jsonl`` and, via runtime.telemetry, to bench.py JSON)."""
+
+    attempts: int = 0
+    retries: int = 0
+    rollbacks: int = 0
+    refolds: int = 0
+    degradations: int = 0
+    backend: str = ""
+    failures: list = field(default_factory=list)
+
+    def as_dict(self):
+        return asdict(self)
+
+
+def _log_event(outdir, record):
+    """Append to the run's ``metrics.jsonl`` stream (same file the
+    facade's ChainStore writes) without requiring a store instance."""
+    p = Path(outdir)
+    p.mkdir(parents=True, exist_ok=True)
+    rec = {"ts": round(time.time(), 3), **record}
+    with open(p / "metrics.jsonl", "a") as fh:
+        fh.write(json.dumps(rec) + "\n")
+
+
+def _degraded(gibbs):
+    """Numpy twin of a jax facade for graceful degradation, or None when
+    the run shape cannot transfer (multi-chain or thinned records have
+    no numpy equivalent)."""
+    be = gibbs._backend
+    if getattr(be, "C", 1) != 1 or getattr(be, "record_every", 1) != 1:
+        return None
+    try:
+        return gibbs.with_backend("numpy")
+    except Exception:
+        return None
+
+
+def run_supervised(gibbs, x0, outdir, niter, save_every=100, resume=True,
+                   max_retries=8, degrade_after=3, backoff_base=0.5,
+                   backoff_cap=30.0, jitter=0.25, backoff_seed=0,
+                   sleep=time.sleep, allow_degrade=True, **sample_kwargs):
+    """Drive ``gibbs.sample`` to ``niter`` under the retry policy above.
+
+    Returns ``(chain, report)``.  ``sleep`` is injectable so tests can
+    capture the backoff schedule instead of waiting it out; ``resume``
+    applies to the FIRST attempt only (every retry resumes).
+    """
+    from ..analysis.guards import count_recompiles
+
+    rep = SupervisorReport(backend=gibbs.backend_name)
+    consecutive_device = 0
+    last_div_sig = None
+    rc = None
+    while True:
+        rep.attempts += 1
+        try:
+            with count_recompiles() as rc:
+                chain = gibbs.sample(
+                    x0, outdir=outdir, niter=niter,
+                    resume=resume or rep.attempts > 1,
+                    save_every=save_every, **sample_kwargs)
+            rep.backend = gibbs.backend_name
+            _log_event(outdir, {"event": "supervised_run_complete",
+                                **rep.as_dict()})
+            return chain, rep
+        except KeyboardInterrupt:
+            raise                # the facade's finally-flush already ran
+        except Exception as exc:
+            kind = classify_failure(exc)
+            n_comp = int(getattr(rc, "events", 0) or 0)
+            fail = {"attempt": rep.attempts, "kind": kind,
+                    "error": f"{type(exc).__name__}: {exc}"[:300],
+                    "n_compiles": n_comp}
+            rep.failures.append(fail)
+            _log_event(outdir, {"event": "supervised_failure", **fail})
+            if kind == "user":
+                raise
+            if rep.retries >= max_retries:
+                _log_event(outdir, {"event": "supervised_giving_up",
+                                    **rep.as_dict()})
+                raise
+            rep.retries += 1
+            telemetry.incr("retries")
+            if kind == "corruption":
+                # load_resume already tried the .bak; one more explicit
+                # attempt here, then give up — retrying an unverifiable
+                # checkpoint forever converges to nothing
+                if integrity.rollback(outdir):
+                    rep.rollbacks += 1
+                    _log_event(outdir, {"event": "checkpoint_rollback",
+                                        "attempt": rep.attempts})
+                else:
+                    raise
+            if kind == "divergence":
+                sig = f"{type(exc).__name__}:{exc}"
+                if sig == last_div_sig:
+                    # deterministic replay reproduced the same blow-up:
+                    # re-draw the stretch under a fresh PRNG fold
+                    if sentinels.refold_checkpoint_key(
+                            outdir, salt=rep.attempts):
+                        rep.refolds += 1
+                        _log_event(outdir, {"event": "prng_refold",
+                                            "attempt": rep.attempts})
+                last_div_sig = sig
+            else:
+                last_div_sig = None
+            consecutive_device = (consecutive_device + 1
+                                  if kind == "device" else 0)
+            if (allow_degrade and gibbs.backend_name == "jax"
+                    and consecutive_device >= degrade_after):
+                down = _degraded(gibbs)
+                if down is not None:
+                    gibbs = down
+                    rep.degradations += 1
+                    rep.backend = gibbs.backend_name
+                    telemetry.incr("degradations")
+                    consecutive_device = 0
+                    _log_event(outdir, {"event": "backend_degraded",
+                                        "to": gibbs.backend_name,
+                                        "attempt": rep.attempts})
+            delay = backoff_delay(rep.retries, backoff_base, backoff_cap,
+                                  jitter, seed=backoff_seed)
+            _log_event(outdir, {"event": "supervised_retry",
+                                "next_attempt": rep.attempts + 1,
+                                "kind": kind,
+                                "backoff_s": round(delay, 3)})
+            sleep(delay)

@@ -1,0 +1,51 @@
+"""Process-wide resilience counters.
+
+One tiny registry shared by the integrity layer, the sentinels and the
+supervisor so retry/rollback/degradation activity is visible in one
+place: ``bench.py`` embeds :func:`snapshot` in its JSON line and the
+supervisor mirrors the same numbers into ``metrics.jsonl`` events.
+
+Counter names in use (others may appear; consumers must not assume a
+closed set):
+
+- ``retries``             supervisor attempts beyond the first
+- ``rollbacks``           checkpoints restored from the ``.bak`` set
+- ``refolds``             checkpoint PRNG keys perturbed after a
+                          repeated (deterministic) divergence
+- ``degradations``        jax -> numpy backend downgrades
+- ``torn_checkpoints``    chain/bchain row-count mismatches on resume
+- ``corrupt_checkpoints`` manifest verification failures on resume
+- ``sentinel_events``     non-fatal health warnings (acceptance collapse)
+- ``sentinel_trips``      sentinel-raised divergences (stuck/non-finite)
+"""
+
+from __future__ import annotations
+
+import threading
+
+_lock = threading.Lock()
+_counts: dict[str, int] = {}
+
+
+def incr(name: str, n: int = 1) -> int:
+    """Add ``n`` to counter ``name`` (created at 0); returns the new value."""
+    with _lock:
+        _counts[name] = _counts.get(name, 0) + int(n)
+        return _counts[name]
+
+
+def get(name: str) -> int:
+    with _lock:
+        return _counts.get(name, 0)
+
+
+def snapshot() -> dict[str, int]:
+    """Copy of all counters, sorted by name (stable for JSON output)."""
+    with _lock:
+        return dict(sorted(_counts.items()))
+
+
+def reset() -> None:
+    """Zero every counter (tests; bench run isolation)."""
+    with _lock:
+        _counts.clear()

@@ -7,11 +7,20 @@ state, so a resumed run would hit undefined ``aclength_white`` (latent bug,
 SURVEY §5).  Here both are fixed: resume reads what was written, and an
 ``adapt.npz`` sidecar carries adaptation state (covariances, ACT lengths,
 RNG/PRNG state) so a resumed chain continues the same stochastic process.
+
+Integrity (docs/RESILIENCE.md): each save rotates the previous verified
+checkpoint to a ``.bak`` generation and writes a ``manifest.json``
+sidecar (sha256/size/shape per file, row count) LAST — resume verifies
+the set against it, rolls back to ``.bak`` on mismatch, and only then
+trusts the files.  The ``runtime.faults`` seams inside ``save`` let the
+chaos suite kill the process between the two ``os.replace`` calls and
+prove recovery is bit-exact.
 """
 
 from __future__ import annotations
 
 import os
+import warnings
 from pathlib import Path
 
 import numpy as np
@@ -19,27 +28,41 @@ import numpy as np
 
 class ChainStore:
     """Directory of: chain.npy, bchain.npy, pars_chain.txt, pars_bchain.txt,
-    adapt.npz."""
+    adapt.npz (+ manifest.json and one rotating .bak generation)."""
 
-    def __init__(self, outdir, param_names, b_param_names):
+    def __init__(self, outdir, param_names, b_param_names, backup=True):
         self.outdir = Path(outdir)
         self.outdir.mkdir(parents=True, exist_ok=True)
         self.param_names = list(param_names)
         self.b_param_names = list(b_param_names)
+        #: keep a rotating .bak of the previous verified checkpoint set
+        self.backup = bool(backup)
         np.savetxt(self.outdir / "pars_chain.txt", self.param_names, fmt="%s")
         np.savetxt(self.outdir / "pars_bchain.txt", self.b_param_names, fmt="%s")
 
     def save(self, chain, bchain, upto, adapt_state=None):
         """Persist rows [0, upto) plus adaptation state, atomically enough
-        for a crash between files not to corrupt resume (write tmp, rename)."""
+        for a crash between files not to corrupt resume (write tmp, rename;
+        the manifest written last makes any torn combination detectable)."""
+        from ..runtime import faults, integrity
+
+        if self.backup:
+            # rotate BEFORE touching the primaries: a kill anywhere in
+            # this save leaves the .bak holding the previous checkpoint
+            integrity.rotate_backup(self.outdir)
         for nm, arr in (("chain.npy", chain), ("bchain.npy", bchain)):
             tmp = self.outdir / (nm + ".tmp.npy")
             np.save(tmp, arr[:upto])
             os.replace(tmp, self.outdir / nm)
+            if nm == "chain.npy":
+                faults.fire("chainstore.between_replaces", row=upto,
+                            outdir=self.outdir)
         if adapt_state is not None:
             tmp = self.outdir / "adapt.npz.tmp.npz"
             np.savez(tmp, iter=np.int64(upto), **adapt_state)
             os.replace(tmp, self.outdir / "adapt.npz")
+        integrity.write_manifest(self.outdir, rows=upto)
+        faults.fire("chainstore.post_save", row=upto, outdir=self.outdir)
 
     def log_metrics(self, record: dict):
         """Append one JSON line to ``metrics.jsonl`` — the structured
@@ -70,33 +93,91 @@ class ChainStore:
                 "the canonical outputs)") from exc
 
         tmp = self.outdir / "chain.h5.tmp"
-        with h5py.File(tmp, "w") as fh:
-            fh.create_dataset("chain", data=np.asarray(chain[:upto]))
-            fh.create_dataset("bchain", data=np.asarray(bchain[:upto]))
-            st = h5py.string_dtype()
-            fh.create_dataset("params", data=np.asarray(self.param_names,
-                                                        dtype=st))
-            fh.create_dataset("b_params", data=np.asarray(self.b_param_names,
-                                                          dtype=st))
-            fh.attrs["niter"] = int(upto)
-            for k, v in (extra_attrs or {}).items():
-                fh.attrs[k] = v
-        os.replace(tmp, self.outdir / "chain.h5")
+        try:
+            with h5py.File(tmp, "w") as fh:
+                fh.create_dataset("chain", data=np.asarray(chain[:upto]))
+                fh.create_dataset("bchain", data=np.asarray(bchain[:upto]))
+                st = h5py.string_dtype()
+                fh.create_dataset("params", data=np.asarray(self.param_names,
+                                                            dtype=st))
+                fh.create_dataset("b_params",
+                                  data=np.asarray(self.b_param_names,
+                                                  dtype=st))
+                fh.attrs["niter"] = int(upto)
+                for k, v in (extra_attrs or {}).items():
+                    fh.attrs[k] = v
+            os.replace(tmp, self.outdir / "chain.h5")
+        finally:
+            # a failed export must not leave a stale tmp that a later
+            # retry's os.replace would silently promote
+            tmp.unlink(missing_ok=True)
 
     def load_resume(self):
         """Return (chain, bchain, start_iter, adapt_state) or None if there
-        is nothing to resume from."""
+        is nothing to resume from.
+
+        When a ``manifest.json`` exists the set is verified against it
+        first; a mismatch (torn write, truncation, bit rot) rolls back
+        to the ``.bak`` generation, and :class:`runtime.CheckpointError`
+        is raised when neither set verifies — never a silent resume
+        from corrupt files.  Pre-manifest directories skip verification
+        (legacy path) but a chain/bchain row-count mismatch is still
+        reported loudly instead of silently truncated."""
+        from ..runtime import integrity, telemetry
+
+        man = integrity.read_manifest(self.outdir)
+        if man is not None:
+            rep = integrity.verify(self.outdir, man)
+            if not rep["ok"]:
+                bad = ", ".join(rep["bad"])
+                telemetry.incr("corrupt_checkpoints")
+                self.log_metrics({"event": "checkpoint_corrupt",
+                                  "files": rep["bad"]})
+                if not integrity.rollback(self.outdir):
+                    raise integrity.CheckpointError(
+                        f"{self.outdir}: checkpoint failed integrity "
+                        f"verification ({bad}) and no verified .bak "
+                        "backup exists; delete the directory to start "
+                        "fresh")
+                warnings.warn(
+                    f"{self.outdir}: checkpoint failed integrity "
+                    f"verification ({bad}); rolled back to the previous "
+                    ".bak checkpoint", RuntimeWarning, stacklevel=2)
+                self.log_metrics({"event": "checkpoint_rollback"})
+                man = integrity.read_manifest(self.outdir)
         cpath = self.outdir / "chain.npy"
         bpath = self.outdir / "bchain.npy"
         if not (cpath.exists() and bpath.exists()):
             return None
         chain = np.load(cpath)
         bchain = np.load(bpath)
+        if len(chain) != len(bchain):
+            # verified sets can't get here; a legacy (pre-manifest) torn
+            # checkpoint can — recoverable, but never silently
+            torn = ("bchain.npy" if len(bchain) < len(chain)
+                    else "chain.npy")
+            warnings.warn(
+                f"{self.outdir}: torn checkpoint — chain.npy has "
+                f"{len(chain)} rows, bchain.npy has {len(bchain)} "
+                f"({torn} is short); resuming from the common prefix",
+                RuntimeWarning, stacklevel=2)
+            self.log_metrics({"event": "torn_checkpoint", "file": torn,
+                              "chain_rows": int(len(chain)),
+                              "bchain_rows": int(len(bchain))})
+            telemetry.incr("torn_checkpoints")
         upto = min(len(chain), len(bchain))
+        if man is not None and not man.get("corrupt"):
+            upto = min(upto, int(man.get("rows", upto)))
         adapt = None
         apath = self.outdir / "adapt.npz"
         if apath.exists():
-            with np.load(apath) as z:
-                adapt = {k: z[k] for k in z.files}
+            try:
+                with np.load(apath) as z:
+                    adapt = {k: z[k] for k in z.files}
+            except Exception as exc:
+                raise integrity.CheckpointError(
+                    f"{self.outdir}/adapt.npz is unreadable ({exc}); the "
+                    "adaptation state cannot be restored — delete the "
+                    "directory to start fresh") from exc
             upto = min(upto, int(adapt.pop("iter")))
         return chain[:upto], bchain[:upto], upto, adapt

@@ -11,10 +11,12 @@ sharing the same machinery with a common free-spectrum block.
 
 from __future__ import annotations
 
+import sys
 import time
 
 import numpy as np
 
+from ..runtime import faults, sentinels
 from .chains import ChainStore
 from .numpy_backend import NumpyGibbs
 
@@ -30,6 +32,10 @@ class _GibbsBase:
         self.backend_name = backend
         self.ecorrsample = ecorrsample
         self.progress = progress
+        # constructor record for with_backend (supervised degradation)
+        self._ctor = {"hypersample": hypersample, "ecorrsample": ecorrsample,
+                      "redsample": redsample, "psr": psr, "seed": seed,
+                      "opts": dict(backend_opts)}
         if backend == "numpy":
             self._backend = self._make_numpy(hypersample, ecorrsample,
                                              redsample, seed, backend_opts)
@@ -74,6 +80,25 @@ class _GibbsBase:
                     named.setdefault(jj, f"{pname}_{s.name}_{jj - sl.start}")
             out += [named[jj] for jj in sorted(named)]
         return out
+
+    def with_backend(self, backend):
+        """A twin facade on the same PTA with a different execution
+        backend — the supervisor's jax->numpy graceful-degradation hook.
+        Jax-only options (record/chunk/mesh controls) are dropped when
+        degrading to the numpy oracle, which has no equivalents."""
+        c = self._ctor
+        opts = dict(c["opts"])
+        if backend == "numpy":
+            for k in ("record_precision", "record_every", "nchains",
+                      "chunk_size", "pad_pulsars", "mesh", "warmup_sweeps",
+                      "warmup_white_steps", "white_steps_max",
+                      "exact_every", "transfer_guard"):
+                opts.pop(k, None)
+        return type(self)(self.pta, hypersample=c["hypersample"],
+                          ecorrsample=c["ecorrsample"],
+                          redsample=c["redsample"], psr=c["psr"],
+                          backend=backend, seed=c["seed"],
+                          progress=self.progress, **opts)
 
     # -- main loop -----------------------------------------------------------
 
@@ -143,40 +168,90 @@ class _GibbsBase:
         t0 = time.time()
         iterator = self._backend.run(x, chain, bchain, start, niter)
         last_saved = start
+        upto_done = start
+        # when rows past the last checkpoint are known-bad (sentinel
+        # trip) or a save itself failed midway, the finally-flush must
+        # NOT persist them — a poisoned/inconsistent checkpoint is worse
+        # than the bounded loss it would avoid
+        no_flush = False
+        # \r progress is for humans at a terminal; under nohup/CI the
+        # same stream must be periodic plain lines, not one giant
+        # carriage-returned line
+        is_tty = bool(getattr(sys.stdout, "isatty", lambda: False)())
         # save_every is in SWEEPS (the reference's unit); yields count
         # recorded rows, so the row-space interval shrinks by k — the
         # crash-loss window must not silently stretch with thinning
         save_rows = max(1, save_every // rec_k)
-        for upto in iterator:
-            if upto - last_saved >= save_rows or upto >= total_rows:
-                store.save(chain, bchain, upto,
-                           adapt_state=self._backend.adapt_state())
-                el = time.time() - t0
-                done = upto - start
-                # yields count recorded ROWS; each row is record_every
-                # sweeps, so the sweep rate scales back up by k
-                rate = done * rec_k / el if el > 0 else float("nan")
-                # "iter" stays in sweep units (comparable to niter); the
-                # jax backend tracks the exact counter under thinning
-                it_s = int(getattr(self._backend, "_it_cur", upto))
-                store.log_metrics({
-                    "iter": it_s, "niter": int(niter),
-                    "rows": int(upto) if rec_k > 1 else None,
-                    "elapsed_s": round(el, 3),
-                    "sweeps_per_s": round(rate, 3),
-                    "record_every": rec_k if rec_k > 1 else None,
-                    "backend": self.backend_name,
-                    "nchains": int(getattr(self._backend, "C", 1)),
-                    "aclength_white": getattr(
-                        self._backend, "aclength_white", None),
-                    "aclength_ecorr": getattr(
-                        self._backend, "aclength_ecorr", None),
-                })
-                last_saved = upto
-                if self.progress:
-                    print(f"\r[{self.backend_name}] {upto}/{total_rows} "
-                          f"rows ({rate:.1f} sweeps/s)", end="", flush=True)
-        if self.progress:
+        try:
+            for upto in iterator:
+                faults.mutate_rows(chain, bchain, upto_done, upto,
+                                   backend=self.backend_name)
+                try:
+                    sentinels.check_rows(chain, bchain, upto_done, upto)
+                except sentinels.ChainDivergence as exc:
+                    # the backend already advanced past the poisoned
+                    # rows: nothing after the last checkpoint may flush
+                    no_flush = True
+                    store.log_metrics({"event": "divergence",
+                                       "row": exc.row, "what": exc.what,
+                                       "backend": self.backend_name})
+                    raise
+                upto_done = upto
+                faults.fire("sample.loop", row=upto,
+                            backend=self.backend_name)
+                if upto - last_saved >= save_rows or upto >= total_rows:
+                    no_flush = True   # a crash inside save: don't re-save
+                    store.save(chain, bchain, upto,
+                               adapt_state=self._backend.adapt_state())
+                    no_flush = False
+                    el = time.time() - t0
+                    done = upto - start
+                    # yields count recorded ROWS; each row is record_every
+                    # sweeps, so the sweep rate scales back up by k
+                    rate = done * rec_k / el if el > 0 else float("nan")
+                    # "iter" stays in sweep units (comparable to niter);
+                    # the jax backend tracks the exact counter under
+                    # thinning
+                    it_s = int(getattr(self._backend, "_it_cur", upto))
+                    store.log_metrics({
+                        "iter": it_s, "niter": int(niter),
+                        "rows": int(upto) if rec_k > 1 else None,
+                        "elapsed_s": round(el, 3),
+                        "sweeps_per_s": round(rate, 3),
+                        "record_every": rec_k if rec_k > 1 else None,
+                        "backend": self.backend_name,
+                        "nchains": int(getattr(self._backend, "C", 1)),
+                        "sentinel": getattr(
+                            self._backend, "health_last", None),
+                        "aclength_white": getattr(
+                            self._backend, "aclength_white", None),
+                        "aclength_ecorr": getattr(
+                            self._backend, "aclength_ecorr", None),
+                    })
+                    last_saved = upto
+                    if self.progress:
+                        msg = (f"[{self.backend_name}] {upto}/"
+                               f"{total_rows} rows ({rate:.1f} sweeps/s)")
+                        if is_tty:
+                            print("\r" + msg, end="", flush=True)
+                        else:
+                            print(msg, flush=True)
+        finally:
+            if upto_done > last_saved and not no_flush:
+                # bounded-loss flush: KeyboardInterrupt or a backend
+                # failure between checkpoints still persists every
+                # verified row (< save_every sweeps lost), resumable
+                try:
+                    store.save(chain, bchain, upto_done,
+                               adapt_state=self._backend.adapt_state())
+                    store.log_metrics({"event": "final_flush",
+                                       "rows": int(upto_done),
+                                       "backend": self.backend_name})
+                except Exception:
+                    # never mask the original exception with a failed
+                    # best-effort flush
+                    pass
+        if self.progress and is_tty:
             print()
         if hdf5:
             store.export_hdf5(chain, bchain, total_rows,
@@ -220,6 +295,28 @@ class PTABlockGibbs(_GibbsBase):
                               **opts)
 
 
+def _adopt_jax_checkpoint(drv, state):
+    """Adopt a jax-backend checkpoint into a numpy driver (supervised
+    jax->numpy degradation): resume from its ``x_cur``, seed a fresh
+    deterministic RNG from the checkpoint's PRNG key data, and flag the
+    first resumed sweep to re-draw b and re-run the one-shot adaptation
+    (the device adaptation state has no numpy equivalent).  The
+    continuation is a valid Gibbs chain from the same state — not a
+    bitwise replay; the oracle cannot reproduce the device stream."""
+    xc = np.asarray(state["x_cur"], dtype=np.float64)
+    if xc.ndim == 2:
+        if xc.shape[0] != 1:
+            raise RuntimeError(
+                f"cannot degrade a multi-chain (nchains={xc.shape[0]}) "
+                "jax checkpoint to the single-chain numpy backend")
+        xc = xc[0]
+    drv.x_resume = xc
+    ent = [0x6DE6] + [int(v) for v in
+                      np.asarray(state["jax_key"], np.uint32).ravel()]
+    drv.g.rng = np.random.default_rng(np.random.SeedSequence(ent))
+    drv._readapt = True
+
+
 def _reject_jax_only_opts(opts):
     """Targeted error for device-record options reaching the f64 oracle:
     the numpy backends record every sweep at full precision by design, so
@@ -246,11 +343,19 @@ class _NumpySingleDriver:
 
     def run(self, x, chain, bchain, start, niter):
         first = start == 0
+        readapt = getattr(self, "_readapt", False)
+        self._readapt = False
         self.x_cur = x
         for ii in range(start, niter):
+            if readapt and ii == start:
+                # adopted foreign (jax) checkpoint: b was never restored
+                # — draw it from the resumed state before it is recorded
+                self.g.draw_b(np.asarray(self.x_cur, dtype=np.float64))
             chain[ii] = self.x_cur
             bchain[ii] = self.g.b
-            self.x_cur = self.g.sweep(self.x_cur, first=first and ii == 0)
+            self.x_cur = self.g.sweep(
+                self.x_cur,
+                first=(first and ii == 0) or (readapt and ii == start))
             yield ii + 1
 
     def adapt_state(self):
@@ -260,6 +365,9 @@ class _NumpySingleDriver:
 
     def load_adapt_state(self, state):
         state = dict(state)
+        if "jax_key" in state and "rng_state" not in state:
+            _adopt_jax_checkpoint(self, state)
+            return
         if "x_cur" in state:
             self.x_resume = np.asarray(state.pop("x_cur"))
         self.g.load_adapt_state(state)
@@ -277,11 +385,17 @@ class _NumpyPTADriver:
 
     def run(self, x, chain, bchain, start, niter):
         first = start == 0
+        readapt = getattr(self, "_readapt", False)
+        self._readapt = False
         self.x_cur = x
         for ii in range(start, niter):
+            if readapt and ii == start:
+                self.g.draw_b(np.asarray(self.x_cur, dtype=np.float64))
             chain[ii] = self.x_cur
             bchain[ii] = np.concatenate(self.g.b)
-            self.x_cur = self.g.sweep(self.x_cur, first=first and ii == 0)
+            self.x_cur = self.g.sweep(
+                self.x_cur,
+                first=(first and ii == 0) or (readapt and ii == start))
             yield ii + 1
 
     def adapt_state(self):
@@ -291,6 +405,9 @@ class _NumpyPTADriver:
 
     def load_adapt_state(self, state):
         state = dict(state)
+        if "jax_key" in state and "rng_state" not in state:
+            _adopt_jax_checkpoint(self, state)
+            return
         if "x_cur" in state:
             self.x_resume = np.asarray(state.pop("x_cur"))
         self.g.load_adapt_state(state)

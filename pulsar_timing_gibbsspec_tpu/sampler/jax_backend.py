@@ -44,6 +44,7 @@ import numpy as np
 
 from ..config import settings
 from ..ops.acf import integrated_act
+from ..runtime.sentinels import SentinelMonitor, chunk_health
 from .compiled import CompiledPTA, compile_pta
 
 _SCALES = np.array([0.1, 0.5, 1.0, 3.0, 10.0])
@@ -1804,7 +1805,7 @@ class JaxGibbsDriver:
                  pad_pulsars=None, mesh=None, warmup_sweeps=50,
                  warmup_white_steps=16, white_steps_max=64, nchains=1,
                  exact_every=EXACT_EVERY, record_precision=None,
-                 record_every=1, transfer_guard=False):
+                 record_every=1, transfer_guard=False, sentinels=True):
         settings.apply()
         import jax
         import jax.random as jr
@@ -1875,6 +1876,12 @@ class JaxGibbsDriver:
         #: so an implicit host<->device round-trip sneaking into the hot
         #: path raises instead of silently serializing the sweep
         self.transfer_guard = bool(transfer_guard)
+        #: per-chunk health sentinel (runtime.sentinels): the compiled
+        #: chunk already computes per-chain finite/moved reductions on
+        #: device; the monitor turns them into metrics.jsonl warnings
+        #: and raises ChainDivergence on persistently stuck chains
+        self.sentinel = SentinelMonitor() if sentinels else None
+        self.health_last = None
         self.warmup_sweeps = warmup_sweeps
         self.warmup_white_steps = warmup_white_steps
         self.exact_every = int(exact_every)
@@ -2527,7 +2534,12 @@ class JaxGibbsDriver:
             # the same reason the b record does.  The carry/resume path
             # reads x_end (selected from the pre-cast stack above), so
             # checkpoints and trailing chunks never see the rounding.
-            return x_end, b_end, xs_rec.astype(self.rdtype), bs_flat
+            # Health reductions ride the same dispatch: a handful of
+            # per-chain scalars (all-finite, moved fraction) computed on
+            # device, so divergence/stuck-chain detection costs no extra
+            # transfer (runtime.sentinels, docs/RESILIENCE.md)
+            health = chunk_health(xs_rec, bs_rec)
+            return x_end, b_end, xs_rec.astype(self.rdtype), bs_flat, health
 
         return jax.jit(run_chunk)
 
@@ -2650,6 +2662,10 @@ class JaxGibbsDriver:
         # sample() calls); the seed entry (-1) is still valid but cheap
         # to rebuild once per run
         self._de_dev_cache = {}
+        if self.sentinel is not None:
+            # streak state is per-run: a supervised retry must not
+            # inherit the failed attempt's stuck count
+            self.sentinel.reset_run()
         ii = start
         if ii == 0:
             # draw b from the initial state before any conditional touches
@@ -2661,14 +2677,16 @@ class JaxGibbsDriver:
             if W > 0:
                 self.key, sub = self._jr.split(self.key)
                 fn = self._warmup_chunk_fn(W)
-                x, b, xs, bs = fn(x, jnp.asarray(self.b), sub,
-                                  jnp.asarray(0, jnp.int32), self._aux(),
-                                  jnp.asarray(W, jnp.int32))
+                x, b, xs, bs, health = fn(x, jnp.asarray(self.b), sub,
+                                          jnp.asarray(0, jnp.int32),
+                                          self._aux(),
+                                          jnp.asarray(W, jnp.int32))
                 self.b = b
                 xs_h = self._squeeze(np.asarray(xs, dtype=np.float64))
                 self._check_finite(xs_h, 0, "warmup state")
                 bs_h = self._squeeze(np.asarray(bs, np.float64))
                 self._check_finite(bs_h, 0, "warmup b coefficients")
+                self._observe_health(health, W)
                 wr = self._rows_of(W)          # thinned warmup row count
                 chain[0:wr] = xs_h
                 bchain[0:wr] = bs_h
@@ -2715,9 +2733,9 @@ class JaxGibbsDriver:
         # Checkpoint consistency: the state yielded with chunk i's rows is
         # chunk i's own carry (x_end, b_end) — never the in-flight chunk's.
         b_dev = jnp.asarray(self.b)
-        pending = None          # (row, m, xs, bs, x_end, b_end, it_end)
+        pending = None    # (row, m, xs, bs, x_end, b_end, it_end, health)
 
-        def _writeback(row, m, xs, bs, x_end, b_end, it_end):
+        def _writeback(row, m, xs, bs, x_end, b_end, it_end, health):
             # a trailing short chunk records extra rows (the compiled
             # chunk always runs full length); truncate HOST-side — an
             # eager device xs[:m] would dispatch with a host scalar
@@ -2727,6 +2745,10 @@ class JaxGibbsDriver:
             self._check_finite(xs_h, row, "chain state")
             bs_h = self._squeeze(np.asarray(bs, np.float64))[:m]
             self._check_finite(bs_h, row, "b coefficients")
+            # sentinel BEFORE the state advances: a stuck-chain raise
+            # leaves x_cur/_it_cur at the previous writeback, so the
+            # facade's checkpoint stays consistent for the rewind
+            self._observe_health(health, it_end)
             chain[row:row + m] = xs_h
             bchain[row:row + m] = bs_h
             self.x_cur = np.asarray(x_end, dtype=np.float64)
@@ -2759,7 +2781,7 @@ class JaxGibbsDriver:
             args = (x, b_dev, self.key, dput(np.int32(ii)),
                     self._aux(chain, ii), dput(np.int32(n)))
             with self._dispatch_guard():
-                x, b_dev, xs, bs = fn(*args)
+                x, b_dev, xs, bs, health = fn(*args)
             m = max(0, -(-(n - off) // self.record_every))
             if pending is not None:
                 # start both host copies in flight together before the
@@ -2777,11 +2799,20 @@ class JaxGibbsDriver:
                     except (AttributeError, RuntimeError):
                         pass
                 yield _writeback(*pending)
-            pending = (rowc, m, xs, bs, x, b_dev, ii + n)
+            pending = (rowc, m, xs, bs, x, b_dev, ii + n, health)
             ii += n
             rowc += m
         if pending is not None:
             yield _writeback(*pending)
+
+    def _observe_health(self, health, it_end):
+        """Fold a chunk's on-device health reductions into the monitor
+        (host conversion of a handful of per-chain scalars)."""
+        if self.sentinel is None:
+            return
+        h = {k: np.asarray(v) for k, v in health.items()}
+        self.sentinel.observe(h, it_end)
+        self.health_last = self.sentinel.last
 
     def _de_hist_for(self, chain, m):
         """(C, H, d) DE history for DE period ``m`` (iterations

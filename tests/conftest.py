@@ -27,6 +27,33 @@ REFDATA = os.environ.get("PTGIBBS_REFDATA", "/root/reference/simulated_data")
 
 
 @pytest.fixture(scope="session")
+def synth_pta():
+    """Tiny synthetic single-pulsar PTA with a common free-spectrum
+    block — no reference data needed (resilience/chaos tests)."""
+    from pulsar_timing_gibbsspec_tpu.data.dataset import Pulsar
+    from pulsar_timing_gibbsspec_tpu.models.factory import model_general
+
+    DAY = 86400.0
+    rng = np.random.default_rng(11)
+    n = 60
+    span = 6.0 * 365.25 * DAY
+    toas = np.sort(rng.uniform(0.0, span, n)) + 53000.0 * DAY
+    errs = np.full(n, 5e-7)
+    res = errs * rng.standard_normal(n)
+    t = (toas - toas.mean()) / span
+    M = np.column_stack([np.ones(n), t, t * t])
+    psr = Pulsar(
+        name="FAKE_CHAOS", toas=toas, toaerrs=errs, residuals=res,
+        freqs=np.full(n, 1400.0),
+        backend_flags=np.asarray(["sim"] * n, dtype=object),
+        Mmat=M, fitpars=["offset", "F0", "F1"],
+        flags={"pta": "NANOGrav"},
+        pos=np.array([1.0, 0.0, 0.0]))
+    return model_general([psr], red_var=False, white_vary=False,
+                         common_psd="spectrum", common_components=4)
+
+
+@pytest.fixture(scope="session")
 def j1713():
     from pulsar_timing_gibbsspec_tpu.data import load_pulsar
 

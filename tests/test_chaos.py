@@ -1,0 +1,255 @@
+"""Fault-injection chaos suite (docs/RESILIENCE.md acceptance).
+
+Every injected fault — NaN'd chunk, fake XlaRuntimeError, kill between
+the two os.replace calls in ChainStore.save, truncated chain.npy,
+corrupted adapt.npz — must be detected, recovered via rollback/retry,
+and the supervised run's final chain must be bit-identical to an
+uninterrupted run with the same seed (numpy backend; the jax backend's
+resume is bitwise too, so its case asserts exact equality as well).
+All cases run on the tiny synthetic PTA, fast enough for tier-1.
+"""
+
+import json
+import shutil
+
+import numpy as np
+import pytest
+
+from pulsar_timing_gibbsspec_tpu.runtime import (faults, run_supervised,
+                                                 supervisor, telemetry)
+from pulsar_timing_gibbsspec_tpu.sampler.gibbs import PTABlockGibbs
+
+pytestmark = pytest.mark.chaos
+
+NITER = 60
+SAVE = 20
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    faults.clear()
+    telemetry.reset()
+    yield
+    faults.clear()
+
+
+@pytest.fixture(scope="module")
+def x0(synth_pta):
+    return synth_pta.initial_sample(np.random.default_rng(0))
+
+
+@pytest.fixture(scope="module")
+def baseline(synth_pta, x0, tmp_path_factory):
+    """Uninterrupted numpy run — the bit-identical recovery target."""
+    g = PTABlockGibbs(synth_pta, backend="numpy", seed=1, progress=False)
+    out = tmp_path_factory.mktemp("baseline")
+    return g.sample(x0, outdir=out, niter=NITER, save_every=SAVE)
+
+
+def _gibbs(pta):
+    return PTABlockGibbs(pta, backend="numpy", seed=1, progress=False)
+
+
+def _events(outdir):
+    with open(outdir / "metrics.jsonl") as fh:
+        return [json.loads(ln) for ln in fh]
+
+
+def test_kill_between_replaces_recovers_bitwise(synth_pta, x0, baseline,
+                                                tmp_path):
+    """A crash in the torn-checkpoint window (chain.npy replaced,
+    bchain.npy not yet): the next attempt detects the sha mismatch,
+    rolls back to the .bak generation and replays bit-exactly."""
+    faults.inject("crash", point="chainstore.between_replaces", at_row=40)
+    chain, rep = run_supervised(_gibbs(synth_pta), x0, tmp_path, NITER,
+                                save_every=SAVE, sleep=lambda s: None)
+    assert np.array_equal(chain, baseline)
+    assert rep.retries == 1
+    assert rep.failures[0]["kind"] == "crash"
+    assert telemetry.get("rollbacks") == 1
+    assert telemetry.get("corrupt_checkpoints") == 1
+    evs = [e.get("event") for e in _events(tmp_path)]
+    assert "checkpoint_corrupt" in evs and "checkpoint_rollback" in evs
+
+
+def test_truncated_chain_rolls_back_and_extends_bitwise(synth_pta, x0,
+                                                        tmp_path):
+    """Truncate chain.npy after a completed run, then extend it under
+    supervision: verification fails, the .bak restores the previous
+    checkpoint, and the extension replays to a chain bit-identical to
+    one never damaged."""
+    g = _gibbs(synth_pta)
+    g.sample(x0, outdir=tmp_path, niter=NITER, save_every=SAVE)
+    ref_dir = tmp_path.parent / (tmp_path.name + "_ref")
+    shutil.copytree(tmp_path, ref_dir)
+    with open(tmp_path / "chain.npy", "r+b") as fh:
+        fh.truncate(fh.seek(0, 2) // 2)
+    chain, rep = run_supervised(_gibbs(synth_pta), x0, tmp_path, 100,
+                                save_every=SAVE, sleep=lambda s: None)
+    ref, _ = run_supervised(_gibbs(synth_pta), x0, ref_dir, 100,
+                            save_every=SAVE, sleep=lambda s: None)
+    assert np.array_equal(chain, ref)
+    assert telemetry.get("rollbacks") >= 1
+
+
+def test_corrupted_adapt_rolls_back_and_extends_bitwise(synth_pta, x0,
+                                                        tmp_path):
+    g = _gibbs(synth_pta)
+    g.sample(x0, outdir=tmp_path, niter=NITER, save_every=SAVE)
+    ref_dir = tmp_path.parent / (tmp_path.name + "_refa")
+    shutil.copytree(tmp_path, ref_dir)
+    with open(tmp_path / "adapt.npz", "r+b") as fh:
+        size = fh.seek(0, 2)
+        fh.seek(size // 2)
+        fh.write(b"\xde\xad\xbe\xef")
+    chain, _ = run_supervised(_gibbs(synth_pta), x0, tmp_path, 100,
+                              save_every=SAVE, sleep=lambda s: None)
+    ref, _ = run_supervised(_gibbs(synth_pta), x0, ref_dir, 100,
+                            save_every=SAVE, sleep=lambda s: None)
+    assert np.array_equal(chain, ref)
+    assert telemetry.get("corrupt_checkpoints") >= 1
+
+
+def test_corruption_without_backup_raises(synth_pta, x0, tmp_path):
+    """No verified .bak to fall back to: the supervisor must give up
+    loudly (CheckpointError), not loop or resume from garbage."""
+    from pulsar_timing_gibbsspec_tpu.runtime import CheckpointError
+
+    g = _gibbs(synth_pta)
+    g.sample(x0, outdir=tmp_path, niter=20, save_every=30)  # one save
+    for nm in tmp_path.glob("*.bak*"):
+        nm.unlink()
+    with open(tmp_path / "chain.npy", "r+b") as fh:
+        fh.truncate(fh.seek(0, 2) // 2)
+    with pytest.raises(CheckpointError, match="no verified .bak"):
+        run_supervised(_gibbs(synth_pta), x0, tmp_path, 40,
+                       save_every=SAVE, sleep=lambda s: None)
+
+
+def test_nan_chunk_rewinds_and_recovers_bitwise(synth_pta, x0, baseline,
+                                                tmp_path):
+    """A transiently NaN'd stretch of recorded rows: the sentinel stops
+    it before the checkpoint, the retry rewinds and replays clean."""
+    faults.inject("nan_rows", at_row=45)
+    chain, rep = run_supervised(_gibbs(synth_pta), x0, tmp_path, NITER,
+                                save_every=SAVE, sleep=lambda s: None)
+    assert np.array_equal(chain, baseline)
+    assert rep.retries == 1 and rep.refolds == 0
+    assert rep.failures[0]["kind"] == "divergence"
+    divs = [e for e in _events(tmp_path) if e.get("event") == "divergence"]
+    assert divs and divs[0]["row"] == 45 and divs[0]["what"] == "nonfinite"
+
+
+def test_repeated_divergence_refolds_prng(synth_pta, x0, baseline,
+                                          tmp_path):
+    """The same divergence reproducing on the deterministic replay means
+    rewind-and-replay cannot help: the supervisor refolds the checkpoint
+    PRNG so the re-draw takes a fresh stream (and the final chain is, by
+    design, NOT the baseline's past the refold point)."""
+    faults.inject("nan_rows", at_row=45, times=2)
+    chain, rep = run_supervised(_gibbs(synth_pta), x0, tmp_path, NITER,
+                                save_every=SAVE, sleep=lambda s: None)
+    assert np.isfinite(chain).all()
+    assert rep.retries == 2 and rep.refolds == 1
+    assert np.array_equal(chain[:40], baseline[:40])     # pre-checkpoint
+    assert not np.array_equal(chain[40:], baseline[40:])  # fresh stream
+    assert any(e.get("event") == "prng_refold" for e in _events(tmp_path))
+
+
+def test_fake_xla_error_backoff_and_bitwise_recovery(synth_pta, x0,
+                                                     baseline, tmp_path):
+    """Device-class failures retry under capped exponential backoff; the
+    final flush bounds the loss so the retry resumes past the fault row
+    and the result is bit-identical."""
+    faults.inject("xla_error", point="sample.loop", at_row=30, times=3)
+    delays = []
+    chain, rep = run_supervised(_gibbs(synth_pta), x0, tmp_path, NITER,
+                                save_every=SAVE, backoff_base=0.5,
+                                backoff_cap=1.0, jitter=0.0,
+                                sleep=delays.append)
+    assert np.array_equal(chain, baseline)
+    assert [f["kind"] for f in rep.failures] == ["device"] * 3
+    assert delays == [0.5, 1.0, 1.0]          # doubling, then capped
+    retries = [e for e in _events(tmp_path)
+               if e.get("event") == "supervised_retry"]
+    assert [r["backoff_s"] for r in retries] == [0.5, 1.0, 1.0]
+
+
+def test_final_flush_bounds_loss_on_interrupt(synth_pta, x0, tmp_path):
+    """A failure between checkpoints still persists every verified row
+    (satellite: try/finally flush) — the fault fires at row 30, past the
+    row-20 checkpoint, yet resume starts from row 30, not 20."""
+    faults.inject("xla_error", point="sample.loop", at_row=30)
+    g = _gibbs(synth_pta)
+    with pytest.raises(faults.XlaRuntimeError):
+        g.sample(x0, outdir=tmp_path, niter=NITER, save_every=SAVE)
+    from pulsar_timing_gibbsspec_tpu.sampler.chains import ChainStore
+
+    store = ChainStore(tmp_path, g.param_names, g.b_param_names)
+    got = store.load_resume()
+    assert got is not None and got[2] == 30
+    assert any(e.get("event") == "final_flush"
+               for e in _events(tmp_path))
+
+
+def test_jax_nan_chunk_recovers_bitwise(synth_pta, tmp_path):
+    """Jax-backend case: injected NaN rows rewind to the checkpoint and
+    replay; jax resume is bitwise (per-sweep keys are pure in the
+    absolute iteration index), so recovery is exactly equal too."""
+    x0 = synth_pta.initial_sample(np.random.default_rng(0))
+    kw = dict(backend="jax", seed=3, progress=False, warmup_sweeps=2,
+              chunk_size=4)
+    base_dir = tmp_path / "base"
+    base = PTABlockGibbs(synth_pta, **kw).sample(
+        x0, outdir=base_dir, niter=16, save_every=4)
+    faults.inject("nan_rows", at_row=10, backend="jax")
+    g = PTABlockGibbs(synth_pta, **kw)
+    chain, rep = run_supervised(g, x0, tmp_path / "chaos", 16,
+                                save_every=4, sleep=lambda s: None)
+    assert np.array_equal(chain, base)
+    assert rep.retries == 1
+    assert rep.failures[0]["kind"] == "divergence"
+
+
+def test_jax_degrades_to_numpy_and_completes(synth_pta, tmp_path):
+    """After degrade_after consecutive device failures the supervisor
+    swaps in the numpy oracle, which adopts the jax checkpoint (same
+    rows, fresh deterministic RNG) and finishes the run."""
+    x0 = synth_pta.initial_sample(np.random.default_rng(0))
+    g = PTABlockGibbs(synth_pta, backend="jax", seed=3, progress=False,
+                      warmup_sweeps=2, chunk_size=4)
+    faults.inject("xla_error", point="sample.loop", at_row=8, times=10,
+                  backend="jax")
+    chain, rep = run_supervised(g, x0, tmp_path, 16, save_every=4,
+                                degrade_after=2, sleep=lambda s: None)
+    assert rep.degradations == 1 and rep.backend == "numpy"
+    assert telemetry.get("degradations") == 1
+    assert chain.shape[0] == 16 and np.isfinite(chain).all()
+    evs = [e for e in _events(tmp_path)
+           if e.get("event") == "backend_degraded"]
+    assert evs and evs[0]["to"] == "numpy"
+    # the numpy continuation preserved the jax prefix on disk
+    saved = np.load(tmp_path / "chain.npy")
+    assert saved.shape == (16, chain.shape[1])
+    assert np.isfinite(saved).all()
+
+
+def test_supervisor_gives_up_after_max_retries(synth_pta, x0, tmp_path):
+    faults.inject("xla_error", point="sample.loop", at_row=10, times=99)
+    with pytest.raises(faults.XlaRuntimeError):
+        run_supervised(_gibbs(synth_pta), x0, tmp_path, NITER,
+                       save_every=SAVE, max_retries=2, allow_degrade=False,
+                       sleep=lambda s: None)
+    evs = [e.get("event") for e in _events(tmp_path)]
+    assert "supervised_giving_up" in evs
+    assert evs.count("supervised_failure") == 3       # 1 + 2 retries
+
+
+def test_report_counters_match_telemetry(synth_pta, x0, tmp_path):
+    faults.inject("crash", point="chainstore.between_replaces", at_row=40)
+    _, rep = run_supervised(_gibbs(synth_pta), x0, tmp_path, NITER,
+                            save_every=SAVE, sleep=lambda s: None)
+    assert rep.attempts == 2
+    assert telemetry.get("retries") == rep.retries == 1
+    d = rep.as_dict()
+    assert d["backend"] == "numpy" and len(d["failures"]) == 1

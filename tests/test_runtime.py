@@ -1,0 +1,312 @@
+"""Unit tests for the resilience runtime (pulsar_timing_gibbsspec_tpu.
+runtime): telemetry counters, fault arming semantics, checkpoint
+manifest/verify/rotate/rollback, sentinel monitor, failure taxonomy,
+backoff schedule, and the ChainStore satellite fixes (hdf5 tmp cleanup,
+non-tty progress)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from pulsar_timing_gibbsspec_tpu.runtime import (faults, integrity,
+                                                 sentinels, supervisor,
+                                                 telemetry)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+# ---- telemetry -------------------------------------------------------------
+
+def test_telemetry_counters():
+    telemetry.reset()
+    assert telemetry.get("retries") == 0
+    telemetry.incr("retries")
+    telemetry.incr("retries", 2)
+    assert telemetry.get("retries") == 3
+    snap = telemetry.snapshot()
+    assert snap["retries"] == 3
+    snap["retries"] = 99                      # snapshot is a copy
+    assert telemetry.get("retries") == 3
+    telemetry.reset()
+    assert telemetry.snapshot() == {}
+
+
+# ---- faults ----------------------------------------------------------------
+
+def test_fault_fires_once_at_row():
+    f = faults.inject("crash", point="p", at_row=10)
+    faults.fire("p", row=5)                   # below threshold: no-op
+    faults.fire("other", row=50)              # wrong seam: no-op
+    with pytest.raises(faults.InjectedCrash):
+        faults.fire("p", row=12)
+    faults.fire("p", row=20)                  # consumed: no-op
+    assert f.fired == 1
+
+
+def test_fault_backend_filter_and_context_manager():
+    with faults.injected("xla_error", point="p", at_row=0, backend="jax"):
+        faults.fire("p", row=1, backend="numpy")     # filtered out
+        with pytest.raises(faults.XlaRuntimeError):
+            faults.fire("p", row=1, backend="jax")
+    faults.fire("p", row=1, backend="jax")    # disarmed on exit
+
+
+def test_mutate_rows_poisons_only_target_row():
+    chain = np.zeros((10, 3))
+    bchain = np.zeros((10, 4))
+    faults.inject("nan_rows", at_row=6)
+    faults.mutate_rows(chain, bchain, 0, 5)   # row 6 not in [0, 5)
+    assert np.isfinite(chain).all()
+    faults.mutate_rows(chain, bchain, 5, 8)
+    assert np.isnan(chain[6]).all() and np.isnan(bchain[6]).all()
+    assert np.isfinite(chain[:6]).all() and np.isfinite(chain[7:]).all()
+
+
+def test_file_damage_kinds(tmp_path):
+    p = tmp_path / "chain.npy"
+    np.save(p, np.arange(100.0))
+    size = p.stat().st_size
+    faults.inject("truncate_file", point="s", at_row=0, path="chain.npy")
+    faults.fire("s", row=1, outdir=tmp_path)
+    assert p.stat().st_size < size
+    np.save(p, np.arange(100.0))
+    sha = integrity.file_sha256(p)
+    faults.inject("corrupt_file", point="s", at_row=0, path="chain.npy")
+    faults.fire("s", row=1, outdir=tmp_path)
+    assert p.stat().st_size == size           # same size, different bytes
+    assert integrity.file_sha256(p) != sha
+
+
+# ---- integrity -------------------------------------------------------------
+
+def _write_set(d, rows=5):
+    np.save(d / "chain.npy", np.arange(rows * 3.0).reshape(rows, 3))
+    np.save(d / "bchain.npy", np.ones((rows, 4)))
+    np.savez(d / "adapt.npz", iter=np.int64(rows), rng=np.arange(6))
+    return integrity.write_manifest(d, rows=rows)
+
+
+def test_manifest_roundtrip_and_verify(tmp_path):
+    man = _write_set(tmp_path)
+    assert man["schema"] == integrity.SCHEMA_VERSION
+    assert man["files"]["chain.npy"]["shape"] == [5, 3]
+    assert man["files"]["chain.npy"]["dtype"] == "float64"
+    rep = integrity.verify(tmp_path)
+    assert rep["ok"] and rep["rows"] == 5
+
+
+def test_verify_catches_truncation_and_corruption(tmp_path):
+    _write_set(tmp_path)
+    with open(tmp_path / "bchain.npy", "r+b") as fh:
+        fh.truncate(40)
+    rep = integrity.verify(tmp_path)
+    assert not rep["ok"] and rep["bad"] == ["bchain.npy"]
+    _write_set(tmp_path)
+    with open(tmp_path / "chain.npy", "r+b") as fh:
+        fh.seek(80)
+        fh.write(b"\xff\xff\xff\xff")         # same size, flipped bytes
+    rep = integrity.verify(tmp_path)
+    assert not rep["ok"] and rep["bad"] == ["chain.npy"]
+
+
+def test_unparseable_manifest_fails_verification(tmp_path):
+    _write_set(tmp_path)
+    (tmp_path / "manifest.json").write_text("{not json")
+    assert not integrity.verify(tmp_path)["ok"]
+
+
+def test_rotate_and_rollback(tmp_path):
+    telemetry.reset()
+    _write_set(tmp_path, rows=5)
+    assert integrity.rotate_backup(tmp_path)
+    _write_set(tmp_path, rows=8)              # new generation
+    # damage the current set; the .bak generation must restore rows=5
+    with open(tmp_path / "chain.npy", "r+b") as fh:
+        fh.truncate(30)
+    assert not integrity.verify(tmp_path)["ok"]
+    assert integrity.rollback(tmp_path)
+    rep = integrity.verify(tmp_path)
+    assert rep["ok"] and rep["rows"] == 5
+    assert len(np.load(tmp_path / "chain.npy")) == 5
+    assert telemetry.get("rollbacks") == 1
+
+
+def test_rotate_refuses_unverified_set(tmp_path):
+    _write_set(tmp_path, rows=5)
+    assert integrity.rotate_backup(tmp_path)
+    _write_set(tmp_path, rows=8)
+    with open(tmp_path / "chain.npy", "r+b") as fh:
+        fh.truncate(30)
+    # the torn current set must NOT overwrite the good backup
+    assert not integrity.rotate_backup(tmp_path)
+    assert integrity.verify(tmp_path, integrity.read_manifest(
+        tmp_path, integrity.MANIFEST_BAK), suffix=".bak")["ok"]
+
+
+def test_rollback_without_backup_fails(tmp_path):
+    _write_set(tmp_path)
+    assert not integrity.rollback(tmp_path)
+
+
+# ---- sentinels -------------------------------------------------------------
+
+def test_check_rows_names_first_bad_row():
+    chain = np.zeros((10, 3))
+    bchain = np.zeros((10, 2))
+    sentinels.check_rows(chain, bchain, 0, 10)       # clean: no raise
+    chain[7, 1] = np.nan
+    with pytest.raises(sentinels.ChainDivergence) as ei:
+        sentinels.check_rows(chain, bchain, 5, 10)
+    assert ei.value.row == 7 and ei.value.what == "nonfinite"
+    sentinels.check_rows(chain, bchain, 0, 7)        # before the bad row
+
+
+def test_monitor_collapse_warns_stuck_raises():
+    mon = sentinels.SentinelMonitor(collapse_frac=0.1, stuck_chunks=2)
+    ok = {"finite": np.array([True]), "move_frac": np.array([0.5])}
+    low = {"finite": np.array([True]), "move_frac": np.array([0.01])}
+    dead = {"finite": np.array([True]), "move_frac": np.array([0.0])}
+    assert mon.observe(ok, 10) == []
+    ev = mon.observe(low, 20)
+    assert ev and ev[0]["event"] == "mh_acceptance_collapse"
+    assert mon.observe(dead, 30) == []               # streak 1: tolerated
+    with pytest.raises(sentinels.ChainDivergence) as ei:
+        mon.observe(dead, 40)                        # streak 2: wedged
+    assert ei.value.what == "stuck_chain"
+    mon.reset_run()
+    assert mon.observe(dead, 50) == []               # streak reset
+
+
+def test_refold_changes_numpy_rng_stream(tmp_path):
+    rng = np.random.default_rng(7)
+    from pulsar_timing_gibbsspec_tpu.sampler.blocks import (rng_state_pack,
+                                                            rng_state_unpack)
+
+    np.savez(tmp_path / "adapt.npz", iter=np.int64(3),
+             rng_state=rng_state_pack(rng))
+    before = np.load(tmp_path / "adapt.npz")["rng_state"]
+    assert sentinels.refold_checkpoint_key(tmp_path, salt=1)
+    after = np.load(tmp_path / "adapt.npz")["rng_state"]
+    assert not np.array_equal(before, after)
+    # deterministic: same salt from the same state -> same refold
+    r2 = np.random.default_rng()
+    rng_state_unpack(r2, after)
+    assert sentinels.refold_checkpoint_key(tmp_path, salt=9)
+    # a second refold with a different salt moves the state again
+    assert not np.array_equal(
+        after, np.load(tmp_path / "adapt.npz")["rng_state"])
+
+
+def test_refold_jax_key_and_manifest_update(tmp_path):
+    import jax.random as jr
+
+    key = jr.key(0)
+    np.savez(tmp_path / "adapt.npz", iter=np.int64(4),
+             jax_key=np.asarray(jr.key_data(key)))
+    integrity.write_manifest(tmp_path, rows=4)
+    assert sentinels.refold_checkpoint_key(tmp_path, salt=2)
+    after = np.load(tmp_path / "adapt.npz")["jax_key"]
+    assert not np.array_equal(after, np.asarray(jr.key_data(key)))
+    assert np.array_equal(after, np.asarray(jr.key_data(
+        jr.fold_in(key, 2))))
+    # the manifest tracks the rewritten adapt.npz
+    assert integrity.verify(tmp_path)["ok"]
+
+
+# ---- supervisor taxonomy + backoff ----------------------------------------
+
+def test_classify_failure_table():
+    cf = supervisor.classify_failure
+    assert cf(faults.InjectedCrash("x")) == "crash"
+    assert cf(integrity.CheckpointError("x")) == "corruption"
+    assert cf(sentinels.ChainDivergence("x")) == "divergence"
+    assert cf(FloatingPointError("NaN at iteration 5")) == "divergence"
+    assert cf(faults.XlaRuntimeError("INTERNAL: boom")) == "device"
+    assert cf(RuntimeError("RESOURCE EXHAUSTED: out of memory")) == "device"
+    assert cf(ValueError("x0 has shape (3,)")) == "user"
+    assert cf(RuntimeError("cannot resume - nchains mismatch")) == "user"
+    assert cf(RuntimeError("Disallowed host-to-device transfer "
+                           "(transfer guard)")) == "user"
+    assert cf(OSError("disk full")) == "crash"
+
+
+def test_backoff_capped_deterministic():
+    d = [supervisor.backoff_delay(r, base=0.5, cap=4.0, jitter=0.0)
+         for r in range(1, 7)]
+    assert d == [0.5, 1.0, 2.0, 4.0, 4.0, 4.0]       # doubles, then caps
+    a = supervisor.backoff_delay(3, jitter=0.25, seed=1)
+    b = supervisor.backoff_delay(3, jitter=0.25, seed=1)
+    assert a == b                                     # reproducible jitter
+    assert supervisor.backoff_delay(3, jitter=0.25, seed=2) != a
+
+
+def test_supervisor_reraises_user_bugs_immediately(synth_pta, tmp_path):
+    from pulsar_timing_gibbsspec_tpu.sampler.gibbs import PTABlockGibbs
+
+    g = PTABlockGibbs(synth_pta, backend="numpy", seed=1, progress=False)
+    calls = []
+    with pytest.raises(ValueError, match="parameters"):
+        supervisor.run_supervised(g, np.zeros(99), tmp_path, 10,
+                                  sleep=calls.append)
+    assert calls == []                                # no retry, no sleep
+
+
+# ---- ChainStore satellites -------------------------------------------------
+
+def test_export_hdf5_cleans_tmp_on_failure(tmp_path):
+    h5py = pytest.importorskip("h5py")
+    from pulsar_timing_gibbsspec_tpu.sampler.chains import ChainStore
+
+    store = ChainStore(tmp_path, ["a", "b"], ["c"])
+    chain = np.zeros((4, 2))
+    bchain = np.zeros((4, 1))
+    with pytest.raises(Exception):
+        # an attribute h5py cannot serialize fails the export mid-write
+        store.export_hdf5(chain, bchain, 4,
+                          extra_attrs={"bad": object()})
+    assert not (tmp_path / "chain.h5.tmp").exists()
+    # a later retry succeeds from a clean slate
+    store.export_hdf5(chain, bchain, 4)
+    assert (tmp_path / "chain.h5").exists()
+    with h5py.File(tmp_path / "chain.h5") as fh:
+        assert fh.attrs["niter"] == 4
+
+
+def test_progress_plain_lines_when_not_tty(synth_pta, tmp_path, capsys):
+    from pulsar_timing_gibbsspec_tpu.sampler.gibbs import PTABlockGibbs
+
+    g = PTABlockGibbs(synth_pta, backend="numpy", seed=1, progress=True)
+    x0 = synth_pta.initial_sample(np.random.default_rng(0))
+    g.sample(x0, outdir=tmp_path, niter=30, save_every=10)
+    out = capsys.readouterr().out
+    assert "\r" not in out                    # captured stdout is not a tty
+    lines = [ln for ln in out.splitlines() if ln]
+    assert len(lines) >= 3 and all("rows" in ln for ln in lines)
+
+
+def test_torn_legacy_checkpoint_warns_and_logs(synth_pta, tmp_path):
+    from pulsar_timing_gibbsspec_tpu.sampler.chains import ChainStore
+    from pulsar_timing_gibbsspec_tpu.sampler.gibbs import PTABlockGibbs
+
+    g = PTABlockGibbs(synth_pta, backend="numpy", seed=1, progress=False)
+    x0 = synth_pta.initial_sample(np.random.default_rng(0))
+    g.sample(x0, outdir=tmp_path, niter=20, save_every=10)
+    # simulate a legacy torn write: shorten bchain, drop the manifest
+    b = np.load(tmp_path / "bchain.npy")
+    np.save(tmp_path / "bchain.npy", b[:15])
+    (tmp_path / "manifest.json").unlink()
+    (tmp_path / "manifest.bak.json").unlink(missing_ok=True)
+    store = ChainStore(tmp_path, g.param_names, g.b_param_names)
+    with pytest.warns(RuntimeWarning, match="torn checkpoint"):
+        got = store.load_resume()
+    assert got is not None and got[2] == 15   # common prefix
+    events = [json.loads(ln) for ln in open(tmp_path / "metrics.jsonl")]
+    torn = [e for e in events if e.get("event") == "torn_checkpoint"]
+    assert torn and torn[0]["file"] == "bchain.npy"
+    assert torn[0]["chain_rows"] == 20 and torn[0]["bchain_rows"] == 15

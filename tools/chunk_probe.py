@@ -56,9 +56,10 @@ def main():
     if args.overlap:
         # prime the steady chunk fn (first call pays the XLA compile;
         # keeping it out of the timed loop)
-        x, b_dev, xs, bs = fn(x, b_dev, drv.key, jnp.asarray(ii, jnp.int32),
-                              drv._aux(chain, ii),
-                              jnp.asarray(args.chunk, jnp.int32))
+        x, b_dev, xs, bs, _h = fn(x, b_dev, drv.key,
+                                  jnp.asarray(ii, jnp.int32),
+                                  drv._aux(chain, ii),
+                                  jnp.asarray(args.chunk, jnp.int32))
         _ = np.asarray(x)[0, 0]
         ii += args.chunk
         pending = None
@@ -66,9 +67,9 @@ def main():
         for rep in range(args.nchunks + 1):
             t0 = time.time()
             aux = drv._aux(chain, ii)
-            x, b_dev, xs, bs = fn(x, b_dev, drv.key,
-                                  jnp.asarray(ii, jnp.int32), aux,
-                                  jnp.asarray(args.chunk, jnp.int32))
+            x, b_dev, xs, bs, _h = fn(x, b_dev, drv.key,
+                                      jnp.asarray(ii, jnp.int32), aux,
+                                      jnp.asarray(args.chunk, jnp.int32))
             t1 = time.time()
             if pending is not None:
                 pxs, pbs = pending
@@ -99,9 +100,9 @@ def main():
         t0 = time.time()
         aux = drv._aux(chain, ii)
         t1 = time.time()
-        x, b_dev, xs, bs = fn(x, b_dev, drv.key,
-                              jnp.asarray(ii, jnp.int32), aux,
-                              jnp.asarray(args.chunk, jnp.int32))
+        x, b_dev, xs, bs, _h = fn(x, b_dev, drv.key,
+                                  jnp.asarray(ii, jnp.int32), aux,
+                                  jnp.asarray(args.chunk, jnp.int32))
         t2 = time.time()
         # block on the tiny carry first: this isolates pure device compute
         # from the record transfers below
