@@ -61,13 +61,46 @@ def build_pta(n_psr=45, nbins=10, orf="crn"):
 NWINDOWS = 5
 
 
+def _trim_steady(marks, nwin=NWINDOWS):
+    """Drop the trailing marks that cannot belong to a steady window:
+
+    - a PARTIAL trailing chunk (iteration stride below the modal chunk
+      stride) measures a different amount of work per mark than every
+      other window member;
+    - the FINAL chunk's device-to-host writeback has no following compute
+      to overlap with (the double-buffered steady loop drains there), so
+      its interval mixes transfer drain into the rate.  BENCH_r05's last
+      window read 12.92 vs ~63 (crn) and 17.31 vs ~24 (hd) purely from
+      this contamination.
+
+    Only the *rate* computation trims; the raw marks stay complete in the
+    JSON (``_raw_marks``) so the drain remains visible and re-derivable.
+    The drain drop only applies to chunked marks (stride > 1) with enough
+    marks left for ``nwin`` real windows — the numpy oracle's per-sweep
+    marks have no writeback to drain."""
+    marks = np.asarray(marks, dtype=np.float64)
+    if len(marks) < 4:
+        return marks
+    strides = np.diff(marks[:, 0])
+    modal = float(np.median(strides[:-1]))
+    if strides[-1] < modal:
+        marks = marks[:-1]
+    if modal > 1 and len(marks) >= nwin + 2:
+        marks = marks[:-1]
+    return marks
+
+
 def _window_rates(marks, nwin=NWINDOWS):
     """Per-window sweep rates from (iteration, time) marks split into
     ``nwin`` equal spans (median-of-windows absorbs tunnel hiccups; >=5
-    windows so the median has real support)."""
+    windows so the median has real support).  Incomplete trailing work —
+    a partial final chunk or the un-overlapped final writeback — is
+    trimmed first (``_trim_steady``) so the last window measures the same
+    steady process as the others."""
     marks = np.asarray(marks, dtype=np.float64)
     if len(marks) < 2:
         return []
+    marks = _trim_steady(marks, nwin)
     if len(marks) < nwin + 1:
         its, ts = marks[-1, 0] - marks[0, 0], marks[-1, 1] - marks[0, 1]
         return [float(its / ts)] if ts > 0 else []
@@ -259,7 +292,17 @@ def bench_config(orf, n_psr, niter, np_iters, adapt, nchains, profile,
         "numpy_raw": np_raw,
     }
     if prof is not None:
-        out["per_block_ms"] = {k: round(v * 1e3, 3) for k, v in prof.items()}
+        out["per_block_ms"] = {k: round(v, 3)
+                               for k, v in prof["per_block_ms"].items()}
+        # reconciliation companions (see profiling.profile_blocks): which
+        # blocks are actually in THIS config's every-sweep body, their
+        # subtotal, and the composed sweep they must reconcile with — so
+        # per_block_ms can't silently mix off-sweep entries (the r05
+        # b_draw=403.8-next-to-full_sweep=10.8 misread)
+        out["per_block_in_sweep"] = prof["in_sweep"]
+        out["sum_blocks_ms"] = round(prof["sum_blocks_ms"], 3)
+        out["full_sweep_ms"] = round(prof["full_sweep_ms"], 3)
+        out["dispatch_ms"] = round(prof["dispatch_ms"], 3)
     # resilience counters (runtime.telemetry): retries/rollbacks/refolds
     # accumulated during this process plus the driver's last on-device
     # health reductions — a long bench that silently retried or rolled
@@ -391,10 +434,12 @@ def main(argv=None):
         # sets), so the default stays 32 — ~2.9x faster per sweep than
         # r4.  The CRN path, whose knee was the tunnel writeback, keeps
         # scaling to 64.
+        # HD per-block profile rides this leg (the structured joint
+        # b-draw is the block the ISSUE-3 acceptance reads here)
         hd = bench_config("hd", n_psr, max(100, niter // 4),
                           max(5, np_iters // 4), adapt,
                           nchains if args.nchains else min(nchains, 32),
-                          profile=False, record=args.record,
+                          profile=profile, record=args.record,
                           record_every=args.record_every)
     elif args.orf == "both":
         # own interpreter: the big correlated-ORF program has crashed the
@@ -407,9 +452,11 @@ def main(argv=None):
         cmd = [sys.executable, os.path.abspath(__file__), "--orf", "hd",
                "--niter", str(niter), "--numpy-iters", str(np_iters),
                "--nchains", str(nchains if args.nchains
-                                else min(nchains, 32)), "--no-profile",
+                                else min(nchains, 32)),
                "--record", args.record,
                "--record-every", str(args.record_every)]
+        if not profile:
+            cmd.append("--no-profile")
         if args.quick:
             cmd.append("--quick")
         try:
@@ -449,7 +496,10 @@ def main(argv=None):
     if head.get("thinned_k4") is not None:
         out["thinned_k4"] = head["thinned_k4"]
     if crn is not None and "per_block_ms" in crn:
-        out["per_block_ms"] = crn["per_block_ms"]
+        for k in ("per_block_ms", "per_block_in_sweep", "sum_blocks_ms",
+                  "full_sweep_ms", "dispatch_ms"):
+            if k in crn:
+                out[k] = crn[k]
     if hd is not None:
         out["hd"] = hd
     print(json.dumps(out))

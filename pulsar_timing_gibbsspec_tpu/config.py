@@ -51,6 +51,18 @@ class Settings:
     #: (reference uses 1000, pulsar_gibbs.py:228)
     rho_grid_size: int = 1000
 
+    #: mixed-precision mode of the structured correlated-ORF joint b-draw
+    #: (sampler/jax_backend.draw_b_joint_structured): when on, the steady
+    #: (exact=False) draw factors both stages with the two-float MXU
+    #: kernel — an f32 factorization plus one iterative-refinement step
+    #: (ops/linalg.tf_chol_factor's residual congruence correction, the
+    #: same pattern as the segmented f32 Gram) — carrying the accepted,
+    #: condition-independent O(n*eps_f32) error class the sequential HD
+    #: kernel already KS-validated.  Off forces the f64 blocked factor
+    #: everywhere.  Warmup/refresh draws (exact=True) are always f64
+    #: regardless of this flag (the breakdown-margin contract).
+    joint_mixed: bool = os.environ.get("PTGIBBS_JOINT_MIXED", "1") != "0"
+
     #: persistent XLA compilation cache (first 45-pulsar compile costs
     #: minutes through the remote-compile tunnel; cached reruns are free).
     #: Empty string disables.

@@ -268,3 +268,115 @@ def blocked_chol_inv(A):
     arithmetic — no precision compromise vs ``jnp.linalg.cholesky``.
     """
     return _cholinv_rec(A)
+
+
+# ---------------------------------------------------------------------------
+# block-grid Cholesky: factorization over an m x m grid of P x P blocks
+# ---------------------------------------------------------------------------
+#
+# The correlated-ORF joint b-draw's Schur complement on the GW subspace is
+# a (2K, 2K) grid of (P, P) blocks: diagonal-in-pulsar TNT couplings on
+# every grid cell plus the dense cross-pulsar HD prior G^-1/rho_k on the
+# grid diagonal only.  A dense (2KP, 2KP) recursion works but its program
+# size grows with the flattened dimension (the same growth that capped the
+# old dense joint draw at HD_DENSE_MAX total coefficients); the grid
+# factorization below keeps every operation at the (P, P) block size — m
+# unrolled stages, each one diagonal-block recursion plus batched (P, P)
+# matmul trailing updates — so the compiled program scales with m, not
+# (mP)^2, and the matmuls stay MXU-shaped.  It is the SAME Cholesky (same
+# ordering, same arithmetic up to f64 roundoff) as factoring the flattened
+# matrix, so the sampled law is identical to the dense reference path.
+
+def _mm_t(a, b, transpose_b=False):
+    """f64 batched matmul with the tf_mm calling convention, so the grid
+    factorization can swap between exact and two-float instantiations."""
+    if transpose_b:
+        b = jnp.swapaxes(b, -1, -2)
+    return _mm(a, b)
+
+
+def block_grid_cholinv(S, factor=None, mm=None):
+    """Blocked right-looking Cholesky of an SPD matrix laid out as an
+    ``(..., m, m, P, P)`` grid of blocks (``S[..., i, j]`` is block row
+    ``i``, block column ``j``; grid-symmetric: ``S[i, j] == S[j, i]^T``).
+
+    Returns ``(Ld, Ldi, Loff)``:
+
+    - ``Ld``  ``(..., m, P, P)``: the lower-triangular diagonal blocks of
+      the factor ``L``;
+    - ``Ldi`` ``(..., m, P, P)``: their explicit inverses (every solve
+      below is a batched matvec, the :func:`blocked_chol_inv` discipline);
+    - ``Loff`` ``(..., m, m, P, P)``: the strictly-lower off-diagonal
+      blocks of ``L`` (zeros elsewhere).
+
+    ``factor`` is the per-diagonal-block ``(L, L^-1)`` routine
+    (:func:`blocked_chol_inv` for f64, :func:`tf_chol_factor` for the
+    two-float mixed-precision mode) and ``mm`` the matching matmul with
+    the ``(a, b, transpose_b=False)`` convention (:func:`_mm_t` /
+    :func:`tf_mm`).  ``m`` is unrolled at trace time.
+    """
+    if factor is None:
+        factor = _cholinv_rec
+    if mm is None:
+        mm = _mm_t
+    m = S.shape[-4]
+    Ld, Ldi = [], []
+    Loff = jnp.zeros(S.shape, S.dtype)
+    T = S
+    for g in range(m):
+        Lg, Lgi = factor(T[..., 0, 0, :, :])
+        Ld.append(Lg)
+        Ldi.append(Lgi)
+        if g == m - 1:
+            break
+        # column panel: L[j, g] = T[j, 0] @ Lg^-T for all trailing j
+        Lcol = mm(T[..., 1:, 0, :, :], Lgi[..., None, :, :],
+                  transpose_b=True)                     # (..., r, P, P)
+        Loff = Loff.at[..., g + 1:, g, :, :].set(Lcol)
+        # trailing Schur update, all (j, l) pairs as one batched matmul
+        upd = mm(Lcol[..., :, None, :, :], Lcol[..., None, :, :, :],
+                 transpose_b=True)                      # (..., r, r, P, P)
+        T = T[..., 1:, 1:, :, :] - upd
+    return (jnp.stack(Ld, axis=-3), jnp.stack(Ldi, axis=-3), Loff)
+
+
+def block_grid_solve_lower(Ldi, Loff, r):
+    """``L v = r`` with the grid factor from :func:`block_grid_cholinv`;
+    ``r`` is ``(..., m, P)`` in block-major order.  Forward substitution
+    over the unrolled block stages — every step a (P, P) matvec."""
+    m = r.shape[-2]
+    vs = []
+    for g in range(m):
+        acc = r[..., g, :]
+        for j in range(g):
+            acc = acc - jnp.einsum("...ij,...j->...i",
+                                   Loff[..., g, j, :, :], vs[j],
+                                   precision="highest")
+        vs.append(jnp.einsum("...ij,...j->...i", Ldi[..., g, :, :], acc,
+                             precision="highest"))
+    return jnp.stack(vs, axis=-2)
+
+
+def block_grid_solve_upper(Ldi, Loff, r):
+    """``L^T w = r`` with the grid factor (backward substitution)."""
+    m = r.shape[-2]
+    ws = [None] * m
+    for g in reversed(range(m)):
+        acc = r[..., g, :]
+        for j in range(g + 1, m):
+            acc = acc - jnp.einsum("...ji,...j->...i",
+                                   Loff[..., j, g, :, :], ws[j],
+                                   precision="highest")
+        ws[g] = jnp.einsum("...ji,...j->...i", Ldi[..., g, :, :], acc,
+                           precision="highest")
+    return jnp.stack(ws, axis=-2)
+
+
+def block_grid_to_dense(S):
+    """``(..., m, m, P, P)`` grid -> ``(..., mP, mP)`` dense matrix in
+    block-major coordinate order (``dense[g P + p, g' P + q] =
+    S[g, g', p, q]``) — the small-system fallback path factors this with
+    one :func:`blocked_chol_inv` recursion; identical ordering means the
+    factor (hence the drawn sample) matches the grid path exactly."""
+    m, P = S.shape[-4], S.shape[-1]
+    return jnp.moveaxis(S, -2, -3).reshape(S.shape[:-4] + (m * P, m * P))

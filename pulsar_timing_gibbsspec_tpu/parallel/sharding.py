@@ -117,3 +117,56 @@ def shard_compiled(cm: CompiledPTA, mesh) -> CompiledPTA:
         ))
     updates["components"] = comps
     return dataclasses.replace(cm, **updates)
+
+
+def collective_report(fn, *example_args, max_gather_elems=None):
+    """Count the cross-device collectives XLA inserted into ``fn``'s
+    optimized HLO — the regression instrument behind the MULTICHIP
+    collective budget (``__graft_entry__`` asserts the sweep holds
+    {all-reduce, all-gather} constant and that no gather moves a
+    basis-sized operand).
+
+    Returns ``{"all-reduce": n, "all-gather": n, "gather_elems": [...]}``
+    where ``gather_elems`` lists each all-gather's operand element count
+    (shape product).  ``max_gather_elems`` raises if any gather exceeds
+    it — the guard that keeps "shard the pulsar axis, replicate x" honest:
+    per-pulsar work must never round-trip a basis-sized array.
+
+    Note on the structured correlated-ORF joint b-draw
+    (``sampler/jax_backend.draw_b_joint_structured``): its Schur stage
+    contracts the per-pulsar (2K, B) panels into (2K, 2K) grids of (P, P)
+    blocks, so under pulsar-axis sharding the only new cross-device
+    movement is the gather of those P-by-P Schur blocks — P*(2K)^2
+    elements, the same order as the existing rho-grid reductions and far
+    below any basis-sized operand — and the per-pulsar stage stays fully
+    local.  The MULTICHIP budget ({'all-reduce': 5, 'all-gather': 3} at
+    r05) is measured on the CRN sweep, which never enters the joint draw.
+    """
+    import re
+
+    import jax
+
+    hlo = (jax.jit(fn).lower(*example_args)
+           .compile().as_text())
+    counts = {"all-reduce": len(re.findall(r"\ball-reduce(?:-start)?\(",
+                                           hlo)),
+              "all-gather": len(re.findall(r"\ball-gather(?:-start)?\(",
+                                           hlo))}
+    elems = []
+    for m in re.finditer(r"all-gather(?:-start)?\(", hlo):
+        # operand shape precedes the op name on the defining line:
+        #   %x = f32[6,17]{...} all-gather(...)
+        line = hlo[hlo.rfind("\n", 0, m.start()) + 1:m.start()]
+        sm = re.search(r"\[([0-9,]*)\]", line)
+        if sm:
+            dims = [int(v) for v in sm.group(1).split(",") if v]
+            elems.append(int(np.prod(dims)) if dims else 1)
+    counts["gather_elems"] = sorted(elems)
+    if max_gather_elems is not None:
+        too_big = [e for e in elems if e > max_gather_elems]
+        if too_big:
+            raise RuntimeError(
+                f"all-gather operand(s) of {too_big} elements exceed the "
+                f"{max_gather_elems}-element budget — a basis-sized array "
+                "is crossing the mesh")
+    return counts

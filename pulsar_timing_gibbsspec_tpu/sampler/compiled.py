@@ -423,6 +423,28 @@ class CompiledPTA:
         Gi = Li.T @ Li                      # (L L^T)^-1 = L^-T L^-1
         return jnp.broadcast_to(Gi, (max(self.K, 1), self.P, self.P))
 
+    def gw_cols_valid(self):
+        """``(cols, valid, ccl)`` for the GW coefficient columns in
+        group-major order — the shared gather layout of every
+        correlated-ORF b-draw kernel (joint/sequential/freqblock):
+
+        - ``cols``  ``(P, 2K)`` int32: per-pulsar b-column of GW group
+          ``t`` (groups ordered ``[sin k=0..K-1 | cos k=0..K-1]``;
+          out-of-range entries mark pulsars without that frequency);
+        - ``valid`` ``(P, 2K)`` cdtype: in-range indicator;
+        - ``ccl``   ``(P, 2K)``: clipped gather-safe indices (gathers
+          through ``ccl`` must be masked by ``valid`` — a clipped slot
+          can collide with a real column).
+        """
+        import jax.numpy as jnp
+
+        gsin = jnp.asarray(self.gw_sin_ix, jnp.int32)
+        gcos = jnp.asarray(self.gw_cos_ix, jnp.int32)
+        cols = jnp.concatenate([gsin, gcos], axis=1)
+        valid = ((cols >= 0) & (cols < self.Bmax)).astype(self.cdtype)
+        ccl = jnp.clip(cols, 0, self.Bmax - 1)
+        return cols, valid, ccl
+
     def gw_tau(self, b):
         """(P, K) per-frequency ``(b_sin^2 + b_cos^2)/2``
         (reference ``pulsar_gibbs.py:208-209``)."""

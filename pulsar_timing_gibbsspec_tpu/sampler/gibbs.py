@@ -92,7 +92,7 @@ class _GibbsBase:
             for k in ("record_precision", "record_every", "nchains",
                       "chunk_size", "pad_pulsars", "mesh", "warmup_sweeps",
                       "warmup_white_steps", "white_steps_max",
-                      "exact_every", "transfer_guard"):
+                      "exact_every", "transfer_guard", "joint_mixed"):
                 opts.pop(k, None)
         return type(self)(self.pta, hypersample=c["hypersample"],
                           ecorrsample=c["ecorrsample"],

@@ -39,6 +39,7 @@ chain adaptation state), so C chains multiply posterior samples/sec by
 from __future__ import annotations
 
 import os
+from typing import NamedTuple
 
 import numpy as np
 
@@ -304,7 +305,16 @@ def lnlike_fullmarg_fn(cm: CompiledPTA, x, TNT, d):
         jnp.sum(d * expval, axis=-1) - logdet_sigma - logdet_phi)
 
 
-def draw_b_fn(cm: CompiledPTA, x, key, b=None, exact=False):
+def _joint_kernel_active(cm: CompiledPTA):
+    """True when the correlated-ORF b-draw routes to the structured joint
+    kernel (:func:`draw_b_joint_structured`) — the production default —
+    rather than one of the sequential/frequency-block alternatives kept
+    selectable through ``PTGIBBS_HD_KERNEL`` past ``HD_DENSE_MAX``."""
+    return (HD_SCALABLE_KERNEL == "joint"
+            or cm.P * cm.Bmax <= HD_DENSE_MAX)
+
+
+def draw_b_fn(cm: CompiledPTA, x, key, b=None, exact=False, factors=None):
     """b | everything: batched preconditioned-Cholesky Gaussian draw
     (reference ``update_b``, ``pulsar_gibbs.py:489-520``).
 
@@ -316,9 +326,13 @@ def draw_b_fn(cm: CompiledPTA, x, key, b=None, exact=False):
     correctness keeps the f64-accumulated path.
 
     With a correlated ORF the per-pulsar draws are replaced by one joint
-    cross-pulsar Gaussian (:func:`draw_b_joint`), or — past
-    ``HD_DENSE_MAX`` total coefficients — by the sequential pulsar-wise
-    conditional sweep starting from ``b`` (zeros if not given).
+    cross-pulsar Gaussian drawn through the structure-exploiting
+    two-stage factorization (:func:`draw_b_joint_structured` — the
+    production kernel at every size; ``factors`` passes a per-sweep
+    :func:`joint_factor_cache`).  ``PTGIBBS_HD_KERNEL=pulsar|freq``
+    selects the sequential / frequency-block alternatives past
+    ``HD_DENSE_MAX`` total coefficients, starting from ``b`` (zeros if
+    not given).
     """
     import jax.numpy as jnp
     import jax.random as jr
@@ -326,16 +340,17 @@ def draw_b_fn(cm: CompiledPTA, x, key, b=None, exact=False):
     from ..ops.linalg import mvn_conditional_draw
 
     if cm.orf_name != "crn":
-        if cm.P * cm.Bmax <= HD_DENSE_MAX:
-            return draw_b_joint(cm, x, key)
-        if b is None:
-            b = jnp.zeros((cm.P, cm.Bmax), cm.cdtype)
         # exact=True selects the f64 blocked factorization: the warmup /
         # initial draws run there — warmup states (prior-drawn rho, b
         # interpolating the data) push the conditional systems past the
         # two-float factor's breakdown margins (observed as seed-dependent
         # NaN warmup chains on TPU), while the ~10x cost only ever applies
         # to the few dozen warmup sweeps
+        if _joint_kernel_active(cm):
+            return draw_b_joint_structured(cm, x, key, b=b, exact=exact,
+                                           factors=factors)
+        if b is None:
+            b = jnp.zeros((cm.P, cm.Bmax), cm.cdtype)
         if HD_SCALABLE_KERNEL == "pulsar":
             return draw_b_hd_sequential(cm, x, b, key, exact=exact)
         return draw_b_hd_freqblock(cm, x, b, key, exact=exact)
@@ -679,67 +694,336 @@ def draw_b_hd_freqblock(cm: CompiledPTA, x, b, key, exact=False):
     return b
 
 
-#: scalable correlated-ORF kernel past HD_DENSE_MAX: "pulsar" (sequential
-#: pulsar-wise sweep — production) or "freq" (two-block frequency-joint).
-#: Measured (docs/HD_MIXING.md): the pulsar kernel mixes BETTER (toy
-#: ACT ratio vs the dense joint draw 1.38 vs the freq kernel's 2.71) —
-#: each pulsar's draw resolves the strong gw <-> timing-model coupling
-#: within one conditional, which dominates the cross-pulsar coupling the
-#: freq kernel resolves instead.  The freq kernel's K-length scan (vs P)
-#: is kept as the scaling alternative for futures where P >> K.
-HD_SCALABLE_KERNEL = os.environ.get("PTGIBBS_HD_KERNEL", "pulsar")
-if HD_SCALABLE_KERNEL not in ("pulsar", "freq"):
+#: correlated-ORF b-draw kernel: "joint" (production — the structured
+#: two-stage joint draw, :func:`draw_b_joint_structured`: one batched
+#: per-pulsar factorization + a block-grid Schur factorization on the GW
+#: subspace; samples the EXACT joint conditional, so it inherits the
+#: dense draw's mixing — toy ACT ratio 1.0 by construction — at program
+#: size and flop cost that scale with the 2K·P Schur subspace instead of
+#: (P·Bmax)^2), "pulsar" (sequential pulsar-wise sweep, the pre-r06
+#: production kernel: ACT ratio 1.38 vs dense, docs/HD_MIXING.md) or
+#: "freq" (two-block frequency-joint: ACT ratio 2.71, kept for the
+#: P >> K regime its K-length scan shape is right for).  "pulsar"/"freq"
+#: apply past HD_DENSE_MAX total coefficients; below it the joint draw
+#: always runs (it is both exact and the cheapest at toy size).
+HD_SCALABLE_KERNEL = os.environ.get("PTGIBBS_HD_KERNEL", "joint")
+if HD_SCALABLE_KERNEL not in ("joint", "pulsar", "freq"):
     raise ValueError(
-        f"PTGIBBS_HD_KERNEL={HD_SCALABLE_KERNEL!r}: the scalable "
-        "correlated-ORF kernel must be 'pulsar' (production) or 'freq'")
+        f"PTGIBBS_HD_KERNEL={HD_SCALABLE_KERNEL!r}: the correlated-ORF "
+        "kernel must be 'joint' (production), 'pulsar' or 'freq'")
+
+#: flatten-threshold of the structured draw's GW Schur factorization: at
+#: or below this many GW-subspace coordinates (2K·P) the (2K, 2K) grid of
+#: (P, P) blocks is flattened and factored by ONE blocked_chol_inv
+#: recursion (fewer ops for toy systems); above it the per-(frequency,
+#: phase) block-grid factorization keeps every operation at the (P, P)
+#: block size so the compiled program scales with 2K, not (2KP)^2 — the
+#: same program-size wall that capped the old dense joint draw (the dense
+#: (P·Bmax)^2 compile measured 242 s at dim 108; transport broke at dim
+#: 1665).  Both paths compute the same Cholesky in the same coordinate
+#: order, so the drawn sample is identical up to f64 roundoff.
+SCHUR_DENSE_MAX = 128
 
 
-def draw_b_joint(cm: CompiledPTA, x, key):
-    """Correlated-ORF joint b-draw over all pulsars at once.
+def _joint_perm_parts(cm: CompiledPTA, x):
+    """Shared assembly pieces of the permuted joint system — the ONE
+    coordinate ordering both the dense reference draw
+    (:func:`draw_b_joint`) and the structured two-stage draw
+    (:func:`draw_b_joint_structured`) factor, so Cholesky uniqueness
+    makes their same-key samples agree to f64 roundoff:
 
-    The inter-pulsar coupling lives only in the GW columns: the joint
-    prior per (frequency, phase) group over pulsars is ``rho_k G`` (the
-    extension the reference never finished — ``pta_gibbs.py:533`` assumes
-    phi block-diagonal, SURVEY §3.6), so the joint ``Phi^-1`` carries
-    ``G^-1 / rho_k`` on those groups and stays diagonal elsewhere.  The
-    dense ``(P Bmax, P Bmax)`` system goes through the same
-    matmul-scheduled blocked factorization as the batched per-pulsar path.
+    ``[P·Bmax "local" slots, pulsar-major (GW slots replaced by inert
+    identity coordinates) | 2K·P GW slots, group-major (sin k=0..K-1,
+    cos k=0..K-1; pulsar index inner)]``
+
+    Identity rows embedded in an SPD matrix stay exactly decoupled under
+    Cholesky (L[i,i]=1, zeros elsewhere in the row/column — the same
+    trick draw_b_hd_freqblock's block 1 uses), so the inert slots keep
+    every shape static without perturbing the real coordinates' factor;
+    their drawn values are masked out at scatter-back.  Invalid GW slots
+    (pulsars without that frequency; pad pulsars) are inert identity
+    rows in the GW section the same way.
+
+    Returns ``(TNT, d, cols, valid, ccl, gwm, nm, Snn, Tg, Agg)`` where
+    ``Snn`` is the per-pulsar local block (GW rows/cols -> identity),
+    ``Tg (P, B, 2K)`` the local-GW coupling strips (GW rows zeroed) and
+    ``Agg (P, 2K, 2K)`` the per-pulsar GW-GW TNT blocks.
     """
     import jax.numpy as jnp
-    import jax.random as jr
 
-    from ..ops.linalg import blocked_chol_inv
-
+    cdt = cm.cdtype
     B, P = cm.Bmax, cm.P
-    PB = P * B
     N = cm.ndiag_fast(x)
     TNT, d = (tnt_d_seg(cm, N) if not cm.has_ke
               else tnt_d_x(cm, x, N))   # see draw_b_hd_sequential note
     phi = cm.phi(x)
     pinv = 1.0 / phi                                     # (P, B)
     rows_p = jnp.arange(P)[:, None]
-    gw_cols = jnp.concatenate([cm.gw_sin_ix, cm.gw_cos_ix], axis=1)
-    pinv = pinv.at[rows_p, gw_cols].set(0.0, mode="drop")
-    rows = jnp.arange(P)[:, None] * B + jnp.arange(B)[None, :]
-    Sigma = jnp.zeros((PB, PB), cm.cdtype)
-    Sigma = Sigma.at[rows[:, :, None], rows[:, None, :]].set(TNT)
-    Sigma = Sigma.at[jnp.arange(PB), jnp.arange(PB)].add(pinv.reshape(PB))
-    rho = 10.0 ** (2.0 * jnp.asarray(x, cm.cdtype)[cm.rho_ix_x])   # (K,)
-    Ginv = jnp.moveaxis(cm.orf_ginv_k(x), 0, -1)                   # (P, P, K)
-    for phase_ix in (cm.gw_sin_ix, cm.gw_cos_ix):
-        frows = jnp.arange(P)[:, None] * B + phase_ix              # (P, K)
-        Sigma = Sigma.at[frows[:, None, :], frows[None, :, :]].add(
-            Ginv / rho[None, None, :])
-    dflat = d.reshape(PB)
-    diag = jnp.diagonal(Sigma)
-    dj = 1.0 / jnp.sqrt(diag)
-    A = Sigma * dj[:, None] * dj[None, :]
+    cols, valid, ccl = cm.gw_cols_valid()                # (P, 2K) each
+    gwm = jnp.zeros((P, B), cdt).at[rows_p, ccl].max(valid)
+    nm = 1.0 - gwm                                       # non-GW indicator
+    eyeB = jnp.eye(B, dtype=cdt)
+    # local block: per-pulsar Sigma with the GW prior rows zeroed and the
+    # GW rows/cols replaced by identity (diag(pinv) restricted to non-GW
+    # slots — the GW slots' prior lives in the Schur section instead)
+    Snn = (TNT + (pinv * nm)[:, :, None] * eyeB) \
+        * nm[:, :, None] * nm[:, None, :] + gwm[:, :, None] * eyeB
+    # GW strips: TNT columns at the group cols (valid-masked gathers —
+    # a clipped invalid index can collide with a real column)
+    Tcols = jnp.take_along_axis(TNT, ccl[:, None, :], axis=2) \
+        * valid[:, None, :]                              # (P, B, 2K)
+    Tg = Tcols * nm[:, :, None]                          # GW rows zeroed
+    Agg = jnp.take_along_axis(Tcols, ccl[:, :, None], axis=1) \
+        * valid[:, :, None]                              # (P, 2K, 2K)
+    return TNT, d, cols, valid, ccl, gwm, nm, Snn, Tg, Agg
+
+
+def _joint_gw_prior(cm: CompiledPTA, x, valid):
+    """(2K, P, P) group-major GW prior blocks ``G^-1/rho_k`` with inert
+    identity rows on invalid slots, plus the duplicated ``rho``/``G_pp``
+    vectors the Schur diagonal needs: ``(Dg, rho2, Gpp)``."""
+    import jax.numpy as jnp
+
+    cdt = cm.cdtype
+    P = cm.P
+    rho = 10.0 ** (2.0 * jnp.asarray(x, cdt)[cm.rho_ix_x])         # (K,)
+    Ginv = cm.orf_ginv_k(x).astype(cdt)                            # (K,P,P)
+    Gfull = jnp.concatenate([Ginv, Ginv], axis=0)                  # (2K,P,P)
+    rho2 = jnp.concatenate([rho, rho])                             # (2K,)
+    vg = valid.T                                                   # (2K, P)
+    eyeP = jnp.eye(P, dtype=cdt)
+    Dg = Gfull / rho2[:, None, None] * vg[:, :, None] * vg[:, None, :] \
+        + (1.0 - vg)[:, :, None] * eyeP
+    Gpp = jnp.diagonal(Gfull, axis1=1, axis2=2)                    # (2K, P)
+    return Dg, rho2, Gpp
+
+
+def draw_b_joint(cm: CompiledPTA, x, key):
+    """Correlated-ORF joint b-draw over all pulsars at once — the DENSE
+    reference path (one flat factorization of the full permuted system).
+
+    The inter-pulsar coupling lives only in the GW columns: the joint
+    prior per (frequency, phase) group over pulsars is ``rho_k G`` (the
+    extension the reference never finished — ``pta_gibbs.py:533`` assumes
+    phi block-diagonal, SURVEY §3.6), so the joint ``Phi^-1`` carries
+    ``G^-1 / rho_k`` on those groups and stays diagonal elsewhere.
+
+    The system is assembled in the permuted ``[local | GW group-major]``
+    ordering of :func:`_joint_perm_parts` — the production kernel
+    (:func:`draw_b_joint_structured`) factors the SAME matrix blockwise,
+    so for the same key the two draws agree to f64 roundoff (the
+    same-key acceptance test); this dense path is the oracle/reference,
+    not a sweep kernel.
+    """
+    import jax.numpy as jnp
+    import jax.random as jr
+
+    from ..ops.linalg import blocked_chol_inv
+
+    cdt = cm.cdtype
+    B, P, K = cm.Bmax, cm.P, cm.K
+    PB = P * B
+    G = 2 * K
+    n = PB + G * P
+    (TNT, d, cols, valid, ccl, gwm, nm, Snn, Tg,
+     Agg) = _joint_perm_parts(cm, x)
+    rows_p = jnp.arange(P)[:, None]
+    Dg, _, _ = _joint_gw_prior(cm, x, valid)
+    # dense assembly in the permuted layout
+    lrows = jnp.arange(P)[:, None] * B + jnp.arange(B)[None, :]    # (P, B)
+    garr = PB + jnp.arange(G)[:, None] * P + jnp.arange(P)[None, :]
+    gidx = garr.T                                                  # (P, 2K)
+    Lam = jnp.zeros((n, n), cdt)
+    Lam = Lam.at[lrows[:, :, None], lrows[:, None, :]].set(Snn)
+    Lam = Lam.at[lrows[:, :, None], gidx[:, None, :]].set(Tg)
+    Lam = Lam.at[gidx[:, :, None], lrows[:, None, :]].set(
+        jnp.swapaxes(Tg, 1, 2))
+    Lam = Lam.at[gidx[:, :, None], gidx[:, None, :]].set(Agg)
+    Lam = Lam.at[garr[:, :, None], garr[:, None, :]].add(Dg)
+    dn = (d * nm).reshape(PB)
+    dgw = (jnp.take_along_axis(d, ccl, axis=1) * valid).T.reshape(G * P)
+    dvec = jnp.concatenate([dn, dgw])
+    dj = 1.0 / jnp.sqrt(jnp.diagonal(Lam))
+    A = Lam * dj[:, None] * dj[None, :]
     _, Li = blocked_chol_inv(A)
-    u = Li @ (dj * dflat)
-    mean = dj * (Li.T @ u)
-    z = jr.normal(key, (PB,), dtype=cm.cdtype)
-    samp = mean + dj * (Li.T @ z)
-    return samp.reshape(P, B)
+    u = Li @ (dj * dvec)
+    z = jr.normal(key, (n,), dtype=cdt)
+    samp = dj * (Li.T @ (u + z))
+    bloc = samp[:PB].reshape(P, B) * nm
+    bgw = samp[PB:].reshape(G, P).T                                # (P, 2K)
+    return bloc.at[rows_p, cols].set(bgw, mode="drop")
+
+
+class JointFactors(NamedTuple):
+    """Per-pulsar stage-1 products of the structured joint draw — pure
+    functions of (Nvec, non-GW phi) only, i.e. of the white/ECORR and red
+    blocks' coordinates.  The rho draw, the rho<->b scale moves and the
+    ORF MH touch ONLY the GW prior (stage 2), so the sweep body computes
+    this cache once after the red blocks and every joint-draw sub-step of
+    the sweep reuses it (see _sweep_body)."""
+
+    d: object        # (P, B) projected data
+    cols: object     # (P, 2K) GW group columns
+    valid: object    # (P, 2K) in-range mask
+    ccl: object      # (P, 2K) clipped gather indices
+    nm: object       # (P, B) non-GW indicator
+    dj_n: object     # (P, B) local Jacobi scales
+    Li1: object      # (P, B, B) inverse stage-1 factor (preconditioned)
+    Tg: object       # (P, B, 2K) local-GW coupling strips
+    Agg: object      # (P, 2K, 2K) per-pulsar GW-GW TNT blocks
+    mixed: bool      # static: two-float stage kernels selected
+
+
+def joint_factor_cache(cm: CompiledPTA, x, exact=False, mixed=None):
+    """Stage 1 of the structured joint draw: the batched factorization of
+    the P per-pulsar local blocks (TNT + diagonal prior, GW rows/cols ->
+    identity) — :func:`ops.linalg.blocked_chol_inv` over the (P, B, B)
+    batch, or its two-float instantiation in the mixed-precision mode
+    (``settings.joint_mixed``; ``exact=True`` always takes the f64
+    factor, the warmup breakdown-margin contract).
+
+    Split out of the draw so the compiled sweep can hoist it: the cache
+    depends only on coordinates the white/ECORR/red blocks own, never on
+    (rho, ORF, b), so it is computed once per sweep and shared by every
+    joint-draw sub-step (b-draw and any Metropolised variants)."""
+    import jax.numpy as jnp
+
+    from ..ops.linalg import blocked_chol_inv, tf_chol_factor
+
+    if mixed is None:
+        mixed = settings.joint_mixed
+    use_tf = bool(mixed) and not exact
+    (TNT, d, cols, valid, ccl, gwm, nm, Snn, Tg,
+     Agg) = _joint_perm_parts(cm, x)
+    dj_n = 1.0 / jnp.sqrt(jnp.diagonal(Snn, axis1=-2, axis2=-1))
+    An = Snn * dj_n[:, :, None] * dj_n[:, None, :]
+    _, Li1 = (tf_chol_factor(An) if use_tf else blocked_chol_inv(An))
+    return JointFactors(d=d, cols=cols, valid=valid, ccl=ccl, nm=nm,
+                        dj_n=dj_n, Li1=Li1, Tg=Tg, Agg=Agg, mixed=use_tf)
+
+
+def draw_b_joint_structured(cm: CompiledPTA, x, key, b=None, exact=False,
+                            factors=None, mixed=None):
+    """Structure-exploiting joint correlated-ORF b-draw: the production
+    kernel.  Samples the SAME exact joint conditional as
+    :func:`draw_b_joint` — for the same key the two samples agree to f64
+    roundoff — through a two-stage factorization that never materializes
+    the (P·Bmax)^2 system:
+
+    1. **per-pulsar stage** (:func:`joint_factor_cache`): one batched
+       (P, B, B) factorization of the local blocks with GW rows/cols
+       embedded as inert identity coordinates (fixed shapes, exact
+       decoupling under Cholesky);
+    2. **GW Schur stage**: the Schur complement on the 2K·P GW subspace —
+       the only part ``G^-1/rho_k`` touches — assembled as a (2K, 2K)
+       grid of (P, P) blocks: ``S[g, g'] = diag_p(Agg_p[g, g'] - (C_p
+       C_p^T)[g, g']) + delta_gg' G^-1/rho_g`` with ``C_p = Bhat_p
+       Li1_p^T`` the Cholesky B-panel.  The HD coupling therefore stays
+       in (P, P) blocks (``ops.linalg.block_grid_cholinv``, unrolled
+       over the 2K per-(frequency, phase) stages); at or below
+       ``SCHUR_DENSE_MAX`` GW coordinates the grid is flattened and
+       factored by one dense recursion instead (same ordering -> same
+       factor, fewer ops at toy size);
+    3. the Gaussian sample composes the two stages: ``samp = D L^-T
+       (L^-1 d + z)`` with one ``z = normal(key, (P·Bmax + 2K·P,))`` —
+       the same key discipline, shape and coordinate order as the dense
+       draw (jaxlint R1-clean: the key is consumed exactly once).
+
+    Mixed precision (``settings.joint_mixed``, ``exact=False``): both
+    stages factor with :func:`ops.linalg.tf_chol_factor` — an f32 MXU
+    factorization plus one iterative-refinement step (the residual
+    congruence correction), mirroring the segmented-Gram f32 pattern —
+    and the grid matmuls run :func:`ops.linalg.tf_mm`; the accepted
+    condition-independent O(n·eps_f32) error class the sequential kernel
+    KS-validated.  A non-finite result (two-float breakdown at an
+    extreme state) keeps the previous ``b`` wholesale for the sweep
+    instead of poisoning the chain (draw_b_mh's ok-mask contract);
+    ``exact=True`` (warmup/refresh) always factors in f64 and never
+    touches the two-float kernels.
+    """
+    import jax.numpy as jnp
+    import jax.random as jr
+
+    from ..ops.linalg import (_mm_t, block_grid_cholinv,
+                              block_grid_solve_lower,
+                              block_grid_solve_upper, block_grid_to_dense,
+                              blocked_chol_inv, tf_chol_factor, tf_mm)
+
+    cdt = cm.cdtype
+    B, P, K = cm.Bmax, cm.P, cm.K
+    PB = P * B
+    G = 2 * K
+    f = (joint_factor_cache(cm, x, exact=exact, mixed=mixed)
+         if factors is None else factors)
+    mm = tf_mm if f.mixed else _mm_t
+    factor = tf_chol_factor if f.mixed else blocked_chol_inv
+    rows_p = jnp.arange(P)[:, None]
+
+    # ---- stage 2: Schur complement on the GW subspace ---------------------
+    Dg, rho2, Gpp = _joint_gw_prior(cm, x, f.valid)
+    # GW Jacobi scales: diag of the permuted system's GW section
+    diag_g = jnp.diagonal(f.Agg, axis1=-2, axis2=-1) \
+        + jnp.where(f.valid > 0, Gpp.T / rho2[None, :], 1.0)       # (P, 2K)
+    dj_g = 1.0 / jnp.sqrt(diag_g)
+    # Cholesky B-panel: per pulsar, Bhat_p = dj_g ⊙ Tg_p^T ⊙ dj_n
+    Bhat = jnp.swapaxes(f.Tg, 1, 2) * dj_g[:, :, None] \
+        * f.dj_n[:, None, :]                                       # (P,2K,B)
+    C = mm(Bhat, f.Li1, transpose_b=True)                          # (P,2K,B)
+    CCt = mm(C, C, transpose_b=True)                               # (P,2K,2K)
+    Agg_hat = f.Agg * dj_g[:, :, None] * dj_g[:, None, :]
+    dj_gT = dj_g.T                                                 # (2K, P)
+    Dg_hat = Dg * dj_gT[:, :, None] * dj_gT[:, None, :]
+    M = Agg_hat - CCt                                              # (P,2K,2K)
+    S = jnp.zeros((G, G, P, P), cdt).at[
+        :, :, jnp.arange(P), jnp.arange(P)].set(jnp.moveaxis(M, 0, -1))
+    S = S.at[jnp.arange(G), jnp.arange(G)].add(Dg_hat)
+
+    # ---- solves + sample --------------------------------------------------
+    dn_hat = f.dj_n * (f.d * f.nm)                                 # (P, B)
+    dg_hat = dj_g * (jnp.take_along_axis(f.d, f.ccl, axis=1)
+                     * f.valid)                                    # (P, 2K)
+    v_n = jnp.einsum("pij,pj->pi", f.Li1, dn_hat, precision="highest")
+    r_g = dg_hat - jnp.einsum("pgb,pb->pg", C, v_n,
+                              precision="highest")                 # (P, 2K)
+    # one normal draw in the permuted layout: same shape/order as the
+    # dense reference, so same-key samples coincide
+    z = jr.normal(key, (PB + G * P,), dtype=cdt)
+    z_n = z[:PB].reshape(P, B)
+    z_g = z[PB:].reshape(G, P)
+    # inner Jacobi on the Schur matrix (its diagonal drifts below 1 as
+    # the local columns explain the GW columns); chol(D S D) = D chol(S)
+    # for diagonal D, so preconditioning here leaves the sample map of
+    # the overall factorization unchanged in exact arithmetic
+    sdiag = jnp.diagonal(S[jnp.arange(G), jnp.arange(G)],
+                         axis1=-2, axis2=-1)                       # (G, P)
+    sj = 1.0 / jnp.sqrt(sdiag)
+    rg = r_g.T                                                     # (G, P)
+    if G * P <= SCHUR_DENSE_MAX:
+        Sd = block_grid_to_dense(S)                                # (GP, GP)
+        sjf = sj.reshape(G * P)
+        As = Sd * sjf[:, None] * sjf[None, :]
+        _, Lsi = factor(As)
+        v_g = (Lsi @ (sjf * rg.reshape(G * P))).reshape(G, P)
+        w_g = sj * (Lsi.T @ (v_g + z_g).reshape(G * P)).reshape(G, P)
+    else:
+        Ssc = S * sj[:, None, :, None] * sj[None, :, None, :]
+        _, Ldi, Loff = block_grid_cholinv(Ssc, factor=factor, mm=mm)
+        v_g = block_grid_solve_lower(Ldi, Loff, sj * rg)
+        w_g = sj * block_grid_solve_upper(Ldi, Loff, v_g + z_g)
+    # back-substitute the local section through the B-panel
+    w_gT = w_g.T                                                   # (P, 2K)
+    t_n = v_n + z_n - jnp.einsum("pgb,pg->pb", C, w_gT,
+                                 precision="highest")
+    w_n = jnp.einsum("pji,pj->pi", f.Li1, t_n, precision="highest")
+    bnew = (f.dj_n * w_n * f.nm).at[rows_p, f.cols].set(
+        dj_g * w_gT, mode="drop")
+    # two-float breakdown guard (draw_b_mh's ok-mask contract): skip the
+    # whole update rather than poison the chain; exact=True never takes
+    # the two-float kernels so this is inert there
+    if b is None:
+        b = jnp.zeros((P, B), cdt)
+    ok = jnp.all(jnp.isfinite(bnew))
+    return jnp.where(ok, bnew, b)
 
 
 def _mh_step(cm: CompiledPTA, lnlike, ind):
@@ -1805,7 +2089,8 @@ class JaxGibbsDriver:
                  pad_pulsars=None, mesh=None, warmup_sweeps=50,
                  warmup_white_steps=16, white_steps_max=64, nchains=1,
                  exact_every=EXACT_EVERY, record_precision=None,
-                 record_every=1, transfer_guard=False, sentinels=True):
+                 record_every=1, transfer_guard=False, sentinels=True,
+                 joint_mixed=None):
         settings.apply()
         import jax
         import jax.random as jr
@@ -1885,6 +2170,13 @@ class JaxGibbsDriver:
         self.warmup_sweeps = warmup_sweeps
         self.warmup_white_steps = warmup_white_steps
         self.exact_every = int(exact_every)
+        #: mixed-precision mode of the structured correlated-ORF joint
+        #: b-draw (draw_b_joint_structured): steady sweeps factor both
+        #: stages with the two-float MXU kernel + one refinement step;
+        #: every exact_every-th sweep refreshes in f64.  None defers to
+        #: settings.joint_mixed; False forces f64 everywhere (validation)
+        self.joint_mixed = (settings.joint_mixed if joint_mixed is None
+                            else bool(joint_mixed))
         #: cap on the ACT-sized white/ECORR sub-chain length: with Laplace
         #: proposals the measured ACT is O(few); a larger measurement means
         #: a near-unidentified parameter whose exactness does not justify
@@ -2329,6 +2621,19 @@ class JaxGibbsDriver:
             if self.do_red_mh:
                 x = red_mh_block(cm, x, b, k[5], red_U, red_S,
                                  self.red_steps, hist=red_hist)
+            # stage-1 factor cache of the structured joint draw, hoisted
+            # here because its inputs (Nvec, non-GW phi) are final once
+            # the white/ECORR/red blocks above have run: every remaining
+            # block (rho, the rho <-> b scale interweaving, ORF MH) only
+            # moves the GW prior, which lives entirely in the Schur
+            # stage — so the batched per-pulsar factorization is shared
+            # across the sweep's joint-draw sub-steps instead of being
+            # recomputed inside each one
+            factors = None
+            if cm.orf_name != "crn" and _joint_kernel_active(cm):
+                factors = joint_factor_cache(
+                    cm, x, exact=(bdraw == "exact"),
+                    mixed=self.joint_mixed)
             if not collapsed and cm.K and len(cm.rho_ix_x):
                 x = rho_update(cm, x, b, k[3])
             if _rho_scale_applies(cm):
@@ -2338,7 +2643,11 @@ class JaxGibbsDriver:
                 x, _ = mh_scan(cm, x, k[7], lnlike_orf_fn(cm, b),
                                cm.idx.orf, self.red_steps)
             if cm.orf_name != "crn":
-                b = draw_b_fn(cm, x, k[4], b)    # joint or sequential HD
+                # joint (structured two-stage) or sequential HD draw;
+                # steady sweeps take the mixed two-float kernels and the
+                # chunk's periodic exact body refreshes in f64
+                b = draw_b_fn(cm, x, k[4], b, exact=(bdraw == "exact"),
+                              factors=factors)
                 u = b_matvec(cm, b)
             elif bdraw == "mh":
                 b, u, _ = draw_b_mh(cm, x, b, u, k[4])
@@ -2551,14 +2860,16 @@ class JaxGibbsDriver:
 
     def _chunk_fn(self, n, rec_off=0):
         if (n, rec_off) not in self._sweep_fns:
-            if self.cm.orf_name != "crn" or self.cm.has_ke:
-                # correlated ORF: both bdraw variants reduce to the joint
-                # draw — a body pair would trace the large joint program
-                # twice into one executable for nothing.  Kernel ECORR:
-                # the Metropolised b-draw's exact accept density assumes
-                # diagonal N, so only the exact draw runs
+            if self.cm.has_ke:
+                # kernel ECORR: the Metropolised b-draw's exact accept
+                # density assumes diagonal N, so only the exact draw runs
                 bodies = self._sweep_body("exact")
             else:
+                # both CRN and correlated-ORF models run a body pair:
+                # steady sweeps take the mixed/two-float b-draw kernels
+                # and every exact_every-th sweep the f64 body refreshes
+                # the factorization error (the same cadence contract as
+                # the CRN refresh; docs/EXACT_EVERY.md)
                 bodies = (self._sweep_body("mh"), self._sweep_body("exact"))
             self._sweep_fns[(n, rec_off)] = self._make_chunk(bodies, n,
                                                              rec_off)

@@ -54,6 +54,41 @@ def synth_pta():
 
 
 @pytest.fixture(scope="session")
+def synth_hd_pta():
+    """Small self-contained 3-pulsar PTA with a shared free-spectrum GW
+    block under the Hellings-Downs ORF — the correlated-phi joint-b-draw
+    path (tests/test_joint_structured.py, resume coverage) without
+    reference data."""
+    from pulsar_timing_gibbsspec_tpu.data.dataset import Pulsar
+    from pulsar_timing_gibbsspec_tpu.models.factory import model_general
+
+    DAY = 86400.0
+    rng = np.random.default_rng(7)
+    psrs = []
+    for ii in range(3):
+        n = 72
+        span = 8.0 * 365.25 * DAY
+        toas = np.sort(rng.uniform(0.0, span, n)) + 53000.0 * DAY
+        errs = np.full(n, 5e-7)
+        t = (toas - toas.mean()) / span
+        M = np.column_stack([np.ones(n), t, t * t])
+        th = rng.uniform(0, np.pi)
+        ph = rng.uniform(0, 2 * np.pi)
+        psrs.append(Pulsar(
+            name=f"FAKE_HD{ii:02d}", toas=toas, toaerrs=errs,
+            residuals=errs * rng.standard_normal(n),
+            freqs=np.full(n, 1400.0),
+            backend_flags=np.asarray(["sim"] * n, dtype=object),
+            Mmat=M, fitpars=["offset", "F0", "F1"],
+            pos=np.array([np.sin(th) * np.cos(ph),
+                          np.sin(th) * np.sin(ph), np.cos(th)])))
+    return model_general(psrs, tm_svd=True, white_vary=True,
+                         common_psd="spectrum", common_components=4,
+                         red_var=True, red_psd="spectrum",
+                         red_components=3, orf="hd")
+
+
+@pytest.fixture(scope="session")
 def j1713():
     from pulsar_timing_gibbsspec_tpu.data import load_pulsar
 

@@ -1,0 +1,146 @@
+"""Structured correlated-ORF joint b-draw (ISSUE 3): the two-stage
+batched-block + GW-Schur factorization must sample the SAME conditional
+as the dense reference ``draw_b_joint`` — same key, same permuted
+coordinate ordering, same Cholesky — and the compiled sweep must neither
+retrace per sweep nor lose bitwise resume with the hoisted factor cache.
+"""
+
+import numpy as np
+import pytest
+
+from pulsar_timing_gibbsspec_tpu.sampler import jax_backend as jb
+from pulsar_timing_gibbsspec_tpu.sampler.compiled import compile_pta
+
+
+@pytest.fixture(scope="module")
+def hd_cm_x(synth_hd_pta):
+    import jax.numpy as jnp
+
+    cm = compile_pta(synth_hd_pta)
+    x0 = synth_hd_pta.initial_sample(np.random.default_rng(3))
+    return cm, jnp.asarray(x0, cm.cdtype)
+
+
+def _rel_diff(a, b):
+    a, b = np.asarray(a), np.asarray(b)
+    return np.max(np.abs(a - b)) / max(1e-30, np.max(np.abs(a)))
+
+
+def test_structured_matches_dense_same_key_f64(hd_cm_x):
+    """Acceptance: the structured exact (f64) draw reproduces the dense
+    ``draw_b_joint`` sample for the same key to 1e-8 — both factor the
+    same permuted system, so Cholesky uniqueness makes the sample maps
+    identical up to roundoff."""
+    import jax.random as jr
+
+    cm, x = hd_cm_x
+    key = jr.PRNGKey(5)
+    bd = jb.draw_b_joint(cm, x, key)
+    bs = jb.draw_b_joint_structured(cm, x, key, exact=True)
+    assert np.isfinite(np.asarray(bd)).all()
+    assert _rel_diff(bd, bs) < 1e-8
+
+
+def test_structured_matches_dense_block_grid_path(hd_cm_x, monkeypatch):
+    """Same-key agreement with the per-(frequency, phase) block-grid
+    Schur factorization forced (SCHUR_DENSE_MAX=0 disables the small-size
+    dense flattening) — the layout the production widths take."""
+    import jax.random as jr
+
+    cm, x = hd_cm_x
+    monkeypatch.setattr(jb, "SCHUR_DENSE_MAX", 0)
+    key = jr.PRNGKey(6)
+    bd = jb.draw_b_joint(cm, x, key)
+    bs = jb.draw_b_joint_structured(cm, x, key, exact=True)
+    assert _rel_diff(bd, bs) < 1e-8
+
+
+def test_factor_cache_is_inert(hd_cm_x):
+    """A draw through a precomputed joint_factor_cache must equal the
+    self-factoring draw bit-for-bit — the sweep's hoisted cache cannot
+    change the sampled process."""
+    import jax.random as jr
+
+    cm, x = hd_cm_x
+    key = jr.PRNGKey(9)
+    for exact in (True, False):
+        f = jb.joint_factor_cache(cm, x, exact=exact)
+        a = jb.draw_b_joint_structured(cm, x, key, exact=exact)
+        b = jb.draw_b_joint_structured(cm, x, key, exact=exact, factors=f)
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_mixed_draw_is_ks_level(hd_cm_x):
+    """The two-float (f32 factor + one refinement step) steady draw
+    carries the accepted O(n*eps_f32) error class: same-key samples land
+    within ~1e-3 of the f64 draw pointwise, and batch moments over many
+    keys agree — the KS-level statement at toy size."""
+    import jax
+    import jax.random as jr
+
+    cm, x = hd_cm_x
+    key = jr.PRNGKey(11)
+    bd = np.asarray(jb.draw_b_joint_structured(cm, x, key, exact=True))
+    bm = np.asarray(jb.draw_b_joint_structured(cm, x, key, exact=False,
+                                               mixed=True))
+    assert np.isfinite(bm).all()
+    assert _rel_diff(bd, bm) < 1e-3
+
+    keys = jr.split(jr.PRNGKey(12), 192)
+    ex = np.asarray(jax.vmap(
+        lambda k: jb.draw_b_joint_structured(cm, x, k, exact=True))(keys))
+    mx = np.asarray(jax.vmap(
+        lambda k: jb.draw_b_joint_structured(cm, x, k, exact=False,
+                                             mixed=True))(keys))
+    sd = ex.std(axis=0)
+    live = sd > 0
+    # same keys, so the mean difference is the deterministic kernel error
+    # (O(1e-5) of scale), far inside the Monte-Carlo band
+    dmean = np.abs(ex.mean(axis=0) - mx.mean(axis=0))[live]
+    assert np.all(dmean < 0.05 * sd[live] + 1e-12)
+    rstd = np.abs(mx.std(axis=0)[live] / sd[live] - 1.0)
+    assert np.all(rstd < 0.05)
+
+
+def test_dispatch_and_dense_cap_preserved(hd_cm_x, monkeypatch):
+    """PTGIBBS_HD_KERNEL=pulsar|freq still routes past the joint kernel
+    when the system exceeds HD_DENSE_MAX (the escape hatch contract), and
+    the joint kernel is the default at every size."""
+    import jax.random as jr
+
+    cm, x = hd_cm_x
+    assert jb._joint_kernel_active(cm)
+    monkeypatch.setattr(jb, "HD_DENSE_MAX", 0)
+    assert jb._joint_kernel_active(cm)          # "joint" ignores the cap
+    monkeypatch.setattr(jb, "HD_SCALABLE_KERNEL", "pulsar")
+    assert not jb._joint_kernel_active(cm)
+    b = jb.draw_b_fn(cm, x, jr.PRNGKey(1), exact=True)
+    assert np.isfinite(np.asarray(b)).all()
+
+
+def test_no_retraces_across_steady_chunks(synth_hd_pta):
+    """Tier-1 perf guard (ISSUE 3 satellite): the factor-cache hoist and
+    the non-CRN body pair must not reintroduce per-sweep or per-chunk
+    retracing — zero XLA compiles across the second and later steady
+    chunks of the toy HD config."""
+    from pulsar_timing_gibbsspec_tpu import profiling
+    from pulsar_timing_gibbsspec_tpu.sampler.jax_backend import \
+        JaxGibbsDriver
+
+    drv = JaxGibbsDriver(synth_hd_pta, seed=4, common_rho=True,
+                         warmup_sweeps=2, chunk_size=4)
+    x0 = synth_hd_pta.initial_sample(np.random.default_rng(0))
+    niter = 14                      # warmup + >= 3 steady chunks
+    cshape, bshape = drv.chain_shapes(niter)
+    chain, bchain = np.zeros(cshape), np.zeros(bshape)
+    it = drv.run(x0, chain, bchain, 0, niter)
+    next(it)                        # warmup + adaptation + compiles
+    with profiling.recompile_counter() as rc:
+        first = True
+        for _ in it:
+            if first:
+                # the first steady chunk compiles the sweep pair once
+                rc.reset()
+                first = False
+    assert not rc.retraced, f"steady-loop retraces: {rc.events}"
+    assert np.isfinite(chain).all()

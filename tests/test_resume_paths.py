@@ -35,6 +35,28 @@ def test_thinned_resume_roundtrip_bitwise(synth_pta, x0, tmp_path):
                           np.load(full_dir / "chain.npy"))
 
 
+def test_hd_joint_resume_roundtrip_bitwise(synth_hd_pta, tmp_path):
+    """Correlated-ORF (HD) chunked sweep with the structured joint b-draw
+    and its hoisted per-sweep factor cache active: a split run + resume
+    must reproduce the uninterrupted run bit-for-bit — the cache is a
+    pure function of (x, iteration), so chunk boundaries cannot move the
+    sampled process (the same contract the CRN path already keeps)."""
+    x0 = synth_hd_pta.initial_sample(np.random.default_rng(0))
+    niter = 20
+    full_dir, split_dir = tmp_path / "full", tmp_path / "split"
+    full = PTABlockGibbs(synth_hd_pta, **KW).sample(
+        x0, outdir=full_dir, niter=niter, save_every=8)
+    PTABlockGibbs(synth_hd_pta, **KW).sample(
+        x0, outdir=split_dir, niter=12, save_every=8)
+    resumed = PTABlockGibbs(synth_hd_pta, **KW).sample(
+        x0, outdir=split_dir, niter=niter, resume=True, save_every=8)
+    assert resumed.shape == full.shape
+    assert np.isfinite(full).all()
+    assert np.array_equal(resumed, full)
+    assert np.array_equal(np.load(split_dir / "bchain.npy"),
+                          np.load(full_dir / "bchain.npy"))
+
+
 def test_resume_nchains_mismatch_raises(synth_pta, x0, tmp_path):
     """Chain files written with nchains=2 must refuse a resume with
     nchains=1 (and vice versa) instead of silently reshaping."""

@@ -49,13 +49,19 @@ def main():
     bchain = np.zeros(bshape)
     for _ in drv.run(x0, chain, bchain, 0, args.niter):
         pass
-    times = profiling.profile_blocks(drv, drv.x_cur, repeats=3, inner=20)
+    report = profiling.profile_blocks(drv, drv.x_cur, repeats=3, inner=20)
+    times = {k: v / 1e3 for k, v in report["per_block_ms"].items()}
 
     if args.orf == "crn":
         _crn_refresh_internals(drv, times)
 
+    in_sweep = report["in_sweep"]
     for k, v in sorted(times.items(), key=lambda kv: -kv[1]):
-        print(f"  {k:<22s} {v*1e3:8.2f} ms")
+        tag = "" if in_sweep.get(k, True) else "   [off-sweep]"
+        print(f"  {k:<22s} {v*1e3:8.2f} ms{tag}")
+    print(f"  {'sum(in-sweep)':<22s} {report['sum_blocks_ms']:8.2f} ms")
+    print(f"  {'full_sweep':<22s} {report['full_sweep_ms']:8.2f} ms")
+    print(f"  {'dispatch':<22s} {report['dispatch_ms']:8.2f} ms")
 
 
 def _crn_refresh_internals(drv, times):
