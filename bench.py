@@ -308,7 +308,12 @@ def bench_config(orf, n_psr, niter, np_iters, adapt, nchains, profile,
     # health reductions — a long bench that silently retried or rolled
     # back is a different claim than a clean one
     from pulsar_timing_gibbsspec_tpu.runtime import telemetry
+    # counters cover the drain/watchdog/stall taxonomy too
+    # (preempt_requests/preempt_drains/drain_abandoned_chunks/
+    # watchdog_soft/watchdog_dumps/watchdog_stalls/stall_retries);
+    # gauges carry last-value measurements such as drain_latency_ms
     out["resilience"] = {"counters": telemetry.snapshot(),
+                         "gauges": telemetry.gauges(),
                          "sentinel": getattr(drv, "health_last", None)}
     # throughput x mixing, BOTH configs (VERDICT r3: "throughput x unknown
     # ACT is not a samples/sec claim"; r4: CRN carried no ACT at all and

@@ -128,7 +128,11 @@ def design_matrix(par: ParFile, tim: TimFile, return_labels: bool = False):
     # field after the offset value — because tempo2 writes a trailing
     # uncertainty ("JUMP -fe Rcvr_800 -8.8e-06 1 1.2e-07") that a
     # last-token test would misread.
-    for jn, toks in enumerate(par.jumps):
+    # Labels count FITTED jumps (tempo2's JUMP_1..JUMP_n are per fitted
+    # parameter), not raw par-file lines — skipped unfitted entries must
+    # not leave holes in the numbering.
+    n_jump = 0
+    for toks in par.jumps:
         if toks and toks[0].upper() == "MJD" and len(toks) >= 5:
             if toks[4] != "1":
                 continue
@@ -142,8 +146,9 @@ def design_matrix(par: ParFile, tim: TimFile, return_labels: bool = False):
         else:
             continue
         if sel.any() and not sel.all():
+            n_jump += 1
             cols.append(sel.astype(float))
-            labels.append(f"JUMP{jn + 1}")
+            labels.append(f"JUMP{n_jump}")
 
     # binary: harmonics of the orbital phase
     kepler = {"PB", "T0", "TASC", "A1", "OM", "ECC", "EPS1", "EPS2",

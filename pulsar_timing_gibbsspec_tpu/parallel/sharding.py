@@ -66,6 +66,23 @@ def make_mesh(n_devices: int | None = None, axis: str = "pulsar"):
     return Mesh(np.asarray(devs), (axis,))
 
 
+def mesh_layout(mesh):
+    """JSON-serializable description of a mesh placement.
+
+    Recorded in the checkpoint manifest's ``shard_map`` section — the
+    PHYSICAL half of the layout split: logical layout (chain/pulsar
+    order, padded pulsar width, per-chain key folding) lives in the
+    manifest's ``layout`` section and pins the sampled process, while
+    this record is advisory — ``integrity.reshard_restore`` may rebuild
+    the mesh with any device count that divides the padded width."""
+    if mesh is None:
+        return None
+    devs = mesh.devices.ravel()
+    return {"devices": int(devs.size),
+            "axis": str(mesh.axis_names[0]),
+            "platform": str(devs[0].platform) if devs.size else "?"}
+
+
 def pulsar_sharding(mesh, ndim: int):
     """NamedSharding that splits axis 0 over the mesh's pulsar axis and
     replicates the rest."""

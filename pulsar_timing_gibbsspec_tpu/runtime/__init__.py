@@ -6,21 +6,28 @@ package defends that promise in production: ``integrity`` makes the
 checkpoint set verifiable (manifest + rotating .bak), ``sentinels``
 catches diverged/stuck chains before they reach disk, ``supervisor``
 retries transient failures with capped backoff and degrades jax ->
-numpy after repeated device faults, and ``faults`` injects every one of
-those failures deterministically so ``tests/test_chaos.py`` can prove
-recovery is bit-identical to an uninterrupted run.  See
-docs/RESILIENCE.md.
+numpy after repeated device faults, ``preemption`` turns SIGTERM /
+maintenance notices into a deadline-bounded drain to a verified
+checkpoint (the distinct resumable ``preempted`` outcome), ``watchdog``
+aborts hung chunk dispatches against an EMA deadline (the retryable
+``stall`` class), and ``faults`` injects every one of those failures
+deterministically so ``tests/test_chaos.py`` can prove recovery is
+bit-identical to an uninterrupted run.  See docs/RESILIENCE.md.
 """
 
-from . import faults, integrity, sentinels, telemetry
+from . import faults, integrity, preemption, sentinels, telemetry, watchdog
 from .integrity import CheckpointError
+from .preemption import EXIT_PREEMPTED, Preempted
 from .sentinels import ChainDivergence, SentinelMonitor
 from .supervisor import (SupervisorReport, backoff_delay, classify_failure,
                          run_supervised)
+from .watchdog import DispatchStall, DispatchWatchdog
 
 __all__ = [
-    "faults", "integrity", "sentinels", "telemetry",
+    "faults", "integrity", "preemption", "sentinels", "telemetry",
+    "watchdog",
     "CheckpointError", "ChainDivergence", "SentinelMonitor",
     "SupervisorReport", "backoff_delay", "classify_failure",
     "run_supervised",
+    "EXIT_PREEMPTED", "Preempted", "DispatchStall", "DispatchWatchdog",
 ]

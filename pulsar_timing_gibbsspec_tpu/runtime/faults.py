@@ -16,6 +16,9 @@ Seam points (``fire``):
   ``manifest.json`` hit disk (file-corruption kinds damage files here).
 - ``"sample.loop"`` — in the facade's sweep loop, after the newly
   recorded rows passed the sentinels; ``row`` is the rows done so far.
+- ``"dispatch.chunk"`` — inside the jax driver's watchdog-guarded chunk
+  dispatch, before the compiled chunk runs; ``row`` is the absolute
+  iteration index of the chunk start.
 
 Fault kinds:
 
@@ -30,6 +33,17 @@ Fault kinds:
 - ``"truncate_file"``  cut the target file to half its size at a fire
   point with ``outdir`` (torn write / disk-full artifact).
 - ``"corrupt_file"``   overwrite a few bytes mid-file (bit rot).
+- ``"sigterm_at_seam"`` request a preemption drain at the fire point —
+  deterministic, seam-precise stand-in for SIGTERM delivery (the real
+  handler calls the same ``preemption.request_drain``); ``seconds``
+  carries the drain deadline (default when 0).
+- ``"stall"``          sleep ``seconds`` at the fire point (a hung XLA
+  dispatch, as seen from the host) — armed at ``"dispatch.chunk"`` it
+  exercises the watchdog's escalate/abort path.
+- ``"device_count_change_on_resume"`` make ``device_count_override``
+  return ``devices`` — simulates the pool handing the next incarnation
+  a different device count than the checkpoint was written under
+  (``integrity.reshard_restore`` consults it).
 """
 
 from __future__ import annotations
@@ -37,6 +51,7 @@ from __future__ import annotations
 import contextlib
 import os
 import threading
+import time
 from dataclasses import dataclass
 
 import numpy as np
@@ -64,6 +79,8 @@ class _Fault:
     times: int = 1              # max firings before self-disarm
     backend: str | None = None  # only fire for this backend name
     path: str | None = None     # target file for file-damage kinds
+    seconds: float = 0.0        # stall sleep / drain deadline
+    devices: int | None = None  # device_count_change_on_resume target
     fired: int = 0
 
 
@@ -71,10 +88,11 @@ _armed: list[_Fault] = []
 _lock = threading.Lock()
 
 
-def inject(kind, point=None, at_row=None, times=1, backend=None, path=None):
+def inject(kind, point=None, at_row=None, times=1, backend=None, path=None,
+           seconds=0.0, devices=None):
     """Arm a fault; returns the handle (remove with :func:`clear`)."""
     f = _Fault(kind=kind, point=point, at_row=at_row, times=times,
-               backend=backend, path=path)
+               backend=backend, path=path, seconds=seconds, devices=devices)
     with _lock:
         _armed.append(f)
     return f
@@ -130,12 +148,33 @@ def fire(point, row=None, backend=None, outdir=None):
     for f in _take(point, row, backend, ("truncate_file", "corrupt_file")):
         if outdir is not None:
             _damage(os.path.join(str(outdir), f.path or "chain.npy"), f.kind)
+    for f in _take(point, row, backend, ("stall",)):
+        time.sleep(f.seconds)
+    for f in _take(point, row, backend, ("sigterm_at_seam",)):
+        from . import preemption
+
+        preemption.request_drain(
+            reason=f"sigterm_at_seam:{point}",
+            deadline_s=f.seconds or None)
     for f in _take(point, row, backend, ("crash", "xla_error")):
         if f.kind == "crash":
             raise InjectedCrash(
                 f"injected crash at {point} (row {row})")
         raise XlaRuntimeError(
             f"INTERNAL: injected device failure at {point} (row {row})")
+
+
+def device_count_override(default=None):
+    """Consume an armed ``device_count_change_on_resume`` fault.
+
+    Returns the fault's ``devices`` (counting a firing), or ``default``
+    when none is armed — resume paths call this to learn the device
+    count the "pool" hands the next incarnation."""
+    if not _armed:
+        return default
+    hits = _take("resume.device_count", None, None,
+                 ("device_count_change_on_resume",))
+    return hits[-1].devices if hits else default
 
 
 def _damage(path, kind):

@@ -129,6 +129,86 @@ def verify(outdir, manifest=None, suffix="") -> dict:
             "rows": int(manifest.get("rows", 0))}
 
 
+def read_layout(outdir):
+    """The manifest's layout split, or ``None`` for a pre-layout
+    checkpoint: ``{"layout": {...}, "shard_map": {...}|None}``.
+
+    ``layout`` is the LOGICAL identity of the sampled process — facade
+    class, chain count, pulsar names in logical order, padded pulsar
+    width, record thinning, key-folding policy.  ``shard_map`` is the
+    physical placement the run happened to use; it is advisory only.
+    """
+    man = read_manifest(outdir)
+    if man is None or man.get("corrupt") or "layout" not in man:
+        return None
+    return {"layout": man["layout"], "shard_map": man.get("shard_map")}
+
+
+def reshard_restore(outdir, pta, devices=None, **gibbs_kwargs):
+    """Rebuild a sampler facade that resumes ``outdir``'s checkpoint on
+    a (possibly different) device count.
+
+    The checkpoint's LOGICAL layout — chains and pulsars in logical
+    order, padded pulsar width, per-chain keys folded from the logical
+    chain index — pins the sampled process; the shard map does not.  So
+    a run checkpointed under 8 devices resumes under 1, 2 or 4 (or back
+    to 8) as long as the new count divides the recorded padded width,
+    and the per-chain streams are bit-identical: the padded draw shapes
+    (part of the PRNG stream identity under threefry counter pairing)
+    and the logical fold indices are unchanged, only the physical
+    placement of the same arrays moves.
+
+    ``devices=None`` resumes unsharded (single default device); ``1``
+    likewise skips the mesh.  The ``device_count_change_on_resume``
+    fault, when armed, overrides ``devices`` — the chaos suite's
+    stand-in for the pool handing the next incarnation a different
+    slice.  Returns the facade; call ``.sample(x0, outdir=outdir,
+    resume=True, ...)`` on it.
+    """
+    from . import faults
+
+    info = read_layout(outdir)
+    if info is None:
+        raise CheckpointError(
+            f"{outdir}: checkpoint manifest has no logical-layout "
+            "section (written by a pre-elasticity version); resume it "
+            "on the original device count instead")
+    lay = info["layout"]
+    devices = faults.device_count_override(devices)
+    want = list(lay.get("pulsars", []))
+    got = list(getattr(pta, "pulsars", []))
+    if want and got != want:
+        raise CheckpointError(
+            f"{outdir}: pulsar set/order mismatch — the checkpoint's "
+            f"logical layout is {want} but this PTA has {got}; the "
+            "logical order IS the chain identity and cannot move")
+    pad = int(lay.get("pad_pulsars", 0)) or None
+    mesh = None
+    if devices is not None and int(devices) > 1:
+        devices = int(devices)
+        if pad is None or pad % devices:
+            raise CheckpointError(
+                f"{outdir}: checkpoint's padded pulsar width ({pad}) "
+                f"does not divide over {devices} devices; the padded "
+                "width is part of the logical layout (PRNG draw shapes) "
+                "and cannot be changed on resume — pick a device count "
+                "that divides it")
+        from ..parallel.sharding import make_mesh
+
+        mesh = make_mesh(devices)
+    from ..sampler.gibbs import PTABlockGibbs, PulsarBlockGibbs
+
+    cls = {"PulsarBlockGibbs": PulsarBlockGibbs,
+           "PTABlockGibbs": PTABlockGibbs}.get(
+        lay.get("facade"),
+        PTABlockGibbs if len(want) > 1 else PulsarBlockGibbs)
+    gibbs_kwargs.setdefault("nchains", int(lay.get("nchains", 1)))
+    gibbs_kwargs.setdefault("record_every", int(lay.get("record_every", 1)))
+    gibbs_kwargs["pad_pulsars"] = pad
+    gibbs_kwargs["mesh"] = mesh
+    return cls(pta, backend="jax", **gibbs_kwargs)
+
+
 def rotate_backup(outdir) -> bool:
     """Refresh the ``.bak`` generation from the current checkpoint set.
 

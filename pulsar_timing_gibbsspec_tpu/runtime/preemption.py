@@ -1,0 +1,171 @@
+"""Preemption-safe drain: SIGTERM/SIGINT → deadline-bounded checkpoint.
+
+Shared accelerator pools kill long runs far more often than math does:
+the scheduler sends SIGTERM (or a maintenance notice) and gives the
+process a bounded grace window.  This module turns that window into a
+clean exit:
+
+1. :func:`install` registers signal handlers (and :func:`request_drain`
+   is the pluggable hook for maintenance-event watchers — a cloud
+   metadata poller thread calls the same function) that set a
+   process-wide drain flag with a deadline.
+2. The jax driver's chunk loop stops dispatching new chunks the moment
+   the flag is up, and finishes or abandons the in-flight chunk
+   depending on the time left (``should_abandon``).
+3. The facade's sample loop breaks out, its existing try/finally flush
+   persists every verified row, the checkpoint is verified (rolled back
+   to ``.bak`` if a concurrent kill tore it), and :class:`Preempted` is
+   raised.
+4. ``run_supervised`` classifies :class:`Preempted` as the distinct
+   ``preempted`` status — resumable by construction, never a failure,
+   never retried in-process (the host is going away).
+
+Because chunk/checkpoint grids cannot move the sampled process (per-
+sweep keys are pure in the absolute iteration index), the drained
+checkpoint resumes bit-identically on the next incarnation — including
+on a different device count via ``integrity.reshard_restore``.
+
+All state is process-wide (one drain request serves every facade in the
+process) and monotonic-clock based; :func:`reset` restores a clean
+slate for tests.
+"""
+
+from __future__ import annotations
+
+import signal
+import threading
+import time
+
+from . import telemetry
+
+#: conventional exit code for a drained (resumable) run — EX_TEMPFAIL,
+#: the "transient failure, retry me" code batch schedulers requeue on
+EXIT_PREEMPTED = 75
+
+#: default grace window when the requester does not say (seconds);
+#: matches the shorter end of common preemption notices
+DEFAULT_DEADLINE_S = 30.0
+
+
+class Preempted(RuntimeError):
+    """The run drained to a verified checkpoint after a preemption
+    request — a resumable outcome, not a failure.  ``rows`` is the
+    recorded-row count persisted; ``verified`` whether the final
+    checkpoint set passed integrity verification (after rollback, if
+    one was needed)."""
+
+    def __init__(self, msg, rows=0, verified=True, rolled_back=False):
+        super().__init__(msg)
+        self.rows = int(rows)
+        self.verified = bool(verified)
+        self.rolled_back = bool(rolled_back)
+
+
+_lock = threading.Lock()
+_event = threading.Event()
+_state = {"reason": None, "requested_at": None, "deadline_s": None}
+_prev_handlers: dict[int, object] = {}
+
+
+def request_drain(reason="maintenance", deadline_s=None) -> None:
+    """Ask every running sampler in this process to drain.
+
+    This IS the pluggable maintenance-event hook: signal handlers call
+    it, and so can any watcher thread (cloud preemption notice, pool
+    rebalance, operator RPC).  Idempotent — the first request wins; a
+    later one cannot extend the deadline (the grace window is the
+    scheduler's, not ours)."""
+    with _lock:
+        if _event.is_set():
+            return
+        _state["reason"] = str(reason)
+        _state["requested_at"] = time.monotonic()
+        _state["deadline_s"] = (DEFAULT_DEADLINE_S if deadline_s is None
+                                else float(deadline_s))
+        _event.set()
+    telemetry.incr("preempt_requests")
+
+
+def drain_requested() -> bool:
+    """Cheap flag check for hot loops (no lock on the fast path)."""
+    return _event.is_set()
+
+
+def deadline_remaining() -> float:
+    """Seconds left in the grace window (+inf when no drain is
+    requested; can go negative once the window is blown)."""
+    with _lock:
+        if not _event.is_set():
+            return float("inf")
+        return (_state["requested_at"] + _state["deadline_s"]
+                - time.monotonic())
+
+
+def should_abandon(est_s=0.0) -> bool:
+    """True when finishing ``est_s`` more seconds of work would blow the
+    drain deadline — the in-flight chunk is then dropped (its sweeps are
+    replayed bit-exactly on resume) in favor of checkpointing what is
+    already verified."""
+    return _event.is_set() and deadline_remaining() < float(est_s)
+
+
+def drain_info() -> dict:
+    """Snapshot for logging/metrics (reason, age, remaining)."""
+    with _lock:
+        if not _event.is_set():
+            return {"requested": False}
+        now = time.monotonic()
+        return {"requested": True, "reason": _state["reason"],
+                "age_s": round(now - _state["requested_at"], 3),
+                "deadline_s": _state["deadline_s"],
+                "remaining_s": round(_state["requested_at"]
+                                     + _state["deadline_s"] - now, 3)}
+
+
+def mark_drained() -> float:
+    """Record a completed drain: gauges the request-to-checkpoint
+    latency (ms) and counts the drain.  Returns the latency in
+    seconds (0.0 when no request was pending — direct Preempted
+    construction in tests)."""
+    with _lock:
+        t0 = _state["requested_at"]
+    lat = 0.0 if t0 is None else time.monotonic() - t0
+    telemetry.gauge("drain_latency_ms", lat * 1000.0)
+    telemetry.incr("preempt_drains")
+    return lat
+
+
+def install(signals=(signal.SIGTERM, signal.SIGINT),
+            deadline_s=DEFAULT_DEADLINE_S) -> None:
+    """Register drain-on-signal handlers (main thread only — a CPython
+    constraint on ``signal.signal``).  Re-entrant delivery escalates:
+    the SECOND signal restores the previous handler and re-raises, so
+    an operator's double Ctrl-C still kills a wedged drain."""
+    def _handler(signum, frame):
+        if _event.is_set():
+            # second signal: give up on draining, restore + re-raise
+            prev = _prev_handlers.get(signum, signal.SIG_DFL)
+            signal.signal(signum, prev)
+            raise KeyboardInterrupt(
+                f"second signal {signum} during drain")
+        request_drain(reason=signal.Signals(signum).name,
+                      deadline_s=deadline_s)
+
+    for s in signals:
+        _prev_handlers[s] = signal.getsignal(s)
+        signal.signal(s, _handler)
+
+
+def uninstall() -> None:
+    """Restore the handlers :func:`install` replaced."""
+    for s, prev in _prev_handlers.items():
+        signal.signal(s, prev)
+    _prev_handlers.clear()
+
+
+def reset() -> None:
+    """Clear the drain flag and deadline (tests; between supervised
+    incarnations in one process)."""
+    with _lock:
+        _event.clear()
+        _state.update(reason=None, requested_at=None, deadline_s=None)

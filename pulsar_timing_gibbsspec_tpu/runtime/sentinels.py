@@ -186,6 +186,12 @@ def refold_checkpoint_key(outdir, salt) -> bool:
 
     man = integrity.read_manifest(outdir)
     if man is not None and not man.get("corrupt"):
-        integrity.write_manifest(outdir, man.get("rows", int(it)))
+        # carry any non-core manifest sections (logical layout, shard
+        # map) through the rewrite — dropping them would strand the
+        # refolded checkpoint on its original device count
+        extra = {k: v for k, v in man.items()
+                 if k not in ("schema", "rows", "written_at", "files")}
+        integrity.write_manifest(outdir, man.get("rows", int(it)),
+                                 extra=extra or None)
     telemetry.incr("refolds")
     return True

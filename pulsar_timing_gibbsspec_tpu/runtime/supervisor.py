@@ -19,6 +19,17 @@ class gets the right response instead of blind retry:
                   deterministic replay, refold the checkpoint PRNG key
                   so the re-draw takes a fresh stream.
 - ``crash``       injected/os-level kill artifacts: plain retry.
+- ``stall``       watchdog-aborted hung dispatch: its OWN capped retry
+                  budget (``stall_max_retries``) and backoff — a stall
+                  is usually environmental (wedged device runtime) and
+                  either clears in a couple of retries or never does,
+                  so it must not consume the general budget.
+- ``preempted``   drain completed after SIGTERM/maintenance notice:
+                  NOT a failure — the supervisor logs it, stamps the
+                  report ``status="preempted"`` and returns; the next
+                  incarnation resumes bit-identically from the drained
+                  checkpoint (``preemption.EXIT_PREEMPTED`` is the
+                  conventional exit code for schedulers to requeue on).
 - ``user``        bugs (shape errors, contract violations, tripped
                   transfer guard): re-raised immediately — retrying a
                   deterministic bug is denial of service on yourself.
@@ -37,12 +48,18 @@ from pathlib import Path
 
 import numpy as np
 
-from . import faults, integrity, sentinels, telemetry
+from . import faults, integrity, preemption, sentinels, telemetry
+from .watchdog import DispatchStall
 
 
 def classify_failure(exc) -> str:
     """Map an exception from ``sample()`` to a failure class:
-    ``device | corruption | divergence | crash | user | unknown``."""
+    ``device | corruption | divergence | crash | stall | preempted |
+    user | unknown``."""
+    if isinstance(exc, preemption.Preempted):
+        return "preempted"
+    if isinstance(exc, DispatchStall):
+        return "stall"
     if isinstance(exc, faults.InjectedCrash):
         return "crash"
     if isinstance(exc, integrity.CheckpointError):
@@ -95,6 +112,11 @@ class SupervisorReport:
     rollbacks: int = 0
     refolds: int = 0
     degradations: int = 0
+    #: stall-class retries, budgeted separately from ``retries``
+    stall_retries: int = 0
+    #: "completed" | "preempted" — preemption is a resumable outcome,
+    #: not a failure, and callers branch on this to requeue
+    status: str = "completed"
     backend: str = ""
     failures: list = field(default_factory=list)
 
@@ -128,12 +150,21 @@ def _degraded(gibbs):
 def run_supervised(gibbs, x0, outdir, niter, save_every=100, resume=True,
                    max_retries=8, degrade_after=3, backoff_base=0.5,
                    backoff_cap=30.0, jitter=0.25, backoff_seed=0,
-                   sleep=time.sleep, allow_degrade=True, **sample_kwargs):
+                   sleep=time.sleep, allow_degrade=True,
+                   stall_max_retries=3, stall_backoff_base=None,
+                   **sample_kwargs):
     """Drive ``gibbs.sample`` to ``niter`` under the retry policy above.
 
     Returns ``(chain, report)``.  ``sleep`` is injectable so tests can
     capture the backoff schedule instead of waiting it out; ``resume``
     applies to the FIRST attempt only (every retry resumes).
+
+    A ``preempted`` outcome returns early with ``report.status ==
+    "preempted"`` and the rows drained so far — callers exit with
+    ``preemption.EXIT_PREEMPTED`` and let the scheduler requeue.
+    Stalls retry under their own ``stall_max_retries`` budget
+    (backoff base ``stall_backoff_base``, defaulting to
+    ``backoff_base``) without consuming the general budget.
     """
     from ..analysis.guards import count_recompiles
 
@@ -157,6 +188,18 @@ def run_supervised(gibbs, x0, outdir, niter, save_every=100, resume=True,
             raise                # the facade's finally-flush already ran
         except Exception as exc:
             kind = classify_failure(exc)
+            if kind == "preempted":
+                # a drained run is a resumable OUTCOME, not a failure:
+                # report it as such and hand control back so the caller
+                # can exit before the grace window closes
+                rep.status = "preempted"
+                rep.backend = gibbs.backend_name
+                _log_event(outdir, {
+                    "event": "supervised_preempted",
+                    "rows": getattr(exc, "rows", None),
+                    "verified": getattr(exc, "verified", None),
+                    "drain": preemption.drain_info(), **rep.as_dict()})
+                return getattr(gibbs, "chain", None), rep
             n_comp = int(getattr(rc, "events", 0) or 0)
             fail = {"attempt": rep.attempts, "kind": kind,
                     "error": f"{type(exc).__name__}: {exc}"[:300],
@@ -165,6 +208,28 @@ def run_supervised(gibbs, x0, outdir, niter, save_every=100, resume=True,
             _log_event(outdir, {"event": "supervised_failure", **fail})
             if kind == "user":
                 raise
+            if kind == "stall":
+                # stalls have their own capped budget + backoff: they
+                # are environmental and must not eat the general budget
+                if rep.stall_retries >= stall_max_retries:
+                    _log_event(outdir, {"event": "supervised_giving_up",
+                                        "reason": "stall budget",
+                                        **rep.as_dict()})
+                    raise
+                rep.stall_retries += 1
+                telemetry.incr("stall_retries")
+                delay = backoff_delay(
+                    rep.stall_retries,
+                    backoff_base if stall_backoff_base is None
+                    else stall_backoff_base,
+                    backoff_cap, jitter, seed=backoff_seed)
+                _log_event(outdir, {"event": "supervised_retry",
+                                    "next_attempt": rep.attempts + 1,
+                                    "kind": kind,
+                                    "stall_retry": rep.stall_retries,
+                                    "backoff_s": round(delay, 3)})
+                sleep(delay)
+                continue
             if rep.retries >= max_retries:
                 _log_event(outdir, {"event": "supervised_giving_up",
                                     **rep.as_dict()})

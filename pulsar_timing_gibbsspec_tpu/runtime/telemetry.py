@@ -17,6 +17,17 @@ closed set):
 - ``corrupt_checkpoints`` manifest verification failures on resume
 - ``sentinel_events``     non-fatal health warnings (acceptance collapse)
 - ``sentinel_trips``      sentinel-raised divergences (stuck/non-finite)
+- ``preempt_requests``    drain requests (signal or maintenance hook)
+- ``preempt_drains``      drains completed to a verified checkpoint
+- ``drain_abandoned_chunks``  in-flight chunks dropped at the deadline
+- ``watchdog_soft``       dispatch past the soft deadline (logged only)
+- ``watchdog_dumps``      stack dumps at the hard deadline
+- ``watchdog_stalls``     chunk dispatches aborted as stalled
+- ``stall_retries``       supervisor retries under the stall policy
+
+Gauges (:func:`gauge`) carry last-value measurements (floats) next to
+the counters — e.g. ``drain_latency_ms``, the request-to-verified-
+checkpoint time of the most recent preemption drain.
 """
 
 from __future__ import annotations
@@ -25,6 +36,7 @@ import threading
 
 _lock = threading.Lock()
 _counts: dict[str, int] = {}
+_gauges: dict[str, float] = {}
 
 
 def incr(name: str, n: int = 1) -> int:
@@ -39,6 +51,23 @@ def get(name: str) -> int:
         return _counts.get(name, 0)
 
 
+def gauge(name: str, value: float) -> None:
+    """Record a last-value measurement (overwrites; e.g. latencies)."""
+    with _lock:
+        _gauges[name] = float(value)
+
+
+def get_gauge(name: str, default: float | None = None):
+    with _lock:
+        return _gauges.get(name, default)
+
+
+def gauges() -> dict[str, float]:
+    """Copy of all gauges, sorted by name."""
+    with _lock:
+        return dict(sorted(_gauges.items()))
+
+
 def snapshot() -> dict[str, int]:
     """Copy of all counters, sorted by name (stable for JSON output)."""
     with _lock:
@@ -46,6 +75,7 @@ def snapshot() -> dict[str, int]:
 
 
 def reset() -> None:
-    """Zero every counter (tests; bench run isolation)."""
+    """Zero every counter and gauge (tests; bench run isolation)."""
     with _lock:
         _counts.clear()
+        _gauges.clear()

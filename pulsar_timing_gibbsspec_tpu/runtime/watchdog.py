@@ -1,0 +1,177 @@
+"""Dispatch watchdog: EMA-deadline heartbeat around blocking chunk work.
+
+A hung XLA dispatch (wedged device runtime, dead tunnel, livelocked
+collective) looks exactly like a very slow chunk — except it never
+returns, and an unsupervised run blocks forever without writing the
+checkpoint it already has.  The watchdog turns "never returns" into a
+classified, retryable failure:
+
+- The deadline tracks an EMA of steady-chunk wall times: ``k`` times
+  the smoothed chunk wall, floored at ``floor_s``.  Before any steady
+  wall is measured (first dispatch, or a fresh compile of an
+  off-residue tail chunk) the much larger ``first_floor_s`` applies —
+  a cold XLA compile is slow, not stuck.
+- Escalation inside one guarded call: past the SOFT deadline
+  (``soft_frac`` of the hard one) it logs a heartbeat warning and
+  counts ``watchdog_soft``; at the HARD deadline it dumps every
+  thread's stack (the post-mortem a hung run otherwise takes to the
+  grave) and counts ``watchdog_dumps``; then it abandons the dispatch
+  and raises :class:`DispatchStall` (``watchdog_stalls``).
+- The blocking call runs on a reusable single worker thread so the
+  waiter can time out; an abandoned worker (still blocked in native
+  code — Python cannot interrupt it) is detached and a fresh worker
+  serves the next call.  The jitted function, its compile cache and
+  the device arrays are all thread-safe to share, and the abandoned
+  call's result is discarded, so a late completion has no effect.
+
+``run_supervised`` classifies :class:`DispatchStall` as the ``stall``
+failure class with its own capped retry budget: the retry resumes from
+the last committed checkpoint bit-identically (the aborted chunk never
+reached the chain files).
+
+The guard adds no retraces: it never touches traced values — it only
+times the call and runs it on another thread.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+import traceback
+
+from . import telemetry
+
+
+class DispatchStall(RuntimeError):
+    """A guarded dispatch blew its hard deadline and was abandoned."""
+
+
+def dump_stacks() -> str:
+    """Formatted stacks of every live thread (the hang post-mortem)."""
+    out = []
+    names = {t.ident: t.name for t in threading.enumerate()}
+    for tid, frame in sys._current_frames().items():
+        out.append(f"--- thread {names.get(tid, '?')} ({tid}) ---")
+        out.extend(ln.rstrip() for ln in traceback.format_stack(frame))
+    return "\n".join(out)
+
+
+class DispatchWatchdog:
+    """Heartbeat guard for the driver's blocking chunk work.
+
+    ``observe(dt)`` feeds steady-chunk wall times; ``call(fn)`` runs
+    ``fn`` under the current deadline.  ``on_event`` (optional) receives
+    ``(stage, info)`` for ``"soft" | "dump" | "stall"`` so the driver
+    can mirror escalations into ``metrics.jsonl``.
+    """
+
+    def __init__(self, k=4.0, floor_s=30.0, first_floor_s=1800.0,
+                 ema_alpha=0.3, soft_frac=0.5, on_event=None,
+                 poll_s=0.05):
+        if k <= 1.0:
+            raise ValueError("watchdog k must exceed 1 (deadline must "
+                             "sit above the steady chunk wall)")
+        self.k = float(k)
+        self.floor_s = float(floor_s)
+        self.first_floor_s = float(first_floor_s)
+        self.ema_alpha = float(ema_alpha)
+        self.soft_frac = float(soft_frac)
+        self.on_event = on_event
+        self.poll_s = float(poll_s)
+        self.ema = None
+        self._worker = None
+        self._inbox = None
+
+    # -- deadline model ------------------------------------------------------
+
+    def observe(self, dt) -> None:
+        """Feed one steady-chunk wall time (seconds).  Callers must skip
+        walls that include a fresh compile — they would poison the EMA
+        the way one outlier poisons any small-alpha smoother."""
+        dt = float(dt)
+        self.ema = dt if self.ema is None else (
+            self.ema_alpha * dt + (1.0 - self.ema_alpha) * self.ema)
+
+    def deadline(self) -> float:
+        """Current hard deadline (seconds) for one guarded call."""
+        if self.ema is None:
+            return self.first_floor_s
+        return max(self.floor_s, self.k * self.ema)
+
+    # -- guarded execution ---------------------------------------------------
+
+    def _ensure_worker(self):
+        if self._worker is None or not self._worker.is_alive():
+            self._inbox = {"fn": None, "go": threading.Event(),
+                           "done": threading.Event(), "out": None,
+                           "exc": None}
+            self._worker = threading.Thread(
+                target=self._serve, args=(self._inbox,),
+                name="dispatch-watchdog-worker", daemon=True)
+            self._worker.start()
+
+    @staticmethod
+    def _serve(box):
+        while True:
+            box["go"].wait()
+            box["go"].clear()
+            fn = box["fn"]
+            if fn is None:        # abandoned: a fresh worker took over
+                return
+            try:
+                box["out"] = fn()
+            except BaseException as exc:    # noqa: BLE001 — re-raised
+                box["exc"] = exc
+            box["done"].set()
+
+    def _emit(self, stage, info):
+        if self.on_event is not None:
+            try:
+                self.on_event(stage, info)
+            except Exception:
+                pass              # observability must not kill the run
+
+    def call(self, fn, what="dispatch"):
+        """Run ``fn()`` under the deadline; returns its result or
+        re-raises its exception.  Raises :class:`DispatchStall` (and
+        abandons the call) when the hard deadline passes."""
+        self._ensure_worker()
+        box = self._inbox
+        box["fn"], box["out"], box["exc"] = fn, None, None
+        box["done"].clear()
+        box["go"].set()
+        hard = self.deadline()
+        soft = self.soft_frac * hard
+        t0 = time.monotonic()
+        warned = False
+        while True:
+            if box["done"].wait(self.poll_s):
+                break
+            el = time.monotonic() - t0
+            if not warned and el >= soft:
+                warned = True
+                telemetry.incr("watchdog_soft")
+                self._emit("soft", {"what": what, "elapsed_s": el,
+                                    "deadline_s": hard})
+            if el >= hard:
+                telemetry.incr("watchdog_dumps")
+                self._emit("dump", {"what": what, "elapsed_s": el,
+                                    "stacks": dump_stacks()})
+                # detach: the worker may be blocked in native code and
+                # cannot be interrupted; drop our reference and let a
+                # future call start a clean one
+                self._worker = None
+                self._inbox = None
+                telemetry.incr("watchdog_stalls")
+                self._emit("stall", {"what": what, "elapsed_s": el,
+                                     "deadline_s": hard})
+                raise DispatchStall(
+                    f"{what} exceeded the watchdog deadline "
+                    f"({el:.1f}s > {hard:.1f}s; steady-chunk EMA "
+                    f"{'unset' if self.ema is None else f'{self.ema:.2f}s'}"
+                    ") — dispatch abandoned; resume from the last "
+                    "committed checkpoint")
+        if box["exc"] is not None:
+            raise box["exc"]
+        return box["out"]

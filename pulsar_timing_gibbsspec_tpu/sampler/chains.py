@@ -40,10 +40,14 @@ class ChainStore:
         np.savetxt(self.outdir / "pars_chain.txt", self.param_names, fmt="%s")
         np.savetxt(self.outdir / "pars_bchain.txt", self.b_param_names, fmt="%s")
 
-    def save(self, chain, bchain, upto, adapt_state=None):
+    def save(self, chain, bchain, upto, adapt_state=None, extra=None):
         """Persist rows [0, upto) plus adaptation state, atomically enough
         for a crash between files not to corrupt resume (write tmp, rename;
-        the manifest written last makes any torn combination detectable)."""
+        the manifest written last makes any torn combination detectable).
+
+        ``extra`` is merged into ``manifest.json`` — the facade passes the
+        logical-layout / shard-map sections that make the checkpoint
+        resumable on a different device count (docs/RESILIENCE.md)."""
         from ..runtime import faults, integrity
 
         if self.backup:
@@ -61,7 +65,7 @@ class ChainStore:
             tmp = self.outdir / "adapt.npz.tmp.npz"
             np.savez(tmp, iter=np.int64(upto), **adapt_state)
             os.replace(tmp, self.outdir / "adapt.npz")
-        integrity.write_manifest(self.outdir, rows=upto)
+        integrity.write_manifest(self.outdir, rows=upto, extra=extra)
         faults.fire("chainstore.post_save", row=upto, outdir=self.outdir)
 
     def log_metrics(self, record: dict):

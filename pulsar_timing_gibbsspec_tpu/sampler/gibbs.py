@@ -16,7 +16,7 @@ import time
 
 import numpy as np
 
-from ..runtime import faults, sentinels
+from ..runtime import faults, integrity, preemption, sentinels
 from .chains import ChainStore
 from .numpy_backend import NumpyGibbs
 
@@ -92,13 +92,42 @@ class _GibbsBase:
             for k in ("record_precision", "record_every", "nchains",
                       "chunk_size", "pad_pulsars", "mesh", "warmup_sweeps",
                       "warmup_white_steps", "white_steps_max",
-                      "exact_every", "transfer_guard", "joint_mixed"):
+                      "exact_every", "transfer_guard", "joint_mixed",
+                      "watchdog"):
                 opts.pop(k, None)
         return type(self)(self.pta, hypersample=c["hypersample"],
                           ecorrsample=c["ecorrsample"],
                           redsample=c["redsample"], psr=c["psr"],
                           backend=backend, seed=c["seed"],
                           progress=self.progress, **opts)
+
+    def _checkpoint_extra(self):
+        """Manifest sections that make the checkpoint layout-free
+        (docs/RESILIENCE.md): ``layout`` pins the LOGICAL identity of the
+        sampled process — facade class, chain count, pulsar names in
+        logical order, the padded pulsar width (part of the PRNG draw
+        shapes, hence of the stream), record thinning, and the key-fold
+        policy — while ``shard_map`` records the physical placement the
+        run happened to use, advisory only: ``integrity.reshard_restore``
+        rebuilds the mesh for any device count dividing the padded
+        width, bit-identically per logical chain."""
+        be = self._backend
+        layout = {"facade": type(self).__name__,
+                  "backend": self.backend_name,
+                  "nchains": int(getattr(be, "C", 1)),
+                  "record_every": int(getattr(be, "record_every", 1)),
+                  "pulsars": [str(p) for p in self.pta.pulsars],
+                  "rng": "fold_in(fold_in(base_key, iteration), chain)"}
+        cm = getattr(be, "cm", None)
+        if cm is not None:
+            layout["pad_pulsars"] = int(cm.P)
+        shard = None
+        mesh = getattr(be, "_mesh", None)
+        if mesh is not None:
+            from ..parallel.sharding import mesh_layout
+
+            shard = mesh_layout(mesh)
+        return {"layout": layout, "shard_map": shard}
 
     # -- main loop -----------------------------------------------------------
 
@@ -182,6 +211,11 @@ class _GibbsBase:
         # recorded rows, so the row-space interval shrinks by k — the
         # crash-loss window must not silently stretch with thinning
         save_rows = max(1, save_every // rec_k)
+        ck_extra = self._checkpoint_extra()
+        # a drain request (SIGTERM / maintenance hook) breaks the loop;
+        # the finally-flush then persists every verified row and the
+        # post-loop block verifies + raises Preempted (resumable)
+        drained = False
         try:
             for upto in iterator:
                 faults.mutate_rows(chain, bchain, upto_done, upto,
@@ -199,10 +233,19 @@ class _GibbsBase:
                 upto_done = upto
                 faults.fire("sample.loop", row=upto,
                             backend=self.backend_name)
+                # a drain request on the FINAL row falls through: the run
+                # is complete, the normal save below commits it
+                if preemption.drain_requested() and upto < total_rows:
+                    drained = True
+                    store.log_metrics({"event": "drain_requested",
+                                       "row": int(upto),
+                                       **preemption.drain_info()})
+                    break
                 if upto - last_saved >= save_rows or upto >= total_rows:
                     no_flush = True   # a crash inside save: don't re-save
                     store.save(chain, bchain, upto,
-                               adapt_state=self._backend.adapt_state())
+                               adapt_state=self._backend.adapt_state(),
+                               extra=ck_extra)
                     no_flush = False
                     el = time.time() - t0
                     done = upto - start
@@ -243,7 +286,8 @@ class _GibbsBase:
                 # verified row (< save_every sweeps lost), resumable
                 try:
                     store.save(chain, bchain, upto_done,
-                               adapt_state=self._backend.adapt_state())
+                               adapt_state=self._backend.adapt_state(),
+                               extra=ck_extra)
                     store.log_metrics({"event": "final_flush",
                                        "rows": int(upto_done),
                                        "backend": self.backend_name})
@@ -251,6 +295,37 @@ class _GibbsBase:
                     # never mask the original exception with a failed
                     # best-effort flush
                     pass
+        # the backend's own chunk loop also stops dispatching on a drain
+        # request — the iterator then just ends, so an incomplete run
+        # with the flag up IS a drain, not a completion
+        drained = drained or (preemption.drain_requested()
+                              and upto_done < total_rows)
+        if drained:
+            self.chain = chain
+            self.bchain = bchain
+            # the flush above is best-effort (it swallows exceptions so
+            # a failed save cannot mask a real error); a drain must
+            # hand the supervisor a VERIFIED checkpoint or say so —
+            # rolling back to the .bak generation if a concurrent kill
+            # tore the final save
+            rep = integrity.verify(outdir)
+            rolled = False
+            if not rep["ok"]:
+                rolled = integrity.rollback(outdir)
+                rep = integrity.verify(outdir)
+            lat = preemption.mark_drained()
+            store.log_metrics({"event": "preempted_drain",
+                               "rows": int(rep["rows"]),
+                               "verified": bool(rep["ok"]),
+                               "rolled_back": rolled,
+                               "latency_s": round(lat, 3),
+                               **preemption.drain_info()})
+            raise preemption.Preempted(
+                f"{outdir}: drained to a "
+                f"{'verified' if rep['ok'] else 'UNVERIFIED'} checkpoint "
+                f"({rep['rows']} rows) after "
+                f"{preemption.drain_info().get('reason', 'preemption')}",
+                rows=rep["rows"], verified=rep["ok"], rolled_back=rolled)
         if self.progress and is_tty:
             print()
         if hdf5:

@@ -39,13 +39,17 @@ chain adaptation state), so C chains multiply posterior samples/sec by
 from __future__ import annotations
 
 import os
+import time
+import warnings
 from typing import NamedTuple
 
 import numpy as np
 
 from ..config import settings
 from ..ops.acf import integrated_act
+from ..runtime import faults, preemption, telemetry
 from ..runtime.sentinels import SentinelMonitor, chunk_health
+from ..runtime.watchdog import DispatchWatchdog
 from .compiled import CompiledPTA, compile_pta
 
 _SCALES = np.array([0.1, 0.5, 1.0, 3.0, 10.0])
@@ -607,9 +611,14 @@ def draw_b_hd_freqblock(cm: CompiledPTA, x, b, key, exact=False):
     bn = dj * jnp.einsum("pji,pj->pi", Li, w + z, precision="highest")
     # two-float breakdown guard (same contract as draw_b_mh's ok-mask):
     # a NaN factor row skips that pulsar's update for the sweep instead
-    # of poisoning the chain
+    # of poisoning the chain.  Pad pulsars (psr_mask == 0) also keep
+    # their incoming b: their decoupled identity system draws pure
+    # noise, and letting it churn would make pad-row contents depend on
+    # the kernel choice instead of staying inert (the invariant the
+    # sequential kernel's live_mask already keeps).
+    live = (jnp.asarray(cm.psr_mask, cdt) > 0)[:, None]
     ok1 = jnp.all(jnp.isfinite(bn), axis=1, keepdims=True)
-    b = jnp.where((gwm > 0) | ~ok1, b, bn)
+    b = jnp.where((gwm > 0) | ~ok1 | ~live, b, bn)
 
     # ---- block 2: per-frequency joint draw across pulsars -----------------
     # m coordinate groups of P: gw sin, gw cos (+ red sin, red cos at the
@@ -669,7 +678,7 @@ def draw_b_hd_freqblock(cm: CompiledPTA, x, b, key, exact=False):
         # rhs: data projection minus coupling to every OTHER coordinate
         a4 = jnp.take_along_axis(b, c4, axis=1) * v4          # (P, m)
         coup = jnp.einsum("pib,pb->pi", Tr, b, precision="highest")
-        self_c = jnp.einsum("pij,pj->pi", T4, a4)
+        self_c = jnp.einsum("pij,pj->pi", T4, a4, precision="highest")
         dk = jnp.take_along_axis(d, c4, axis=1) * v4
         r = (dk - coup + self_c).T.reshape(m * P)             # group-major
         qdiag = jnp.diagonal(Q)
@@ -686,8 +695,10 @@ def draw_b_hd_freqblock(cm: CompiledPTA, x, b, key, exact=False):
             vi = v4[:, i]
             ci = c4[:, i]
             old = b[pr_arange, ci]
+            # live[:, 0] keeps pad rows out of the scatter: their Ginv
+            # identity rows draw valid-looking but meaningless values
             b = b.at[pr_arange, ci].set(
-                jnp.where((vi > 0) & okk, anew[i], old))
+                jnp.where((vi > 0) & okk & live[:, 0], anew[i], old))
         return b, None
 
     b, _ = jax.lax.scan(step, b, jr.permutation(kp, K))
@@ -2090,7 +2101,7 @@ class JaxGibbsDriver:
                  warmup_white_steps=16, white_steps_max=64, nchains=1,
                  exact_every=EXACT_EVERY, record_precision=None,
                  record_every=1, transfer_guard=False, sentinels=True,
-                 joint_mixed=None):
+                 joint_mixed=None, watchdog=None):
         settings.apply()
         import jax
         import jax.random as jr
@@ -2101,10 +2112,30 @@ class JaxGibbsDriver:
         self._jax, self._jr = jax, jr
         self.cm = compile_pta(pta, pad_pulsars=pad_pulsars,
                               kernel_ecorr=(ecorrsample == "kernel"))
+        #: the mesh (or None) is remembered for the checkpoint manifest's
+        #: shard_map section — physical placement, recorded separately
+        #: from the logical layout precisely so resume can change it
+        self._mesh = mesh
         if mesh is not None:
             from ..parallel.sharding import shard_compiled
 
             self.cm = shard_compiled(self.cm, mesh)
+        #: dispatch watchdog (runtime.watchdog): ``True`` builds the
+        #: default EMA-deadline guard, an instance is used as-is, and
+        #: None/False runs unguarded.  The guard never touches traced
+        #: values (zero retraces) — it only times the blocking chunk
+        #: work on a worker thread so a hung dispatch becomes the
+        #: retryable ``stall`` failure class instead of a silent hang
+        if watchdog is True:
+            self.watchdog = DispatchWatchdog()
+        elif isinstance(watchdog, DispatchWatchdog):
+            self.watchdog = watchdog
+        elif watchdog in (None, False):
+            self.watchdog = None
+        else:
+            raise ValueError(
+                "watchdog must be True/False/None or a DispatchWatchdog "
+                f"instance, got {watchdog!r}")
         self.nb_total = int(sum(self.cm.widths))
         self.white_adapt_iters = white_adapt_iters
         self.red_adapt_iters = red_adapt_iters
@@ -3068,7 +3099,16 @@ class JaxGibbsDriver:
             return row + m
 
         it_base = self._it_base(niter)
+        wd = self.watchdog
+        # steady-chunk wall EMA, kept even without a watchdog: it is the
+        # drain path's estimate of what landing the in-flight chunk costs
+        wall_ema = None
         while ii < niter:
+            if preemption.drain_requested():
+                # stop dispatching new chunks the moment the drain flag
+                # is up; the fate of the chunk already in flight is
+                # decided below against the deadline
+                break
             n = min(self.chunk_size, niter - ii)
             # always run the full compiled chunk length: a trailing
             # odd-length chunk would trigger a fresh ~30 s XLA compile for
@@ -3083,7 +3123,12 @@ class JaxGibbsDriver:
             # tail is extended — that resume pays one fresh compile for
             # its off-residue chunk function.
             off = (it_base - ii) % self.record_every
+            # a _chunk_fn cache miss means THIS chunk pays a fresh XLA
+            # compile at first execution — its wall must not feed the
+            # watchdog EMA (first_floor_s covers cold compiles)
+            n_fns = len(self._sweep_fns)
             fn = self._chunk_fn(self.chunk_size, off)
+            fresh_compile = len(self._sweep_fns) != n_fns
             # stage every argument BEFORE the dispatch with explicit
             # device_put (jnp.asarray of a Python scalar is an IMPLICIT
             # transfer and would trip the guard); the dispatch itself is
@@ -3091,8 +3136,21 @@ class JaxGibbsDriver:
             dput = self._jax.device_put
             args = (x, b_dev, self.key, dput(np.int32(ii)),
                     self._aux(chain, ii), dput(np.int32(n)))
-            with self._dispatch_guard():
-                x, b_dev, xs, bs, health = fn(*args)
+
+            def _go(fn=fn, args=args, it0=ii):
+                # the fault seam and the (thread-local!) transfer guard
+                # both live INSIDE this callable: an injected stall runs
+                # on the watchdog's clock, and the guard covers the
+                # dispatch on whichever thread executes it
+                faults.fire("dispatch.chunk", row=it0, backend="jax")
+                with self._dispatch_guard():
+                    return fn(*args)
+
+            t0 = time.monotonic()
+            if wd is not None:
+                x, b_dev, xs, bs, health = wd.call(_go, what=f"chunk@{ii}")
+            else:
+                x, b_dev, xs, bs, health = _go()
             m = max(0, -(-(n - off) // self.record_every))
             if pending is not None:
                 # start both host copies in flight together before the
@@ -3109,12 +3167,32 @@ class JaxGibbsDriver:
                         arr.copy_to_host_async()
                     except (AttributeError, RuntimeError):
                         pass
-                yield _writeback(*pending)
+                # the writeback blocks on chunk i's device results — on
+                # a hung device THIS is where the run would freeze, so
+                # it runs under the same watchdog deadline
+                if wd is not None:
+                    yield wd.call(lambda p=pending: _writeback(*p),
+                                  what=f"writeback@{pending[0]}")
+                else:
+                    yield _writeback(*pending)
+            dt = time.monotonic() - t0
+            if not fresh_compile:
+                wall_ema = dt if wall_ema is None else (
+                    0.3 * dt + 0.7 * wall_ema)
+                if wd is not None:
+                    wd.observe(dt)
             pending = (rowc, m, xs, bs, x, b_dev, ii + n, health)
             ii += n
             rowc += m
         if pending is not None:
-            yield _writeback(*pending)
+            if preemption.should_abandon(wall_ema or 0.0):
+                # landing the in-flight chunk would blow the grace
+                # window: drop it — its sweeps replay bit-exactly on
+                # resume (per-sweep keys are pure in the absolute
+                # iteration index, so nothing is lost but wall time)
+                telemetry.incr("drain_abandoned_chunks")
+            else:
+                yield _writeback(*pending)
 
     def _observe_health(self, health, it_end):
         """Fold a chunk's on-device health reductions into the monitor
@@ -3209,7 +3287,31 @@ class JaxGibbsDriver:
                 "they must match")
         self.key = jr.wrap_key_data(
             np.asarray(state["jax_key"], dtype=np.uint32))
-        self.b = np.asarray(state["b_pad"], dtype=self.cm.cdtype)
+        b_pad = np.asarray(state["b_pad"], dtype=self.cm.cdtype)
+        want = (self.C, self.cm.P, self.cm.Bmax)
+        if b_pad.shape != want:
+            # the padded pulsar width is part of the LOGICAL layout —
+            # PRNG draw shapes pair threefry counters across the whole
+            # padded block, so changing it re-keys every draw.  Resuming
+            # across a width change is still a valid continuation of the
+            # same posterior (pad rows are exact no-ops), just no longer
+            # a bitwise one; reshard_restore preserves the width exactly
+            # to keep the bitwise contract, so only a hand-built resume
+            # lands here.
+            warnings.warn(
+                f"resume checkpoint's b coefficients have shape "
+                f"{b_pad.shape} but this sampler is compiled for {want} "
+                "(padded pulsar width changed); re-padding — the resumed "
+                "chain is a valid continuation but NOT a bitwise replay. "
+                "Use runtime.integrity.reshard_restore to preserve the "
+                "checkpoint's layout exactly.", RuntimeWarning,
+                stacklevel=2)
+            nb = np.zeros(want, dtype=self.cm.cdtype)
+            p = min(b_pad.shape[1], want[1])
+            w = min(b_pad.shape[2], want[2])
+            nb[:, :p, :w] = b_pad[:, :p, :w]
+            b_pad = nb
+        self.b = b_pad
         if "it_cur" in state:
             self._resume_it = int(state.pop("it_cur"))
         if "x_cur" in state:

@@ -15,7 +15,8 @@ import shutil
 import numpy as np
 import pytest
 
-from pulsar_timing_gibbsspec_tpu.runtime import (faults, run_supervised,
+from pulsar_timing_gibbsspec_tpu.runtime import (faults, integrity,
+                                                 preemption, run_supervised,
                                                  supervisor, telemetry)
 from pulsar_timing_gibbsspec_tpu.sampler.gibbs import PTABlockGibbs
 
@@ -29,8 +30,10 @@ SAVE = 20
 def _clean():
     faults.clear()
     telemetry.reset()
+    preemption.reset()       # the drain flag is process-wide
     yield
     faults.clear()
+    preemption.reset()
 
 
 @pytest.fixture(scope="module")
@@ -253,3 +256,228 @@ def test_report_counters_match_telemetry(synth_pta, x0, tmp_path):
     assert telemetry.get("retries") == rep.retries == 1
     d = rep.as_dict()
     assert d["backend"] == "numpy" and len(d["failures"]) == 1
+
+
+# -- preemption drain / watchdog / reshard elasticity (ISSUE 4) -------------
+
+def test_sigterm_drains_to_verified_checkpoint_and_resumes_bitwise(
+        synth_pta, x0, baseline, tmp_path):
+    """A drain request mid-run (sigterm_at_seam — the same request_drain
+    the real SIGTERM handler calls) stops the loop, flushes, verifies,
+    and surfaces as the supervisor's resumable ``preempted`` status —
+    never a failure; the next incarnation resumes bit-identically."""
+    faults.inject("sigterm_at_seam", point="sample.loop", at_row=30,
+                  seconds=60.0)
+    chain, rep = run_supervised(_gibbs(synth_pta), x0, tmp_path, NITER,
+                                save_every=SAVE, sleep=lambda s: None)
+    assert rep.status == "preempted"
+    assert rep.attempts == 1 and rep.retries == 0 and not rep.failures
+    assert telemetry.get("preempt_requests") == 1
+    assert telemetry.get("preempt_drains") == 1
+    assert telemetry.get_gauge("drain_latency_ms") is not None
+    v = integrity.verify(tmp_path)
+    assert v["ok"] and v["rows"] == 30
+    assert np.array_equal(chain[:30], baseline[:30])
+    evs = [e.get("event") for e in _events(tmp_path)]
+    for want in ("drain_requested", "preempted_drain",
+                 "supervised_preempted"):
+        assert want in evs, want
+    # next incarnation (fresh process: flag cleared) — bitwise resume
+    preemption.reset()
+    chain2, rep2 = run_supervised(_gibbs(synth_pta), x0, tmp_path, NITER,
+                                  save_every=SAVE, sleep=lambda s: None)
+    assert rep2.status == "completed"
+    assert np.array_equal(chain2, baseline)
+
+
+def test_kill_during_drain_rolls_back_to_backup(synth_pta, x0, baseline,
+                                                tmp_path):
+    """A concurrent kill tearing the drain's final flush (chain.npy
+    damaged after the manifest was written): the drain path verifies,
+    rolls back to the .bak generation, and still reports a VERIFIED —
+    just earlier — checkpoint; the next incarnation extends bitwise."""
+    faults.inject("sigterm_at_seam", point="sample.loop", at_row=30,
+                  seconds=60.0)
+    faults.inject("truncate_file", point="chainstore.post_save",
+                  at_row=25, path="chain.npy")
+    _, rep = run_supervised(_gibbs(synth_pta), x0, tmp_path, NITER,
+                            save_every=SAVE, sleep=lambda s: None)
+    assert rep.status == "preempted"
+    assert telemetry.get("rollbacks") == 1
+    v = integrity.verify(tmp_path)
+    assert v["ok"] and v["rows"] == 20          # the pre-drain checkpoint
+    drains = [e for e in _events(tmp_path)
+              if e.get("event") == "preempted_drain"]
+    assert drains and drains[0]["verified"] and drains[0]["rolled_back"]
+    preemption.reset()
+    faults.clear()
+    chain2, rep2 = run_supervised(_gibbs(synth_pta), x0, tmp_path, NITER,
+                                  save_every=SAVE, sleep=lambda s: None)
+    assert rep2.status == "completed"
+    assert np.array_equal(chain2, baseline)
+
+
+def test_watchdog_stall_aborts_chunk_and_resumes_bitwise(synth_pta,
+                                                         tmp_path):
+    """An injected stall inside the dispatch seam blows the watchdog's
+    EMA deadline: the chunk is abandoned as the ``stall`` class, the
+    supervisor retries under the stall budget, and the resumed run is
+    bit-identical to an unstalled one (the aborted chunk never reached
+    the chain files)."""
+    from pulsar_timing_gibbsspec_tpu.runtime.watchdog import DispatchWatchdog
+
+    x0 = synth_pta.initial_sample(np.random.default_rng(0))
+    kw = dict(backend="jax", seed=3, progress=False, warmup_sweeps=2,
+              chunk_size=4)
+    base = PTABlockGibbs(synth_pta, **kw).sample(
+        x0, outdir=tmp_path / "base", niter=16, save_every=4)
+    faults.inject("stall", point="dispatch.chunk", at_row=11,
+                  seconds=5.0, backend="jax")
+    wd = DispatchWatchdog(k=4.0, floor_s=0.4, first_floor_s=120.0,
+                          poll_s=0.02)
+    g = PTABlockGibbs(synth_pta, watchdog=wd, **kw)
+    chain, rep = run_supervised(g, x0, tmp_path / "chaos", 16,
+                                save_every=4, sleep=lambda s: None)
+    assert np.array_equal(chain, base)
+    assert rep.status == "completed"
+    assert rep.stall_retries == 1 and rep.retries == 0
+    assert rep.failures[0]["kind"] == "stall"
+    assert telemetry.get("watchdog_stalls") == 1
+    assert telemetry.get("watchdog_dumps") == 1
+    assert telemetry.get("stall_retries") == 1
+
+
+def test_stall_budget_is_capped(synth_pta, x0, tmp_path):
+    """A stall that never clears exhausts its OWN capped budget and
+    re-raises — it must not spin on the general retry budget."""
+    from pulsar_timing_gibbsspec_tpu.runtime.watchdog import DispatchStall
+
+    class AlwaysStalls:
+        backend_name = "jax"
+        chain = None
+
+        def sample(self, *a, **k):
+            raise DispatchStall("wedged")
+
+    with pytest.raises(DispatchStall):
+        run_supervised(AlwaysStalls(), x0, tmp_path, NITER,
+                       save_every=SAVE, stall_max_retries=2,
+                       sleep=lambda s: None)
+    evs = [e.get("event") for e in _events(tmp_path)]
+    assert "supervised_giving_up" in evs
+    assert telemetry.get("stall_retries") == 2
+
+
+@pytest.fixture(scope="module")
+def crn_mesh8(synth_pta, tmp_path_factory):
+    """A CRN run checkpointed mid-stream under an 8-device mesh with
+    pad_pulsars=8 (the logical padded width), plus the uninterrupted
+    16-row target — shared by the reshard cases below."""
+    from pulsar_timing_gibbsspec_tpu.parallel.sharding import make_mesh
+
+    x0 = synth_pta.initial_sample(np.random.default_rng(0))
+    kw = dict(backend="jax", seed=3, progress=False, warmup_sweeps=2,
+              chunk_size=4, pad_pulsars=8)
+    root = tmp_path_factory.mktemp("crn_mesh8")
+    base = PTABlockGibbs(synth_pta, mesh=make_mesh(8), **kw).sample(
+        x0, outdir=root / "base", niter=16, save_every=4)
+    PTABlockGibbs(synth_pta, mesh=make_mesh(8), **kw).sample(
+        x0, outdir=root / "src", niter=8, save_every=4)
+    return {"x0": x0, "base": base, "src": root / "src"}
+
+
+def test_reshard_resume_crn_bitwise(synth_pta, crn_mesh8, tmp_path):
+    """Elasticity contract, CRN case: a checkpoint written under 8
+    devices resumes under 1, 2 and 4 via reshard_restore, and every
+    resumed chain is bitwise-identical per logical chain to the
+    uninterrupted 8-device run — the logical layout (padded width,
+    chain/pulsar order, key folds) pins the stream; the shard map is
+    only placement."""
+    for d in (1, 2, 4):
+        dst = tmp_path / f"dev{d}"
+        shutil.copytree(crn_mesh8["src"], dst)
+        g = integrity.reshard_restore(dst, synth_pta, devices=d,
+                                      seed=3, progress=False,
+                                      warmup_sweeps=2, chunk_size=4)
+        chain = g.sample(crn_mesh8["x0"], outdir=dst, niter=16,
+                         resume=True, save_every=4)
+        assert np.array_equal(chain, crn_mesh8["base"]), f"devices={d}"
+        info = integrity.read_layout(dst)
+        assert info["layout"]["pad_pulsars"] == 8
+        if d > 1:
+            assert info["shard_map"]["devices"] == d
+        else:
+            assert info["shard_map"] is None
+
+
+def test_reshard_back_up_to_eight(synth_pta, crn_mesh8, tmp_path):
+    """8 -> 4 -> 8: scale down mid-run, then back up, still bitwise."""
+    dst = tmp_path / "updown"
+    shutil.copytree(crn_mesh8["src"], dst)
+    g = integrity.reshard_restore(dst, synth_pta, devices=4, seed=3,
+                                  progress=False, warmup_sweeps=2,
+                                  chunk_size=4)
+    g.sample(crn_mesh8["x0"], outdir=dst, niter=12, resume=True,
+             save_every=4)
+    g = integrity.reshard_restore(dst, synth_pta, devices=8, seed=3,
+                                  progress=False, warmup_sweeps=2,
+                                  chunk_size=4)
+    chain = g.sample(crn_mesh8["x0"], outdir=dst, niter=16, resume=True,
+                     save_every=4)
+    assert np.array_equal(chain, crn_mesh8["base"])
+    assert integrity.read_layout(dst)["shard_map"]["devices"] == 8
+
+
+def test_device_count_change_fault_overrides_reshard(synth_pta,
+                                                     crn_mesh8, tmp_path):
+    """The device_count_change_on_resume fault stands in for the pool
+    handing the next incarnation a different slice: reshard_restore
+    consults it and builds the mesh for the injected count."""
+    dst = tmp_path / "pool"
+    shutil.copytree(crn_mesh8["src"], dst)
+    faults.inject("device_count_change_on_resume", devices=2)
+    g = integrity.reshard_restore(dst, synth_pta, devices=8, seed=3,
+                                  progress=False, warmup_sweeps=2,
+                                  chunk_size=4)
+    assert g._backend._mesh.devices.size == 2
+    chain = g.sample(crn_mesh8["x0"], outdir=dst, niter=16, resume=True,
+                     save_every=4)
+    assert np.array_equal(chain, crn_mesh8["base"])
+
+
+def test_reshard_rejects_indivisible_device_count(synth_pta, crn_mesh8,
+                                                  tmp_path):
+    dst = tmp_path / "bad"
+    shutil.copytree(crn_mesh8["src"], dst)
+    with pytest.raises(integrity.CheckpointError, match="padded pulsar"):
+        integrity.reshard_restore(dst, synth_pta, devices=3)
+
+
+def test_reshard_resume_hd_statistical(synth_hd_pta, tmp_path):
+    """HD (multi-pulsar) case: cross-pulsar all-reduce order may change
+    with the device count, so the contract is prefix-bitwise (the
+    checkpointed rows ARE the checkpointed rows) plus a distribution-
+    level match of the continuation, not a bitwise one."""
+    from pulsar_timing_gibbsspec_tpu.parallel.sharding import make_mesh
+
+    x0 = synth_hd_pta.initial_sample(np.random.default_rng(0))
+    kw = dict(backend="jax", seed=5, progress=False, warmup_sweeps=2,
+              chunk_size=4, pad_pulsars=4)
+    base = PTABlockGibbs(synth_hd_pta, mesh=make_mesh(4), **kw).sample(
+        x0, outdir=tmp_path / "base", niter=16, save_every=4)
+    src = tmp_path / "src"
+    PTABlockGibbs(synth_hd_pta, mesh=make_mesh(4), **kw).sample(
+        x0, outdir=src, niter=8, save_every=4)
+    g = integrity.reshard_restore(src, synth_hd_pta, devices=2, seed=5,
+                                  progress=False, warmup_sweeps=2,
+                                  chunk_size=4)
+    chain = g.sample(x0, outdir=src, niter=16, resume=True, save_every=4)
+    assert chain.shape == base.shape
+    assert np.array_equal(chain[:8], base[:8])      # checkpointed prefix
+    assert np.isfinite(chain).all()
+    # KS-level: the continued stretches sample the same posterior; with
+    # identical seeds and only reduction-order noise between them they
+    # are numerically close row-by-row long before 8 rows decorrelate
+    tail, btail = chain[8:], base[8:]
+    span = base.max(axis=0) - base.min(axis=0) + 1e-12
+    assert np.all(np.abs(tail - btail) / span < 0.5)

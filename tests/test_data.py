@@ -67,9 +67,9 @@ def _write_nanograv_style(tmp_path):
         "DMXR2_0003     53270.0",
         "FD1            1.0e-5 1",
         "FD2            -2.0e-6 1",
+        "JUMP -be GUPPI 2.2e-6 0",               # unfitted: no column
         "JUMP -fe Rcvr_800 6.4e-6 1 1.2e-7",     # fitted + uncertainty
         "JUMP MJD 53100 53150 1.1e-6 1",
-        "JUMP -be GUPPI 2.2e-6 0",               # unfitted: no column
         "JUMP -fe L-wide 1",                     # offset "1", NO fit flag
     ]))
     rng = np.random.default_rng(3)
@@ -136,6 +136,10 @@ def test_design_matrix_dmx_jump_fd(tmp_path):
     # 3-token jump whose OFFSET is literally "1" (no fit flag): no column
     sel_lw = np.array([fl.get("fe") == "L-wide" for fl in tim.flags], float)
     assert not any(np.allclose(M[:, j], sel_lw) for j in range(M.shape[1]))
+    # labels number FITTED jumps: the unfitted -be GUPPI line comes first
+    # in the par, so raw-index numbering would call these JUMP2/JUMP3
+    _, labels = design_matrix(par, tim, return_labels=True)
+    assert [l for l in labels if l.startswith("JUMP")] == ["JUMP1", "JUMP2"]
 
     # end-to-end: the pulsar loads and the full basis keeps rank
     psr = load_pulsar(parf, timf)

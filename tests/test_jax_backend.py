@@ -538,10 +538,10 @@ def test_hd_oracle_vs_jax_equivalence(psrs8, tmp_path):
 
 @pytest.mark.parametrize("kernel", ["freq", "pulsar"])
 def test_hd_scalable_matches_dense(psrs8, tmp_path, monkeypatch, kernel):
-    """Both scalable HD kernels (the two-block frequency-joint production
-    sweep and the sequential pulsar-wise sweep) must sample the same
-    posterior as the dense joint draw: same model, dense vs
-    forced-scalable, ESS-aware comparison."""
+    """Both scalable HD kernels (the two-block frequency-joint sweep and
+    the production sequential pulsar-wise sweep — docs/HD_MIXING.md) must
+    sample the same posterior as the dense joint draw: same model, dense
+    vs forced-scalable, ESS-aware comparison."""
     pta = model_general(psrs8[:3], tm_svd=True, red_var=False,
                         white_vary=False, common_psd="spectrum",
                         common_components=5, orf="hd")
@@ -838,6 +838,29 @@ def test_pad_pulsars_inert(psrs8):
     x3 = np.asarray(jb.rho_update(cm3, x, b3, key))
     x4 = np.asarray(jb.rho_update(cm4, x, b4, key))
     np.testing.assert_allclose(x3, x4, rtol=1e-7)
+
+
+def test_hd_kernels_keep_pad_rows_inert(synth_hd_pta):
+    """Both scalable HD b-draw kernels must leave pad-pulsar rows of b
+    exactly as they came in: a pad row that churns (block 1 of the freq
+    kernel used to draw noise into it) makes pad contents depend on the
+    kernel choice and leaks kernel-dependent state into checkpoints
+    (ADVICE r5)."""
+    import jax.numpy as jnp
+    import jax.random as jr
+
+    pta = synth_hd_pta
+    x = pta.initial_sample(np.random.default_rng(1))
+    cm = compile_pta(pta, pad_pulsars=4)
+    x = jnp.asarray(x, cm.cdtype)
+    key = jr.key(7)
+    b0 = jnp.asarray(jb.draw_b_fn(cm, x, key, exact=True))
+    marker = 7.25          # exactly representable; survives bitwise
+    b0 = b0.at[3].set(marker)
+    for kern in (jb.draw_b_hd_freqblock, jb.draw_b_hd_sequential):
+        b1 = np.asarray(kern(cm, x, b0, jr.key(11), exact=True))
+        assert np.all(b1[3] == marker), kern.__name__
+        assert np.all(np.isfinite(b1[:3])), kern.__name__
 
 
 # ---------------------------------------------------------------------------

@@ -310,3 +310,158 @@ def test_torn_legacy_checkpoint_warns_and_logs(synth_pta, tmp_path):
     torn = [e for e in events if e.get("event") == "torn_checkpoint"]
     assert torn and torn[0]["file"] == "bchain.npy"
     assert torn[0]["chain_rows"] == 20 and torn[0]["bchain_rows"] == 15
+
+
+# ---- preemption (drain state machine + signal handlers) --------------------
+
+def test_drain_request_is_idempotent_and_first_wins():
+    from pulsar_timing_gibbsspec_tpu.runtime import preemption
+
+    preemption.reset()
+    telemetry.reset()
+    try:
+        assert not preemption.drain_requested()
+        assert preemption.deadline_remaining() == float("inf")
+        assert not preemption.should_abandon(1e9)
+        preemption.request_drain("maintenance", deadline_s=10.0)
+        assert preemption.drain_requested()
+        # a later request cannot extend the grace window
+        preemption.request_drain("later", deadline_s=1e6)
+        info = preemption.drain_info()
+        assert info["reason"] == "maintenance"
+        assert info["deadline_s"] == 10.0
+        assert 0 < preemption.deadline_remaining() <= 10.0
+        assert preemption.should_abandon(60.0)
+        assert not preemption.should_abandon(0.0)
+        assert telemetry.get("preempt_requests") == 1
+        lat = preemption.mark_drained()
+        assert lat >= 0.0
+        assert telemetry.get("preempt_drains") == 1
+        assert telemetry.get_gauge("drain_latency_ms") == pytest.approx(
+            lat * 1000.0)
+    finally:
+        preemption.reset()
+    assert not preemption.drain_requested()
+
+
+def test_signal_handler_drains_then_escalates():
+    import os
+    import signal
+
+    from pulsar_timing_gibbsspec_tpu.runtime import preemption
+
+    preemption.reset()
+    preemption.install(signals=(signal.SIGTERM,), deadline_s=5.0)
+    try:
+        os.kill(os.getpid(), signal.SIGTERM)
+        assert preemption.drain_requested()
+        assert preemption.drain_info()["reason"] == "SIGTERM"
+        # the SECOND signal must not be swallowed: a wedged drain still
+        # dies on an operator's repeated kill
+        with pytest.raises(KeyboardInterrupt):
+            os.kill(os.getpid(), signal.SIGTERM)
+    finally:
+        preemption.uninstall()
+        preemption.reset()
+
+
+# ---- watchdog --------------------------------------------------------------
+
+def test_watchdog_deadline_model():
+    from pulsar_timing_gibbsspec_tpu.runtime.watchdog import DispatchWatchdog
+
+    with pytest.raises(ValueError, match="exceed 1"):
+        DispatchWatchdog(k=1.0)
+    wd = DispatchWatchdog(k=4.0, floor_s=10.0, first_floor_s=300.0,
+                          ema_alpha=0.5)
+    assert wd.deadline() == 300.0          # no steady wall yet
+    wd.observe(1.0)
+    assert wd.deadline() == 10.0           # floored
+    wd.observe(9.0)                        # ema -> 5.0
+    assert wd.deadline() == pytest.approx(20.0)
+
+
+def test_watchdog_passthrough_and_stall():
+    import time as _t
+
+    from pulsar_timing_gibbsspec_tpu.runtime.watchdog import (
+        DispatchStall, DispatchWatchdog)
+
+    telemetry.reset()
+    events = []
+    wd = DispatchWatchdog(k=2.0, floor_s=0.1, first_floor_s=0.15,
+                          poll_s=0.01, on_event=lambda s, i: events.append(s))
+    assert wd.call(lambda: "ok") == "ok"
+
+    def boom():
+        raise RuntimeError("from inside")
+
+    with pytest.raises(RuntimeError, match="from inside"):
+        wd.call(boom)
+    with pytest.raises(DispatchStall, match="deadline"):
+        wd.call(lambda: _t.sleep(2.0))
+    assert events == ["soft", "dump", "stall"]
+    assert telemetry.get("watchdog_stalls") == 1
+    assert telemetry.get("watchdog_dumps") == 1
+    assert telemetry.get("watchdog_soft") >= 1
+    # the detached worker is replaced: the guard still serves new calls
+    assert wd.call(lambda: 7) == 7
+
+
+# ---- new fault kinds -------------------------------------------------------
+
+def test_stall_and_sigterm_fault_kinds():
+    import time as _t
+
+    from pulsar_timing_gibbsspec_tpu.runtime import preemption
+
+    preemption.reset()
+    try:
+        faults.inject("stall", point="dispatch.chunk", seconds=0.05)
+        t0 = _t.monotonic()
+        faults.fire("dispatch.chunk", row=0)
+        assert _t.monotonic() - t0 >= 0.05
+        faults.fire("dispatch.chunk", row=1)   # consumed: no second sleep
+        faults.inject("sigterm_at_seam", point="sample.loop", seconds=3.0)
+        faults.fire("sample.loop", row=5)
+        assert preemption.drain_requested()
+        assert preemption.drain_info()["deadline_s"] == 3.0
+    finally:
+        preemption.reset()
+
+
+def test_device_count_override_consumes_one_firing():
+    faults.inject("device_count_change_on_resume", devices=4)
+    assert faults.device_count_override(8) == 4
+    assert faults.device_count_override(8) == 8
+
+
+# ---- layout manifest helpers ----------------------------------------------
+
+def test_read_layout_roundtrip(tmp_path):
+    np.save(tmp_path / "chain.npy", np.zeros((3, 2)))
+    lay = {"facade": "PTABlockGibbs", "nchains": 2, "pad_pulsars": 8,
+           "pulsars": ["A", "B"], "record_every": 1}
+    shard = {"devices": 8, "axis": "pulsar", "platform": "cpu"}
+    integrity.write_manifest(tmp_path, rows=3,
+                             extra={"layout": lay, "shard_map": shard})
+    info = integrity.read_layout(tmp_path)
+    assert info == {"layout": lay, "shard_map": shard}
+    # pre-layout manifests read as None (legacy checkpoints)
+    integrity.write_manifest(tmp_path, rows=3)
+    assert integrity.read_layout(tmp_path) is None
+
+
+def test_refold_preserves_layout_sections(tmp_path):
+    import jax.random as jr
+
+    key = np.asarray(jr.key_data(jr.key(0)))
+    np.savez(tmp_path / "adapt.npz", iter=np.int64(4), jax_key=key)
+    np.save(tmp_path / "chain.npy", np.zeros((4, 2)))
+    lay = {"facade": "PTABlockGibbs", "pad_pulsars": 8, "nchains": 1,
+           "pulsars": ["A"], "record_every": 1}
+    integrity.write_manifest(tmp_path, rows=4, extra={"layout": lay,
+                                                      "shard_map": None})
+    assert sentinels.refold_checkpoint_key(tmp_path, salt=1)
+    info = integrity.read_layout(tmp_path)
+    assert info is not None and info["layout"] == lay

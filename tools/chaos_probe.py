@@ -1,21 +1,42 @@
 """End-to-end kill/resume/verify drill for the resilient runtime.
 
-Runs the full recovery story on a synthetic single-pulsar PTA (no
-reference data needed): an uninterrupted baseline run, then a supervised
-run with a fault injected mid-stream (default: process "kill" between
-the chain.npy and bchain.npy replaces — the torn-checkpoint window),
-and asserts the recovered chain is bit-identical to the baseline.
-Prints a JSON report with the telemetry counters and retry metadata.
+Runs a full recovery story on a synthetic single-pulsar PTA (no
+reference data needed), always against an uninterrupted baseline run,
+and asserts the recovered chain is bit-identical to it.  Prints a JSON
+report with the telemetry counters/gauges and retry metadata.
 
-Usage: python tools/chaos_probe.py [--fault kill|truncate|corrupt|nan|xla]
-       [--niter 60] [--save-every 20] [--at-row 30]
+Scenarios (``--scenario``):
+
+- ``fault`` (default): supervised run with a fault injected mid-stream
+  (``--fault kill|truncate|corrupt|nan|xla``; default "kill" — death
+  between the chain.npy and bchain.npy replaces, the torn-checkpoint
+  window), recovered by the supervisor's retry/rollback machinery.
+- ``preempt``: a SIGTERM-style drain request mid-run stops the loop at
+  the next seam, flushes a verified checkpoint, and surfaces as the
+  supervisor's resumable ``preempted`` status; a second incarnation
+  resumes bit-identically.
+- ``stall``: a wedged dispatch trips the watchdog's EMA deadline, the
+  chunk is abandoned as the ``stall`` failure class, and the stall
+  retry budget resumes the run bit-identically (jax backend).
+- ``reshard``: a run checkpointed under an 8-device mesh resumes under
+  ``--devices`` (default 2) via ``integrity.reshard_restore`` and the
+  extended chain is bitwise-identical to the uninterrupted 8-device
+  baseline — the elasticity contract (jax backend, forces 8 virtual
+  host devices).
+
+Usage: python tools/chaos_probe.py [--scenario fault|preempt|stall|reshard]
+       [--fault kill|truncate|corrupt|nan|xla] [--niter N]
+       [--save-every N] [--at-row N] [--devices N] [--outdir DIR]
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
+import shutil
 import sys
+from pathlib import Path
 
 import numpy as np
 
@@ -68,30 +89,19 @@ FAULTS = {
 }
 
 
-def main():
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--fault", choices=sorted(FAULTS), default="kill")
-    ap.add_argument("--niter", type=int, default=60)
-    ap.add_argument("--save-every", type=int, default=20)
-    ap.add_argument("--at-row", type=int, default=None,
-                    help="inject at the first seam with row >= AT_ROW "
-                    "(default: niter // 2)")
-    ap.add_argument("--outdir", default="/tmp/chaos_probe")
-    args = ap.parse_args()
-    at_row = args.niter // 2 if args.at_row is None else args.at_row
+def _fresh(base: Path) -> Path:
+    if base.exists():
+        shutil.rmtree(base)
+    return base
 
-    import shutil
-    from pathlib import Path
 
+def scenario_fault(args, base):
     from pulsar_timing_gibbsspec_tpu.runtime import (
         faults, supervisor, telemetry)
     from pulsar_timing_gibbsspec_tpu.sampler.gibbs import PTABlockGibbs
 
     pta = build_pta()
     x0 = pta.initial_sample(np.random.default_rng(0))
-    base = Path(args.outdir)
-    if base.exists():
-        shutil.rmtree(base)
     ref_dir, run_dir = base / "baseline", base / "supervised"
 
     def gibbs():
@@ -103,7 +113,7 @@ def main():
     telemetry.reset()
     faults.clear()
     for spec in FAULTS[args.fault]:
-        faults.inject(at_row=at_row, times=1, **spec)
+        faults.inject(at_row=args.at_row, times=1, **spec)
     try:
         chain, rep = supervisor.run_supervised(
             gibbs(), x0, run_dir, niter=args.niter,
@@ -114,18 +124,193 @@ def main():
     bitwise = bool(np.array_equal(chain, ref))
     on_disk = bool(np.array_equal(np.load(run_dir / "chain.npy"),
                                   np.load(ref_dir / "chain.npy")))
-    report = {
+    return bitwise and on_disk, {
         "fault": args.fault,
-        "at_row": at_row,
-        "niter": args.niter,
         "bitwise_recovery": bitwise,
         "on_disk_bitwise": on_disk,
         "supervisor": rep.as_dict(),
-        "counters": telemetry.snapshot(),
     }
+
+
+def scenario_preempt(args, base):
+    """Drain-to-checkpoint, then a second incarnation resumes bitwise."""
+    from pulsar_timing_gibbsspec_tpu.runtime import (
+        faults, integrity, preemption, supervisor, telemetry)
+    from pulsar_timing_gibbsspec_tpu.sampler.gibbs import PTABlockGibbs
+
+    pta = build_pta()
+    x0 = pta.initial_sample(np.random.default_rng(0))
+    ref_dir, run_dir = base / "baseline", base / "supervised"
+
+    def gibbs():
+        return PTABlockGibbs(pta, backend="numpy", seed=7, progress=False)
+
+    ref = gibbs().sample(x0, outdir=ref_dir, niter=args.niter,
+                         save_every=args.save_every)
+
+    telemetry.reset()
+    faults.clear()
+    preemption.reset()
+    faults.inject("sigterm_at_seam", point="sample.loop",
+                  at_row=args.at_row, times=1, seconds=60.0)
+    try:
+        _, rep = supervisor.run_supervised(
+            gibbs(), x0, run_dir, niter=args.niter,
+            save_every=args.save_every, backoff_base=0.0, jitter=0.0)
+    finally:
+        faults.clear()
+    v = integrity.verify(run_dir)
+
+    # next incarnation: fresh process, drain flag gone
+    preemption.reset()
+    chain2, rep2 = supervisor.run_supervised(
+        gibbs(), x0, run_dir, niter=args.niter,
+        save_every=args.save_every, backoff_base=0.0, jitter=0.0)
+    bitwise = bool(np.array_equal(chain2, ref))
+    ok = (rep.status == "preempted" and v["ok"]
+          and rep2.status == "completed" and bitwise)
+    return ok, {
+        "drain_status": rep.status,
+        "drain_checkpoint": v,
+        "drain_latency_ms": telemetry.get_gauge("drain_latency_ms"),
+        "resume_status": rep2.status,
+        "bitwise_recovery": bitwise,
+        "supervisor": rep2.as_dict(),
+    }
+
+
+def scenario_stall(args, base):
+    """Watchdog abort of a wedged dispatch, then bitwise stall-retry."""
+    from pulsar_timing_gibbsspec_tpu.runtime import (
+        faults, supervisor, telemetry)
+    from pulsar_timing_gibbsspec_tpu.runtime.watchdog import DispatchWatchdog
+    from pulsar_timing_gibbsspec_tpu.sampler.gibbs import PTABlockGibbs
+
+    pta = build_pta()
+    x0 = pta.initial_sample(np.random.default_rng(0))
+    kw = dict(backend="jax", seed=7, progress=False, warmup_sweeps=2,
+              chunk_size=4)
+    ref = PTABlockGibbs(pta, **kw).sample(
+        x0, outdir=base / "baseline", niter=args.niter,
+        save_every=args.save_every)
+
+    telemetry.reset()
+    faults.clear()
+    faults.inject("stall", point="dispatch.chunk", at_row=args.at_row,
+                  times=1, seconds=5.0, backend="jax")
+    wd = DispatchWatchdog(k=4.0, floor_s=0.4, first_floor_s=120.0,
+                          poll_s=0.02)
+    try:
+        chain, rep = supervisor.run_supervised(
+            PTABlockGibbs(pta, watchdog=wd, **kw), x0,
+            base / "supervised", niter=args.niter,
+            save_every=args.save_every, backoff_base=0.0, jitter=0.0)
+    finally:
+        faults.clear()
+    bitwise = bool(np.array_equal(chain, ref))
+    ok = (bitwise and rep.status == "completed" and rep.stall_retries >= 1)
+    return ok, {
+        "bitwise_recovery": bitwise,
+        "stall_retries": rep.stall_retries,
+        "watchdog_stalls": telemetry.get("watchdog_stalls"),
+        "watchdog_dumps": telemetry.get("watchdog_dumps"),
+        "supervisor": rep.as_dict(),
+    }
+
+
+def scenario_reshard(args, base):
+    """8-device checkpoint resumed on --devices, bitwise vs baseline."""
+    from pulsar_timing_gibbsspec_tpu.parallel.sharding import make_mesh
+    from pulsar_timing_gibbsspec_tpu.runtime import integrity, telemetry
+    from pulsar_timing_gibbsspec_tpu.sampler.gibbs import PTABlockGibbs
+
+    pta = build_pta()
+    x0 = pta.initial_sample(np.random.default_rng(0))
+    kw = dict(backend="jax", seed=7, progress=False, warmup_sweeps=2,
+              chunk_size=4, pad_pulsars=8)
+    part = max(args.save_every, (args.niter // 2) // args.save_every
+               * args.save_every)
+
+    telemetry.reset()
+    ref = PTABlockGibbs(pta, mesh=make_mesh(8), **kw).sample(
+        x0, outdir=base / "baseline", niter=args.niter,
+        save_every=args.save_every)
+    src = base / "resharded"
+    PTABlockGibbs(pta, mesh=make_mesh(8), **kw).sample(
+        x0, outdir=src, niter=part, save_every=args.save_every)
+
+    g = integrity.reshard_restore(src, pta, devices=args.devices,
+                                  seed=7, progress=False,
+                                  warmup_sweeps=2, chunk_size=4)
+    chain = g.sample(x0, outdir=src, niter=args.niter, resume=True,
+                     save_every=args.save_every)
+    bitwise = bool(np.array_equal(chain, ref))
+    info = integrity.read_layout(src)
+    return bitwise, {
+        "bitwise_recovery": bitwise,
+        "checkpointed_rows": part,
+        "devices_from": 8,
+        "devices_to": args.devices,
+        "layout": info["layout"],
+        "shard_map": info["shard_map"],
+    }
+
+
+SCENARIOS = {"fault": scenario_fault, "preempt": scenario_preempt,
+             "stall": scenario_stall, "reshard": scenario_reshard}
+#: jax-backed scenarios run chunked; small defaults keep them quick
+_JAX_DEFAULTS = {"stall": (16, 4), "reshard": (16, 4)}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--scenario", choices=sorted(SCENARIOS),
+                    default="fault")
+    ap.add_argument("--fault", choices=sorted(FAULTS), default="kill",
+                    help="fault kind (scenario 'fault' only)")
+    ap.add_argument("--niter", type=int, default=None,
+                    help="default 60 (numpy scenarios) or 16 (jax)")
+    ap.add_argument("--save-every", type=int, default=None,
+                    help="default 20 (numpy scenarios) or 4 (jax)")
+    ap.add_argument("--at-row", type=int, default=None,
+                    help="inject at the first seam with row >= AT_ROW "
+                    "(default: niter // 2 rounded into the steady loop)")
+    ap.add_argument("--devices", type=int, default=2,
+                    help="resume device count (scenario 'reshard'); "
+                    "must divide the padded width of 8")
+    ap.add_argument("--outdir", default="/tmp/chaos_probe")
+    args = ap.parse_args()
+    dflt = _JAX_DEFAULTS.get(args.scenario, (60, 20))
+    args.niter = dflt[0] if args.niter is None else args.niter
+    args.save_every = dflt[1] if args.save_every is None else args.save_every
+    if args.at_row is None:
+        # land past the warmup/compile chunks for the jax scenarios
+        args.at_row = args.niter // 2 + (3 if args.scenario == "stall"
+                                         else 0)
+
+    if args.scenario == "reshard":
+        # must precede the first jax import: the contract drill needs 8
+        # virtual host devices to stand in for the 8-way mesh
+        os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                                   + " --xla_force_host_platform_"
+                                   "device_count=8").strip()
+
+    from pulsar_timing_gibbsspec_tpu.runtime import telemetry
+
+    base = _fresh(Path(args.outdir))
+    ok, detail = SCENARIOS[args.scenario](args, base)
+    report = {
+        "scenario": args.scenario,
+        "at_row": args.at_row,
+        "niter": args.niter,
+        "ok": bool(ok),
+        "counters": telemetry.snapshot(),
+        "gauges": telemetry.gauges(),
+    }
+    report.update(detail)
     print(json.dumps(report, indent=2))
-    if not (bitwise and on_disk):
-        print("FAIL: recovered chain differs from baseline",
+    if not ok:
+        print(f"FAIL: scenario '{args.scenario}' contract violated",
               file=sys.stderr)
         sys.exit(1)
 
