@@ -26,7 +26,7 @@ _REPO_ROOT = _PKG_ROOT.parent                      # holds the baseline
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="jaxlint",
-        description="AST-based JAX/TPU-discipline linter (rules R1-R6).")
+        description="AST-based JAX/TPU-discipline linter (rules R1-R7).")
     ap.add_argument("paths", nargs="*",
                     help="files or directories (default: the package)")
     ap.add_argument("--baseline", default=None,

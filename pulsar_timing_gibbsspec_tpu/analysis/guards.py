@@ -62,17 +62,55 @@ class RecompileCounter:
     ...     rc.reset()    # don't charge the warmup
     ...     f(x)          # steady state
     >>> rc.events         # 0 -> no retrace
-    """
+
+    Phase attribution (``bench.py``): :meth:`phase` names the window every
+    subsequent compile event is charged to, so warmup compiles land in
+    ``per_phase["warmup"]`` instead of polluting the steady-state count
+    the zero-retrace contract asserts.  Compile sites that are *expected*
+    — a chunk-function cache miss paying a fresh XLA compile for a
+    legitimate new (length, offset) chunk shape — bracket the triggering
+    dispatch in :func:`planned_compile`; :meth:`unplanned` subtracts
+    events fired inside such windows per phase, so
+    ``unplanned("steady") == 0`` is the honest contract even on runs
+    whose steady window legally compiles a trailing odd chunk.  (A
+    window, not a count: one jit build fires a variable number of
+    backend-compile events — measured 2-3 on CPU jax 0.4.x.)"""
 
     def __init__(self):
         self.events = 0
+        self.per_phase: dict = {}
+        self.planned_per_phase: dict = {}
+        self._phase = None
+        self._planned_depth = 0
 
     def _bump(self):
         self.events += 1
+        if self._phase is not None:
+            self.per_phase[self._phase] = \
+                self.per_phase.get(self._phase, 0) + 1
+            if self._planned_depth > 0:
+                self.planned_per_phase[self._phase] = \
+                    self.planned_per_phase.get(self._phase, 0) + 1
+
+    def phase(self, name):
+        """Start charging compile events (and planned-compile notes) to
+        ``name``; returns self so ``rc.phase("warmup")`` chains."""
+        self._phase = name
+        self.per_phase.setdefault(name, 0)
+        self.planned_per_phase.setdefault(name, 0)
+        return self
+
+    def unplanned(self, name) -> int:
+        """Compile events charged to phase ``name`` that fired outside
+        every :func:`planned_compile` window."""
+        return max(0, self.per_phase.get(name, 0)
+                   - self.planned_per_phase.get(name, 0))
 
     def reset(self):
-        """Zero the count (e.g. after the expected warmup compile)."""
+        """Zero all counts (e.g. after the expected warmup compile)."""
         self.events = 0
+        self.per_phase = {}
+        self.planned_per_phase = {}
 
     @property
     def retraced(self) -> bool:
@@ -100,6 +138,29 @@ def count_recompiles():
         yield rc
     finally:
         rc.detach()
+
+
+@contextlib.contextmanager
+def planned_compile():
+    """Mark every compile event fired inside the block as *planned* on
+    all attached counters (e.g. around the dispatch of a chunk function
+    whose cache lookup just missed).  Phase-scoped retrace contracts
+    (``unplanned("steady") == 0``) then don't charge legitimate
+    compiles.  No-op when nothing is attached.
+
+    The depth bump is process-global (events arrive on whatever thread
+    executes the dispatch — e.g. the watchdog worker), so only bracket
+    blocking regions that genuinely end with the compile done."""
+    with _lock:
+        bumped = list(_active_counters)
+        for c in bumped:
+            c._planned_depth += 1
+    try:
+        yield
+    finally:
+        with _lock:
+            for c in bumped:
+                c._planned_depth -= 1
 
 
 @contextlib.contextmanager

@@ -39,6 +39,13 @@ Rules
   function body: the attribute captures a tracer that outlives the trace.
 - **R6 debug-leftover** — ``jax.debug.print``/``jax.debug.breakpoint``/
   ``breakpoint()`` anywhere in library code.
+- **R7 host-sync-leak** — operations inside traced code that force the
+  tracer to a concrete host value, blocking dispatch (or raising a
+  ``TracerBoolConversionError``): ``bool(...)``/``int(...)`` on a
+  non-constant value, and ``if``/``while``/``assert``/``not`` applied
+  directly to a ``jnp.*`` call result (implicit ``__bool__`` — use
+  ``lax.cond``/``jnp.where`` instead).  Complements R2: R2 catches
+  host *NumPy* leaking in, R7 catches traced values leaking *out*.
 
 Suppression: a trailing ``# jaxlint: disable=R1`` (comma-separated rules,
 or ``all``) on the violation's first source line suppresses it.
@@ -64,6 +71,7 @@ RULES = {
     "R4": "retrace-hazard",
     "R5": "tracer-leak-self-assign",
     "R6": "debug-leftover",
+    "R7": "host-sync-leak",
 }
 
 _PRAGMA_RE = re.compile(r"#\s*jaxlint:\s*disable=([A-Za-z0-9,\s]+)")
@@ -489,6 +497,47 @@ def _scan_traced_subtree(root, mod: _Module, report):
                            "precision)")
 
 
+def _contains_jnp_call(node, mod: _Module) -> bool:
+    """Whether an expression's value comes (at least partly) straight
+    from a ``jnp.*`` call — the cheap syntactic proxy for "this is a
+    traced array, not host state"."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call) and _jnp_call_name(sub, mod):
+            return True
+    return False
+
+
+def _scan_r7(root, mod: _Module, report):
+    """Host-sync leaks in one traced subtree: explicit bool()/int()
+    coercions and implicit truthiness tests of jnp expressions."""
+    body = root.body if isinstance(root.body, list) else [root.body]
+    for stmt in body:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Name) and \
+                    node.func.id in ("bool", "int") and node.args and \
+                    not _is_const_expr(node.args[0], mod):
+                report(node, "R7",
+                       f"{node.func.id}(...) on a non-constant value "
+                       "inside traced code forces a host sync (or a "
+                       "TracerBoolConversionError under jit)")
+                continue
+            test = None
+            where = None
+            if isinstance(node, (ast.If, ast.While)):
+                test, where = node.test, type(node).__name__.lower()
+            elif isinstance(node, ast.Assert):
+                test, where = node.test, "assert"
+            elif isinstance(node, ast.UnaryOp) and \
+                    isinstance(node.op, ast.Not):
+                test, where = node.operand, "not"
+            if test is not None and _contains_jnp_call(test, mod):
+                report(node, "R7",
+                       f"implicit bool() of a jnp expression in "
+                       f"'{where}' inside traced code — a host sync "
+                       "point; branch with lax.cond/jnp.where instead")
+
+
 def _scan_r4(mod: _Module, report):
     """Retrace hazards, module-wide."""
     jitted: dict[str, bool] = {}   # call token -> has static argnums
@@ -574,9 +623,10 @@ def analyze_source(src: str, path: str = "<string>") -> list[Violation]:
         for d in mod.all_defs]
     for (body,) in scopes:
         _Rule1KeyScan(mod, report).run(body)
-    # R2/R3/R5 over traced subtrees
+    # R2/R3/R5/R7 over traced subtrees
     for root in mod.traced_roots():
         _scan_traced_subtree(root, mod, report)
+        _scan_r7(root, mod, report)
     _scan_r4(mod, report)
     _scan_r6(mod, report)
 

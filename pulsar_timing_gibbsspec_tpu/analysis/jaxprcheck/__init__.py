@@ -1,0 +1,40 @@
+"""jaxprcheck — jaxpr/HLO-level contract auditor (docs/LINTING.md).
+
+Where :mod:`..jaxlint` enforces JAX discipline at the Python-AST level,
+this package proves the contracts that live *below* the AST, by tracing
+the compiled sweep under abstract inputs (``jax.jit(fn).trace`` /
+``.lower()`` — zero device execution) and walking the ClosedJaxpr and
+lowered HLO against machine-readable contracts committed in
+``contracts/*.json``:
+
+- **C1** (:mod:`.hbm`) — peak-HBM estimate per device, sizing every
+  intermediate with the TPU tiling-pad heuristic calibrated against the
+  r4 measurement of the exact-Gram accumulation scratch, so the C=128
+  wall is rejected at lint time with the offending equation's source
+  location.
+- **C2** (:mod:`.collectives`) — collective census (count / kind /
+  payload elements of all-reduce / all-gather per sweep), ratcheted
+  byte-identical against the committed budget; absorbs the counting core
+  of ``parallel/sharding.collective_report``.
+- **C3** (:mod:`.dtypes`) — dtype-island audit: f64-accumulating
+  matmuls must lie inside declared exact-islands, the mixed steady path
+  must stay f32, and ``precision="highest"`` einsums are verified.
+- **C4** (:mod:`.keys`) — PRNG key lineage: dataflow over ``random_*``
+  primitives proving each key is consumed at most once and fold_in
+  chains match the checkpoint key-fold policy
+  ``fold_in(fold_in(base_key, iteration), chain)``.
+- **C5** (:mod:`.donation`) — chunk carry buffers declared donated are
+  verified actually aliased in the lowering.
+
+CLI: ``python -m pulsar_timing_gibbsspec_tpu.analysis.jaxprcheck`` (also
+``tools/jaxprcheck.py`` and the ``jaxprcheck`` console script), with a
+``jaxprcheck_baseline.json`` ratchet in the jaxlint style.  The traced
+programs come from the stable entry points exported by
+``sampler/jax_backend.py`` (``gram_trace_entry``, ``sweep_chunk_entry``,
+``sharded_sweep_step``) so kernel refactors update their audit surface
+in the same diff.
+"""
+
+from .collectives import census_from_hlo  # noqa: F401  (import-light)
+
+__all__ = ["census_from_hlo"]

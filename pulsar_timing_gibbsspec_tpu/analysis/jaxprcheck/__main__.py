@@ -1,0 +1,105 @@
+"""CLI: audit the committed contracts, ratchet against the baseline.
+
+Usage::
+
+    python -m pulsar_timing_gibbsspec_tpu.analysis.jaxprcheck [opts]
+
+    --fast             only contracts marked "fast": true (the CI/lint
+                       subset — traces small entries on CPU in seconds)
+    --contracts DIR    contract directory (default <repo>/contracts)
+    --json             machine-readable facts + violations on stdout
+    --baseline PATH    ratchet file (default <repo>/jaxprcheck_baseline.json)
+    --no-baseline      report every violation, ignore the ratchet
+    --write-baseline   accept current violations as the new baseline
+
+Exit status 1 when violations beyond the baseline exist (or any at all
+with ``--no-baseline``).  Everything here is host-side tracing and AOT
+lowering on the CPU backend — nothing executes on a device, so the
+audit is safe in CI and on login nodes.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from pathlib import Path
+
+_REPO_ROOT = Path(__file__).resolve().parents[3]
+
+
+def _bootstrap_cpu():
+    """Force the CPU backend with enough host devices for the sharded
+    entries, before any backend initializes."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="jaxprcheck",
+        description="jaxpr/HLO-level contract auditor (HBM, collectives, "
+                    "dtypes, key lineage, donation) — static, no device")
+    ap.add_argument("--fast", action="store_true",
+                    help="only contracts marked fast")
+    ap.add_argument("--contracts", default=None, metavar="DIR")
+    ap.add_argument("--json", action="store_true", dest="as_json")
+    ap.add_argument("--baseline",
+                    default=str(_REPO_ROOT / "jaxprcheck_baseline.json"))
+    ap.add_argument("--no-baseline", action="store_true")
+    ap.add_argument("--write-baseline", action="store_true")
+    args = ap.parse_args(argv)
+
+    _bootstrap_cpu()
+
+    from ..baseline import (compare_to_baseline, load_baseline,
+                            write_baseline)
+    from .runner import discover_contracts, run_contracts
+
+    contracts = discover_contracts(args.contracts, fast_only=args.fast)
+    if not contracts:
+        print("jaxprcheck: no contracts found", file=sys.stderr)
+        return 2
+    violations, facts = run_contracts(contracts)
+
+    if args.write_baseline:
+        write_baseline(args.baseline, violations, _REPO_ROOT)
+        print(f"jaxprcheck: baseline written to {args.baseline} "
+              f"({len(violations)} violation(s))")
+        return 0
+
+    if args.no_baseline:
+        new, stale = list(violations), []
+    else:
+        new, stale = compare_to_baseline(
+            violations, load_baseline(args.baseline), _REPO_ROOT)
+
+    if args.as_json:
+        print(json.dumps(
+            {"contracts": [c.get("name") for c in contracts],
+             "facts": facts,
+             "violations": [
+                 {"path": v.path, "rule": v.rule, "message": v.message}
+                 for v in violations],
+             "new": len(new)}, indent=2, sort_keys=True))
+    else:
+        for v in new:
+            print(str(v))
+        for f, rule, base, cur in stale:
+            print(f"stale baseline entry: {f} [{rule}] baseline {base} "
+                  f"> current {cur}; ratchet the baseline down")
+        ok = "OK" if not new else "FAIL"
+        print(f"jaxprcheck: {len(contracts)} contract(s), "
+              f"{len(violations)} violation(s), {len(new)} new — {ok}")
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

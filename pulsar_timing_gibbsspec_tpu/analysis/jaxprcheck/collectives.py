@@ -1,0 +1,73 @@
+"""C2 — collective census from optimized HLO text.
+
+This module is the counting core absorbed from
+``parallel/sharding.collective_report`` (which now delegates here): the
+regexes are kept verbatim so the census stays byte-comparable with the
+MULTICHIP_r*.json trajectory.  Import-light on purpose — pure ``re`` +
+``numpy`` — so the census can run over committed HLO snapshots without
+touching jax.
+
+Known environment sensitivity (and the reason the census is a
+*committed contract*, not a constant): the r05 artifact measured
+``{'all-reduce': 5, 'all-gather': 3}`` under the bench container's XLA
+build; the current container's XLA partitions the red-conditional
+gumbel draw's u32 random bits with one extra partial-bits all-reduce,
+measuring ``{'all-reduce': 6, 'all-gather': 3}`` on byte-identical
+source.  The contract pins what the current toolchain emits; any drift
+— program OR toolchain — fails the gate and forces a deliberate
+re-commit.
+"""
+
+from __future__ import annotations
+
+import re
+
+import numpy as np
+
+
+def census_from_hlo(hlo: str) -> dict:
+    """Count all-reduce / all-gather ops and list each gather's operand
+    element count — verbatim the counting rules of the pre-absorption
+    ``collective_report``."""
+    counts = {"all-reduce": len(re.findall(r"\ball-reduce(?:-start)?\(",
+                                           hlo)),
+              "all-gather": len(re.findall(r"\ball-gather(?:-start)?\(",
+                                           hlo))}
+    elems = []
+    for m in re.finditer(r"all-gather(?:-start)?\(", hlo):
+        # operand shape precedes the op name on the defining line:
+        #   %x = f32[6,17]{...} all-gather(...)
+        line = hlo[hlo.rfind("\n", 0, m.start()) + 1:m.start()]
+        sm = re.search(r"\[([0-9,]*)\]", line)
+        if sm:
+            dims = [int(v) for v in sm.group(1).split(",") if v]
+            elems.append(int(np.prod(dims)) if dims else 1)
+    counts["gather_elems"] = sorted(elems)
+    return counts
+
+
+def check_gather_budget(counts: dict, max_gather_elems):
+    """None, or the over-budget message ``collective_report`` raises —
+    the guard that keeps "shard the pulsar axis, replicate x" honest."""
+    if max_gather_elems is None:
+        return None
+    too_big = [e for e in counts.get("gather_elems", [])
+               if e > max_gather_elems]
+    if not too_big:
+        return None
+    return (f"all-gather operand(s) of {too_big} elements exceed the "
+            f"{max_gather_elems}-element budget — a basis-sized array "
+            "is crossing the mesh")
+
+
+def census(fn, *example_args, max_gather_elems=None) -> dict:
+    """Lower + compile ``fn`` (host-side AOT only — nothing executes on
+    a device) and census the optimized HLO."""
+    import jax
+
+    hlo = jax.jit(fn).lower(*example_args).compile().as_text()
+    counts = census_from_hlo(hlo)
+    msg = check_gather_budget(counts, max_gather_elems)
+    if msg is not None:
+        raise RuntimeError(msg)
+    return counts

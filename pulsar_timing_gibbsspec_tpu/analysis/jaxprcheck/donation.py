@@ -1,0 +1,45 @@
+"""C5 — donation/aliasing verification from lowered StableHLO.
+
+The driver donates the chunk carries (x, b) so the steady loop runs
+in-place on device; if a refactor breaks donation XLA silently doubles
+the carry footprint and only a profile would notice.  Donation that
+*takes* is visible statically: every argument XLA accepted for
+donation carries a ``tf.aliasing_output`` attribute on the lowered
+module's entry function.  This check lowers with the declared
+``donate_argnums`` and verifies the aliases actually materialized.
+"""
+
+from __future__ import annotations
+
+import re
+
+
+def aliased_outputs(hlo_text: str) -> list:
+    """Output indices aliased to donated inputs, parsed from the
+    ``tf.aliasing_output = N : i32`` argument attributes of the lowered
+    module (StableHLO or HLO text)."""
+    return sorted(
+        int(m.group(1))
+        for m in re.finditer(r"tf\.aliasing_output\s*=\s*(\d+)", hlo_text))
+
+
+def audit_donation(fn, example_args, donate_argnums):
+    """Lower ``fn`` with ``donate_argnums`` (host-side only, nothing
+    executes) and return ``(aliased_output_indices, hlo_text)``."""
+    import jax
+
+    low = jax.jit(fn, donate_argnums=tuple(donate_argnums)).lower(
+        *example_args)
+    text = low.as_text()
+    return aliased_outputs(text), text
+
+
+def check_aliasing(aliased: list, min_aliased: int):
+    """None when enough donated inputs aliased; otherwise the violation
+    message."""
+    if len(aliased) >= int(min_aliased):
+        return None
+    return (f"only {len(aliased)} donated argument(s) aliased to outputs "
+            f"(contract requires >= {min_aliased}) — a donated carry is "
+            "being copied instead of reused; check for dtype/layout "
+            "mismatches between the donated input and its output")

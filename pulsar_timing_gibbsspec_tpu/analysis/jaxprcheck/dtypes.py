@@ -1,0 +1,88 @@
+"""C3 — dtype-island audit over the traced jaxpr.
+
+The sampler's precision policy (README, docs/PERFORMANCE.md) is a
+*placement* policy: f64 belongs to declared exact-islands (the
+f64-accumulated Gram, the oracle/exact bodies, the factorizations they
+feed) while the steady mixed path stays f32, and the f32 MXU einsums
+that replace f64 accumulation must carry ``precision="highest"``.
+
+The audit focuses on matmul-class equations (``dot_general``) — the
+ops where a dtype regression costs 60x (VPU-emulated f64) or silently
+drops accuracy (default-precision MXU f32).  Each dot is attributed to
+the user function that emitted it (``source_of``); the island
+declaration is a list of function names per class:
+
+- ``exact_fns``: functions allowed to emit f64-accumulating dots; an
+  f64 dot sourced anywhere else is a violation (f64 leaked into the
+  steady path).
+- ``highest_fns``: functions whose f32 dots must carry
+  ``precision=HIGHEST`` on both operands (e.g. the segmented Gram);
+  a default-precision dot there is a violation.
+
+A per-program census ``{(out_dtype): count}`` of dots is also returned
+so contracts can ratchet the dtype mix byte-identically.
+"""
+
+from __future__ import annotations
+
+import os
+
+from .walk import iter_eqns, source_of
+
+
+def _dots(closed_jaxpr):
+    for eqn, _depth in iter_eqns(closed_jaxpr.jaxpr):
+        if eqn.primitive.name == "dot_general":
+            yield eqn
+
+
+def _out_dtype(eqn):
+    return str(eqn.outvars[0].aval.dtype)
+
+
+def _is_highest(precision) -> bool:
+    if precision is None:
+        return False
+    if isinstance(precision, (tuple, list)):
+        return all(_is_highest(p) for p in precision)
+    return "HIGHEST" in str(precision).upper()
+
+
+def dot_census(closed_jaxpr) -> dict:
+    """``{out_dtype: count}`` over every dot_general in the program."""
+    out: dict = {}
+    for eqn in _dots(closed_jaxpr):
+        k = _out_dtype(eqn)
+        out[k] = out.get(k, 0) + 1
+    return dict(sorted(out.items()))
+
+
+def _in_island(fn, fname, islands) -> bool:
+    """An island entry matches a function name (``tnt_d``), a file
+    basename (``linalg.py`` — whole-module island, e.g. the repo's f64
+    exact-solve library), or ``basename:function``."""
+    base = os.path.basename(fname)
+    return fn in islands or base in islands or f"{base}:{fn}" in islands
+
+
+def audit_dtypes(closed_jaxpr, exact_fns=(), highest_fns=()):
+    """Return ``(violations, census)``; each violation is a string
+    carrying the op, its dtypes, and the source location."""
+    exact_fns = set(exact_fns)
+    highest_fns = set(highest_fns)
+    violations = []
+    for eqn in _dots(closed_jaxpr):
+        f, ln, fn = source_of(eqn)
+        loc = f"{fn} at {os.path.basename(f)}:{ln}"
+        odt = _out_dtype(eqn)
+        if odt == "float64" and not _in_island(fn, f, exact_fns):
+            violations.append(
+                f"f64-accumulating dot_general outside every declared "
+                f"exact-island: {loc} (islands: {sorted(exact_fns)})")
+        if _in_island(fn, f, highest_fns) and odt != "float64" \
+                and not _is_highest(eqn.params.get("precision")):
+            violations.append(
+                f"dot_general in {loc} must carry precision=HIGHEST "
+                f"(got {eqn.params.get('precision')!r}) — the f32 MXU "
+                "einsum policy for exact-accumulation replacements")
+    return violations, dot_census(closed_jaxpr)

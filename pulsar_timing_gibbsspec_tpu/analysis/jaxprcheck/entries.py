@@ -1,0 +1,136 @@
+"""Declared trace entry points for contract audits.
+
+A contract names an *entry* — a function + abstract example arguments
+— and jaxprcheck traces it on the CPU backend, no device execution.
+Entries are built from synthetic pulsars (no file IO) so the audit is
+reproducible anywhere; the bench-scale gram entry (45 pulsars, 720
+TOAs, 17 timing-model columns) reproduces the r4 exact-Gram geometry
+whose accumulation scratch is the C=128 HBM wall.
+
+Entry kinds (the ``entry`` field of a contract):
+
+- ``gram`` — the vmapped exact b-draw alone
+  (:func:`..sampler.jax_backend.gram_trace_entry`): the C1 calibration
+  target.
+- ``chunk`` — a full compiled sweep chunk through the driver
+  (:func:`..sampler.jax_backend.sweep_chunk_entry`): key lineage,
+  dtype islands, donation.
+- ``sharded_step`` — one CRN sweep step under pulsar-axis sharding on
+  a host-device mesh (mirrors the MULTICHIP dry-run): the C2 census
+  target.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def synthetic_pulsars(n_psr, ntoa, tm_cols=3, seed=0):
+    """Self-contained synthetic pulsars; ``tm_cols`` polynomial
+    timing-model columns (the bench dataset has 17-wide design
+    matrices, the quick entries keep 3)."""
+    from ...data.dataset import Pulsar
+
+    rng = np.random.default_rng(seed)
+    out = []
+    for ii in range(int(n_psr)):
+        span = 10.0 * 365.25 * 86400.0
+        toas = np.sort(rng.uniform(0.0, span, ntoa)) + 53000.0 * 86400.0
+        t = (toas - toas.mean()) / span
+        M = np.column_stack([t ** k for k in range(int(tm_cols))])
+        th = rng.uniform(0, np.pi)
+        ph = rng.uniform(0, 2 * np.pi)
+        out.append(Pulsar(
+            name=f"FAKE{ii:02d}",
+            toas=toas, toaerrs=np.full(ntoa, 1e-6),
+            residuals=1e-7 * rng.standard_normal(ntoa),
+            freqs=np.full(ntoa, 1400.0),
+            backend_flags=np.asarray(["sim"] * ntoa, dtype=object),
+            Mmat=M, fitpars=[f"TM{k}" for k in range(int(tm_cols))],
+            pos=np.array([np.sin(th) * np.cos(ph),
+                          np.sin(th) * np.sin(ph), np.cos(th)]),
+        ))
+    return out
+
+
+def build_model(psrs, nmodes, red=True):
+    """The CRN free-spectrum model the MULTICHIP/bench entries audit."""
+    from ...models.factory import model_general
+
+    return model_general(
+        psrs, tm_svd=True, white_vary=True,
+        common_psd="spectrum", common_components=int(nmodes),
+        red_var=red, red_psd="spectrum", red_components=int(nmodes))
+
+
+def _gram_entry(spec):
+    from ...sampler import jax_backend as jb
+    from ...sampler.compiled import compile_pta
+
+    psrs = synthetic_pulsars(spec.get("n_psr", 45), spec.get("ntoa", 720),
+                             tm_cols=spec.get("tm_cols", 17),
+                             seed=spec.get("seed", 0))
+    pta = build_model(psrs, spec.get("nmodes", 10))
+    cm = compile_pta(pta)
+    fn, args = jb.gram_trace_entry(cm, spec.get("nchains", 64))
+    return fn, args, {}
+
+
+def _chunk_entry(spec):
+    from ...sampler import jax_backend as jb
+
+    psrs = synthetic_pulsars(spec.get("n_psr", 3), spec.get("ntoa", 40),
+                             tm_cols=spec.get("tm_cols", 3),
+                             seed=spec.get("seed", 0))
+    pta = build_model(psrs, spec.get("nmodes", 3))
+    fn, args, drv = jb.sweep_chunk_entry(
+        pta, spec.get("nchains", 4), chunk=spec.get("chunk", 2),
+        pad_pulsars=spec.get("pad_pulsars"), seed=spec.get("seed", 0))
+    return fn, args, {"driver": drv}
+
+
+def _sharded_step_entry(spec):
+    """Mirror of the MULTICHIP dry-run step: pad + shard the compiled
+    model over a 1-d host-device mesh, trace one CRN sweep step."""
+    import jax.numpy as jnp
+    import jax.random as jr
+
+    from ...parallel.sharding import make_mesh, shard_compiled
+    from ...sampler import jax_backend as jb
+    from ...sampler.compiled import compile_pta
+
+    n_dev = int(spec.get("devices", 8))
+    psrs = synthetic_pulsars(spec.get("n_psr", 15), spec.get("ntoa", 24),
+                             tm_cols=spec.get("tm_cols", 3),
+                             seed=spec.get("seed", 0))
+    pta = build_model(psrs, spec.get("nmodes", 3))
+    pad = spec.get("pad_pulsars", -(-len(psrs) // n_dev) * n_dev)
+    cm = compile_pta(pta, pad_pulsars=pad)
+    cm = shard_compiled(cm, make_mesh(n_dev))
+
+    # CompiledPTA rides as a jit ARGUMENT: closure-captured jax.Arrays
+    # lower as replicated constants and GSPMD drops their shardings
+    # (zero collectives — the dry-run measured it); only argument
+    # shardings reach the partitioner
+    def step(cm_, x, b, key):
+        return jb.sharded_sweep_step(cm_, x, b, key)
+
+    x0 = jnp.asarray(pta.initial_sample(np.random.default_rng(0)),
+                     cm.cdtype)
+    b0 = jnp.zeros((cm.P, cm.Bmax), cm.cdtype)
+    return step, (cm, x0, b0, jr.key(0)), {}
+
+
+_ENTRIES = {"gram": _gram_entry, "chunk": _chunk_entry,
+            "sharded_step": _sharded_step_entry}
+
+
+def resolve_entry(spec: dict):
+    """``(fn, example_args, extras)`` for a contract's entry spec.
+    ``extras`` may carry the live driver (``chunk``) for donation
+    checks."""
+    kind = spec.get("entry")
+    if kind not in _ENTRIES:
+        raise KeyError(
+            f"unknown entry {kind!r}; known: {sorted(_ENTRIES)}")
+    return _ENTRIES[kind](spec)

@@ -1,0 +1,155 @@
+"""C1 — peak-HBM estimate from the jaxpr, with the calibrated
+accumulation-scratch model.
+
+The estimate is a *compile-time sizing heuristic*, not a liveness
+simulation: resident bytes are modeled as
+
+    base (tile-padded consts + arguments, always live)
+  + max over equations of (inputs + outputs + scratch)
+
+which tracks XLA's behavior on this program class because the sweep's
+intermediates are dominated by one huge term — the exact-Gram
+``dot_general`` whose wider-than-operand accumulation
+(``preferred_element_type=f64`` over f32 operands) makes XLA
+materialize a segmented operand copy.  The scratch model is calibrated
+against the r4 measurement (README / ROADMAP item 1): an
+``(nseg, C, P, Nmax, B1)`` copy with ``nseg = ceil(N_contract /
+GRAM_SEG_LEN)`` segments, tile-padded — which reproduces the measured
+3.4x pad ratio and 15.8 GiB at C=128 to <1%.  Because it is a
+calibrated heuristic, contracts that assert "passes" carry an expected
+estimate plus a relative tolerance, so silent drift of the *model* is
+caught the same way drift of the *program* is.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import os
+
+from .walk import aval_bytes, iter_eqns, source_of, tile_padded_bytes
+
+#: segment length of the scratch model — must track
+#: ``sampler.jax_backend.GRAM_SEG_LEN`` (imported lazily to keep this
+#: module jax-free until audit time)
+DEFAULT_SEG_LEN = 96
+
+GiB = float(1 << 30)
+
+
+@dataclasses.dataclass
+class Scratch:
+    """One modeled accumulation scratch (the C=128 wall's shape)."""
+
+    shape: tuple          # (nseg,) + operand shape
+    bytes: int            # tile-padded
+    raw_bytes: int        # unpadded element bytes (pad ratio denominator)
+    source: tuple         # (file, line, function)
+
+    @property
+    def pad_ratio(self) -> float:
+        return self.bytes / max(1, self.raw_bytes)
+
+    def describe(self) -> str:
+        f, ln, fn = self.source
+        return (f"accumulation scratch {self.shape} "
+                f"({self.bytes / GiB:.2f} GiB tile-padded, "
+                f"{self.pad_ratio:.2f}x pad) from {fn} "
+                f"at {os.path.basename(f)}:{ln}")
+
+
+@dataclasses.dataclass
+class HbmReport:
+    base_bytes: int
+    peak_eqn_bytes: int
+    peak_eqn: tuple | None       # (primitive name, source triple)
+    scratches: list
+
+    @property
+    def estimate_bytes(self) -> int:
+        return self.base_bytes + self.peak_eqn_bytes
+
+    @property
+    def largest_scratch(self):
+        return max(self.scratches, key=lambda s: s.bytes, default=None)
+
+
+def _npdtype_size(dtype) -> int:
+    import numpy as np
+
+    return int(np.dtype(dtype).itemsize)
+
+
+def _scratch_for(eqn, seg_len):
+    """The calibrated scratch rule: a ``dot_general`` accumulating into
+    a type wider than its operands forces a segmented operand copy."""
+    if eqn.primitive.name != "dot_general":
+        return None
+    pet = eqn.params.get("preferred_element_type")
+    if pet is None:
+        return None
+    avals = [v.aval for v in eqn.invars if hasattr(v, "aval")]
+    if len(avals) < 2:
+        return None
+    la, ra = avals[0], avals[1]
+    op_size = max(_npdtype_size(la.dtype), _npdtype_size(ra.dtype))
+    if _npdtype_size(pet) <= op_size:
+        return None
+    (lc, _rc), _ = eqn.params["dimension_numbers"]
+    n_contract = 1
+    for d in lc:
+        n_contract *= int(la.shape[d])
+    nseg = max(1, math.ceil(n_contract / int(seg_len)))
+    big = la if math.prod(la.shape) >= math.prod(ra.shape) else ra
+    padded = tile_padded_bytes(big.shape, big.dtype)
+    raw = math.prod(big.shape) * _npdtype_size(big.dtype)
+    return Scratch(shape=(nseg,) + tuple(int(s) for s in big.shape),
+                   bytes=nseg * padded, raw_bytes=nseg * raw,
+                   source=source_of(eqn))
+
+
+def audit_hbm(closed_jaxpr, seg_len=DEFAULT_SEG_LEN) -> HbmReport:
+    """Size every equation of ``closed_jaxpr`` (recursing through call
+    primitives) and return the peak-HBM report."""
+    jaxpr = closed_jaxpr.jaxpr
+    base = sum(aval_bytes(getattr(v, "aval", None))
+               for v in (*jaxpr.constvars, *jaxpr.invars))
+    base += sum(tile_padded_bytes(getattr(c, "shape", ()),
+                                  getattr(c, "dtype", "float32"))
+                for c in closed_jaxpr.consts
+                if hasattr(c, "shape") and hasattr(c, "dtype"))
+    peak, peak_eqn, scratches = 0, None, []
+    for eqn, _depth in iter_eqns(jaxpr):
+        foot = 0
+        for v in (*eqn.invars, *eqn.outvars):
+            aval = getattr(v, "aval", None)
+            if aval is not None:
+                foot += aval_bytes(aval)
+        sc = _scratch_for(eqn, seg_len)
+        if sc is not None:
+            scratches.append(sc)
+            foot += sc.bytes
+        if foot > peak:
+            peak = foot
+            peak_eqn = (eqn.primitive.name, source_of(eqn))
+    return HbmReport(base_bytes=int(base), peak_eqn_bytes=int(peak),
+                     peak_eqn=peak_eqn, scratches=scratches)
+
+
+def check_budget(report: HbmReport, budget_bytes: int):
+    """None when the estimate fits; otherwise the violation message —
+    always naming the dominant accumulation scratch, because that is
+    the actionable term (segment it, shrink C, or shard chains)."""
+    est = report.estimate_bytes
+    if est <= int(budget_bytes):
+        return None
+    msg = (f"peak-HBM estimate {est / GiB:.2f} GiB exceeds the "
+           f"{budget_bytes / GiB:.2f} GiB per-device budget")
+    sc = report.largest_scratch
+    if sc is not None:
+        msg += f": {sc.describe()}"
+    elif report.peak_eqn is not None:
+        prim, (f, ln, fn) = report.peak_eqn
+        msg += (f": dominant equation {prim} in {fn} "
+                f"at {os.path.basename(f)}:{ln}")
+    return msg

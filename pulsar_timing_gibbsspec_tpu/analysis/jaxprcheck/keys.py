@@ -1,0 +1,309 @@
+"""C4 — PRNG key lineage over the traced jaxpr.
+
+A dataflow machine over the typed-key primitives (``random_seed`` /
+``random_wrap`` roots, ``random_fold_in`` derivation, ``random_split``
+fan-out, ``random_bits`` consumption) proving the two properties the
+sampler's reproducibility story rests on:
+
+- **single consumption** — no key variable is split or drawn from more
+  than once (the classic key-reuse bug jaxlint's R1 can only catch at
+  the AST level; here it is proved on the actual dataflow, through
+  vmap batching, pjit, scan and cond);
+- **fold policy** — every ``random_split`` happens at the declared
+  fold depth, so the chunk's per-(iteration, chain) streams really are
+  ``fold_in(fold_in(base_key, iteration), chain)`` (the checkpoint
+  key-fold policy recorded in the layout manifest — PR 4).
+
+Per-variable lineage state is ``("pre", n_folds)`` for keys on the
+fold chain (root keys enter at depth 0) and ``("post",)`` for keys
+produced by a split.  Consumption counts flow through call primitives:
+a key consumed inside a pjit/scan body charges the outer variable.
+Loop bodies are modeled as running once per iteration: a key entering
+a scan/while body as a loop *constant* and consumed inside is consumed
+every iteration (flagged — only fold_in derivation is legal there),
+and a carry key passed through unchanged after being consumed inside
+is cross-iteration reuse (flagged).  Cond branches are mutually
+exclusive, so cross-branch consumption charges the max, not the sum.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+from .walk import source_of, subjaxprs
+
+#: primitives that merely reshape/route key arrays — lineage passes
+#: through unchanged
+_PASSTHROUGH = {
+    "broadcast_in_dim", "reshape", "squeeze", "slice", "dynamic_slice",
+    "gather", "concatenate", "transpose", "select_n", "rev", "copy",
+    "convert_element_type", "expand_dims", "device_put",
+}
+
+
+def _is_key_aval(aval) -> bool:
+    import jax
+
+    dtype = getattr(aval, "dtype", None)
+    if dtype is None:
+        return False
+    try:
+        return jax.dtypes.issubdtype(dtype, jax.dtypes.prng_key)
+    except Exception:
+        return False
+
+
+def _is_var(v) -> bool:
+    """True for trackable jaxpr Vars (Literals are unhashable and carry
+    no lineage)."""
+    import jax
+
+    return isinstance(v, jax.core.Var)
+
+
+@dataclasses.dataclass
+class KeyReport:
+    violations: list
+    n_roots: int = 0
+    n_in_trace_roots: int = 0       # random_seed/random_wrap inside trace
+    n_splits: int = 0
+    n_bits: int = 0
+    n_folds: int = 0
+    fold_depths_at_split: list = dataclasses.field(default_factory=list)
+    pre_split_consumes: int = 0     # random_bits straight off a fold chain
+
+
+class _Walker:
+    def __init__(self, report: KeyReport):
+        self.r = report
+
+    def walk(self, jaxpr, state, consumed):
+        """``state``: var -> lineage tuple; ``consumed``: var -> count.
+        Mutates both; returns the state of the jaxpr's outvars."""
+        for eqn in jaxpr.eqns:
+            self._eqn(eqn, state, consumed)
+        return [state.get(v) for v in jaxpr.outvars]
+
+    # -- helpers ----------------------------------------------------------
+    def _in_state(self, eqn, state):
+        for v in eqn.invars:
+            if _is_var(v) and v in state:
+                return state[v]
+        for v in eqn.invars:
+            aval = getattr(v, "aval", None)
+            if aval is not None and _is_key_aval(aval):
+                return ("pre", 0)       # untracked key: treat as root
+        return None
+
+    def _consume(self, eqn, state, consumed, what):
+        for v in eqn.invars:
+            aval = getattr(v, "aval", None)
+            if not _is_var(v) or aval is None or not _is_key_aval(aval):
+                continue
+            consumed[v] = consumed.get(v, 0) + 1
+            if consumed[v] > 1:
+                f, ln, fn = source_of(eqn)
+                self.r.violations.append(
+                    f"key consumed more than once: {what} in {fn} at "
+                    f"{os.path.basename(f)}:{ln} re-uses a key variable "
+                    f"already split/drawn from ({consumed[v]} uses)")
+
+    # -- the machine ------------------------------------------------------
+    def _eqn(self, eqn, state, consumed):
+        name = eqn.primitive.name
+        if name in ("random_seed", "random_wrap"):
+            self.r.n_in_trace_roots += 1
+            for o in eqn.outvars:
+                state[o] = ("pre", 0)
+            return
+        if name == "random_fold_in":
+            self.r.n_folds += 1
+            st = self._in_state(eqn, state) or ("pre", 0)
+            depth = st[1] + 1 if st[0] == "pre" else 1
+            for o in eqn.outvars:
+                state[o] = ("pre", depth)
+            return
+        if name == "random_split":
+            self.r.n_splits += 1
+            st = self._in_state(eqn, state) or ("pre", 0)
+            if st[0] == "pre":
+                self.r.fold_depths_at_split.append(st[1])
+            self._consume(eqn, state, consumed, "random_split")
+            for o in eqn.outvars:
+                state[o] = ("post",)
+            return
+        if name == "random_bits":
+            self.r.n_bits += 1
+            st = self._in_state(eqn, state)
+            if st is not None and st[0] == "pre":
+                self.r.pre_split_consumes += 1
+            self._consume(eqn, state, consumed, "random_bits")
+            return
+        if name in _PASSTHROUGH:
+            st = self._in_state(eqn, state)
+            if st is not None:
+                for o in eqn.outvars:
+                    if _is_key_aval(getattr(o, "aval", None)):
+                        state[o] = st
+            return
+        subs = subjaxprs(eqn)
+        if subs:
+            self._call(eqn, subs, state, consumed)
+            return
+        # any other primitive taking a key input: opaque sink — count a
+        # consumption so a stray key use can't hide
+        if any(_is_key_aval(getattr(v, "aval", None)) for v in eqn.invars):
+            self._consume(eqn, state, consumed, name)
+
+    def _call(self, eqn, subs, state, consumed):
+        name = eqn.primitive.name
+        out_states = None
+        # cond branches are mutually exclusive — only one executes, so
+        # an outer key consumed in several branches is still consumed
+        # once; charge the max across branches, not the sum
+        exclusive = name == "cond"
+        branch_charges: dict = {}
+        for sub in subs:
+            sub_state, sub_consumed = {}, {}
+            outer_of = {}
+            # map outer args onto the body's trailing invars: every call
+            # convention here aligns 1:1 from the tail (pjit is exactly
+            # 1:1; scan's eqn.invars = consts + carry + xs match body
+            # invars = consts + carry + x-slices; cond prepends only the
+            # predicate; while prepends cond-consts the body never sees)
+            inv = sub.invars
+            args = list(eqn.invars)
+            for bv, ov in zip(reversed(inv), reversed(args)):
+                if not _is_var(ov):
+                    continue
+                if ov in state:
+                    sub_state[bv] = state[ov]
+                outer_of[bv] = ov
+            outs = self.walk(sub, sub_state, sub_consumed)
+            # charge body consumption back to the outer variables, so a
+            # key used here AND elsewhere outside still trips the
+            # single-consumption rule
+            for bv, n in sub_consumed.items():
+                ov = outer_of.get(bv)
+                if ov is None or n <= 0:
+                    continue
+                if exclusive:
+                    branch_charges[ov] = max(branch_charges.get(ov, 0), n)
+                    continue
+                consumed[ov] = consumed.get(ov, 0) + n
+                if consumed[ov] > 1:
+                    f, ln, fn = source_of(eqn)
+                    self.r.violations.append(
+                        f"key consumed more than once across a "
+                        f"{name} boundary in {fn} at "
+                        f"{os.path.basename(f)}:{ln}")
+            # loop bodies run once per iteration: a key that enters as a
+            # loop CONSTANT and is consumed inside is consumed every
+            # iteration (only fold_in-then-split derivation is legal
+            # there), and a carry key returned unchanged after being
+            # consumed is cross-iteration reuse
+            if name == "scan":
+                nc = int(eqn.params.get("num_consts", 0))
+                ncar = int(eqn.params.get("num_carry", 0))
+                self._loop_reuse(eqn, name, inv[:nc], sub_consumed)
+                self._carry_reuse(eqn, name, inv[nc:nc + ncar],
+                                  sub.outvars[:ncar], sub_consumed)
+            elif name == "while" and len(sub.outvars) == len(inv):
+                self._carry_reuse(eqn, name, inv, sub.outvars,
+                                  sub_consumed)
+            if out_states is None:
+                out_states = outs
+            else:
+                # cond branches: "post" dominates, else deeper fold
+                merged = []
+                for a, b in zip(out_states, outs):
+                    if a == b:
+                        merged.append(a)
+                    elif a is None:
+                        merged.append(b)
+                    elif b is None:
+                        merged.append(a)
+                    elif a[0] == "post" or b[0] == "post":
+                        merged.append(("post",))
+                    else:
+                        merged.append(("pre", max(a[1], b[1])))
+                out_states = merged
+        for ov, n in branch_charges.items():
+            consumed[ov] = consumed.get(ov, 0) + n
+            if consumed[ov] > 1:
+                f, ln, fn = source_of(eqn)
+                self.r.violations.append(
+                    f"key consumed more than once across a {name} "
+                    f"boundary in {fn} at {os.path.basename(f)}:{ln}")
+        for o, st in zip(eqn.outvars, out_states or []):
+            if st is not None and _is_key_aval(getattr(o, "aval", None)):
+                state[o] = st
+
+    def _loop_reuse(self, eqn, name, const_slots, sub_consumed):
+        for bv in const_slots:
+            if sub_consumed.get(bv):
+                f, ln, fn = source_of(eqn)
+                self.r.violations.append(
+                    f"key entering a {name} body as a loop constant is "
+                    f"split/drawn from inside the body — consumed every "
+                    f"iteration ({fn} at {os.path.basename(f)}:{ln}); "
+                    "derive per-iteration keys with fold_in instead")
+
+    def _carry_reuse(self, eqn, name, carry_in, carry_out, sub_consumed):
+        for cin, cout in zip(carry_in, carry_out):
+            if cout is cin and sub_consumed.get(cin):
+                f, ln, fn = source_of(eqn)
+                self.r.violations.append(
+                    f"{name} carry key consumed inside the body but "
+                    f"passed through unchanged — reused next iteration "
+                    f"({fn} at {os.path.basename(f)}:{ln})")
+
+
+def audit_keys(closed_jaxpr) -> KeyReport:
+    """Run the lineage machine over the whole program."""
+    report = KeyReport(violations=[])
+    jaxpr = closed_jaxpr.jaxpr
+    state, consumed = {}, {}
+    for v in jaxpr.invars:
+        if _is_key_aval(getattr(v, "aval", None)):
+            state[v] = ("pre", 0)
+            report.n_roots += 1
+    _Walker(report).walk(jaxpr, state, consumed)
+    report.fold_depths_at_split = sorted(set(
+        report.fold_depths_at_split))
+    return report
+
+
+def check_policy(report: KeyReport, policy: dict):
+    """Contract assertions over a :class:`KeyReport`; returns a list of
+    violation strings.  Recognized policy keys:
+
+    - ``fold_depths_at_split``: exact sorted list of distinct fold
+      depths observed at split sites (the chunk contract pins ``[2]``:
+      iteration then chain).
+    - ``max_in_trace_roots``: cap on keys seeded/wrapped inside the
+      trace (0 = all randomness flows from the caller's key).
+    - ``allow_pre_split_consume``: when false, ``random_bits`` straight
+      off a fold chain (no split) is a violation.
+    """
+    out = list(report.violations)
+    want = policy.get("fold_depths_at_split")
+    if want is not None and list(report.fold_depths_at_split) != list(want):
+        out.append(
+            f"fold-depth policy mismatch: splits observed at depths "
+            f"{report.fold_depths_at_split}, contract requires {want} "
+            "(fold_in(fold_in(base_key, iteration), chain))")
+    cap = policy.get("max_in_trace_roots")
+    if cap is not None and report.n_in_trace_roots > cap:
+        out.append(
+            f"{report.n_in_trace_roots} key(s) seeded inside the trace "
+            f"(contract allows {cap}) — in-trace random_seed/random_wrap "
+            "breaks the resume key-fold policy")
+    if not policy.get("allow_pre_split_consume", True) \
+            and report.pre_split_consumes:
+        out.append(
+            f"{report.pre_split_consumes} random_bits draw(s) straight "
+            "off the fold chain without a split — draws must come from "
+            "split subkeys")
+    return out

@@ -1,0 +1,245 @@
+"""Contract loading and check dispatch.
+
+A contract is a committed JSON file under ``<repo>/contracts/``:
+
+.. code-block:: json
+
+    {
+      "name": "crn_bench_c128",
+      "fast": false,
+      "note": "why this contract exists / provenance of its numbers",
+      "entry": {"entry": "gram", "n_psr": 45, "...": "..."},
+      "checks": [
+        {"kind": "hbm", "budget_bytes": 16911433728,
+         "expect": "violation", "expect_source_fn": "tnt_d",
+         "expect_scratch_bytes": 16986931200, "tolerance_rel": 0.02},
+        {"kind": "collectives", "census": {"all-reduce": 6}, "...": 0},
+        {"kind": "dtypes", "exact_fns": ["linalg.py"], "census": {}},
+        {"kind": "keys", "policy": {"fold_depths_at_split": [2]}},
+        {"kind": "donation", "donate_argnums": [0, 1], "min_aliased": 2}
+      ]
+    }
+
+``entry`` resolves through :mod:`.entries`; each check walks the
+traced jaxpr or the lowered HLO of that entry.  Check failures are
+:class:`Violation` objects carrying ``path`` (the contract file) and
+``rule`` (the check kind) — the same surface jaxlint violations
+expose, so the :mod:`..baseline` ratchet applies unchanged.
+
+The ``hbm`` check supports ``expect: "violation"``: the contract
+*requires* the auditor to reject the configuration (the C=128 gate),
+naming ``expect_source_fn`` — an HBM estimate that silently stops
+rejecting an over-budget config is itself a contract failure.  When a
+calibration pin (``expect_scratch_bytes`` ± ``tolerance_rel``) is
+present, drift of the size model fails the gate the same way drift of
+the program does.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+from pathlib import Path
+
+from .collectives import census, check_gather_budget
+from .donation import audit_donation, check_aliasing
+from .dtypes import audit_dtypes, dot_census
+from .entries import resolve_entry
+from .hbm import GiB, audit_hbm, check_budget
+from .keys import audit_keys, check_policy
+from .walk import trace_jaxpr
+
+_REPO_ROOT = Path(__file__).resolve().parents[3]
+CONTRACT_DIR = _REPO_ROOT / "contracts"
+
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    """One contract-check failure; ``path``/``rule`` match the jaxlint
+    violation surface so ``analysis.baseline`` ratchets these too."""
+
+    path: str
+    rule: str
+    message: str
+
+    def __str__(self):
+        return f"{self.path}: [{self.rule}] {self.message}"
+
+
+def load_contract(path) -> dict:
+    with open(path, encoding="utf-8") as fh:
+        c = json.load(fh)
+    c["_path"] = str(path)
+    return c
+
+
+def discover_contracts(root=None, fast_only=False) -> list:
+    root = Path(root) if root is not None else CONTRACT_DIR
+    out = []
+    for p in sorted(root.glob("*.json")):
+        c = load_contract(p)
+        if fast_only and not c.get("fast", False):
+            continue
+        out.append(c)
+    return out
+
+
+def contract_hashes(root=None) -> dict:
+    """``{name: sha256-of-canonical-json}`` over committed contracts —
+    the audited-contract fingerprint bench.py embeds in the resilience
+    block, so a bench artifact records exactly which budgets it was
+    proven against."""
+    out = {}
+    root = Path(root) if root is not None else CONTRACT_DIR
+    for p in sorted(root.glob("*.json")):
+        with open(p, encoding="utf-8") as fh:
+            c = json.load(fh)
+        canon = json.dumps(c, sort_keys=True, separators=(",", ":"))
+        out[c.get("name", p.stem)] = hashlib.sha256(
+            canon.encode()).hexdigest()
+    return out
+
+
+def _relpath(path) -> str:
+    try:
+        return os.path.relpath(path, _REPO_ROOT)
+    except ValueError:
+        return str(path)
+
+
+# ---------------------------------------------------------------------------
+# per-kind check implementations: each returns (messages, facts)
+
+def _check_hbm(chk, closed, fn, args):
+    rep = audit_hbm(closed, seg_len=chk.get("seg_len", 96))
+    msg = check_budget(rep, chk["budget_bytes"])
+    facts = {"estimate_bytes": rep.estimate_bytes,
+             "estimate_gib": round(rep.estimate_bytes / GiB, 3)}
+    sc = rep.largest_scratch
+    if sc is not None:
+        facts["scratch"] = {"shape": list(sc.shape), "bytes": sc.bytes,
+                            "pad_ratio": round(sc.pad_ratio, 3),
+                            "source_fn": sc.source[2]}
+    out = []
+    expect = chk.get("expect", "pass")
+    if expect == "pass":
+        if msg is not None:
+            out.append(msg)
+    else:                                   # expect == "violation"
+        want_fn = chk.get("expect_source_fn")
+        if msg is None:
+            out.append(
+                f"expected an HBM-budget violation (the "
+                f"{chk['budget_bytes'] / GiB:.2f} GiB gate) but the "
+                f"estimate passed at {rep.estimate_bytes / GiB:.2f} GiB "
+                "— the auditor stopped rejecting this configuration")
+        elif want_fn and want_fn not in msg:
+            out.append(
+                f"HBM violation fired but does not name {want_fn!r}: "
+                f"{msg}")
+    pin = chk.get("expect_scratch_bytes")
+    if pin is not None:
+        got = sc.bytes if sc is not None else 0
+        tol = float(chk.get("tolerance_rel", 0.02))
+        if abs(got - pin) > tol * pin:
+            out.append(
+                f"scratch calibration drift: modeled {got} bytes, "
+                f"contract pins {pin} (±{tol:.0%}) — re-calibrate "
+                "against a fresh HBM measurement before re-committing")
+    return out, facts
+
+
+def _check_collectives(chk, closed, fn, args):
+    got = census(fn, *args)
+    facts = {"census": got}
+    out = []
+    want = chk.get("census")
+    if want is not None:
+        a = json.dumps(got, sort_keys=True)
+        b = json.dumps(want, sort_keys=True)
+        if a != b:                          # byte-identical ratchet
+            out.append(f"collective census drift: measured {a}, "
+                       f"contract pins {b}")
+    msg = check_gather_budget(got, chk.get("max_gather_elems"))
+    if msg is not None:
+        out.append(msg)
+    return out, facts
+
+
+def _check_dtypes(chk, closed, fn, args):
+    v, got = audit_dtypes(closed,
+                          exact_fns=chk.get("exact_fns", ()),
+                          highest_fns=chk.get("highest_fns", ()))
+    out = list(v)
+    want = chk.get("census")
+    if want is not None and json.dumps(got, sort_keys=True) != \
+            json.dumps(want, sort_keys=True):
+        out.append(f"dot dtype census drift: measured {got}, "
+                   f"contract pins {want}")
+    return out, {"census": got}
+
+
+def _check_keys(chk, closed, fn, args):
+    rep = audit_keys(closed)
+    out = check_policy(rep, chk.get("policy", {}))
+    return out, {"n_roots": rep.n_roots, "n_splits": rep.n_splits,
+                 "n_bits": rep.n_bits, "n_folds": rep.n_folds,
+                 "fold_depths_at_split": list(rep.fold_depths_at_split),
+                 "pre_split_consumes": rep.pre_split_consumes}
+
+
+def _check_donation(chk, closed, fn, args):
+    aliased, _text = audit_donation(fn, args,
+                                    chk.get("donate_argnums", ()))
+    out = []
+    msg = check_aliasing(aliased, chk.get("min_aliased", 1))
+    if msg is not None:
+        out.append(msg)
+    return out, {"aliased_outputs": aliased}
+
+
+_CHECKS = {"hbm": _check_hbm, "collectives": _check_collectives,
+           "dtypes": _check_dtypes, "keys": _check_keys,
+           "donation": _check_donation}
+
+
+def run_contract(contract: dict):
+    """``(violations, facts)`` for one loaded contract.  The entry is
+    traced once; every check shares the ClosedJaxpr."""
+    path = _relpath(contract.get("_path", contract.get("name", "?")))
+    fn, args, _extras = resolve_entry(contract["entry"])
+    closed = trace_jaxpr(fn, args)
+    violations, facts = [], {"name": contract.get("name"),
+                             "n_eqns": len(closed.jaxpr.eqns)}
+    for chk in contract.get("checks", []):
+        kind = chk["kind"]
+        impl = _CHECKS.get(kind)
+        if impl is None:
+            violations.append(Violation(path, kind,
+                                        f"unknown check kind {kind!r}"))
+            continue
+        msgs, chk_facts = impl(chk, closed, fn, args)
+        facts[kind] = chk_facts
+        violations.extend(Violation(path, kind, m) for m in msgs)
+    return violations, facts
+
+
+def run_contracts(contracts):
+    """``(all_violations, {name: facts})`` over a contract list; a
+    contract that errors out (entry fails to build/trace) becomes an
+    ``error`` violation rather than an exception, so one broken
+    contract cannot mask the others."""
+    all_v, all_f = [], {}
+    for c in contracts:
+        path = _relpath(c.get("_path", c.get("name", "?")))
+        try:
+            v, f = run_contract(c)
+        except Exception as e:              # noqa: BLE001 - report, don't die
+            all_v.append(Violation(path, "error",
+                                   f"{type(e).__name__}: {e}"))
+            continue
+        all_v.extend(v)
+        all_f[c.get("name", path)] = f
+    return all_v, all_f

@@ -1,0 +1,148 @@
+"""Jaxpr traversal and the TPU tile-padding size model.
+
+The traversal is generic over call-like primitives: any equation
+parameter holding a (Closed)Jaxpr — ``pjit``'s ``jaxpr``, ``scan``'s
+``jaxpr``, ``cond``'s ``branches``, ``while``'s ``body_jaxpr`` /
+``cond_jaxpr``, custom-derivative wrappers — is recursed into, so
+auditors see every equation of the whole program.
+
+The size model is the (sublane, lane) tile padding of TPU vector
+memory: the minor-most dimension pads to 128 lanes and the
+second-minor to the dtype's sublane count (8 for 4/8-byte, 16 for
+2-byte, 32 for 1-byte elements); rank-1 arrays pad the single axis to
+128.  Calibrated against the r4 HBM measurement of the exact-Gram
+accumulation scratch: the model reproduces the README's 3.4x pad ratio
+and 15.8 GiB at C=128 to <1% (tests/test_jaxprcheck.py pins both).
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+#: lane (minor-most) tile width — fixed across dtypes
+LANE = 128
+
+#: sublane (second-minor) tile height by element size in bytes
+_SUBLANE = {1: 32, 2: 16}          # default 8 for 4- and 8-byte elements
+
+
+def _itemsize(dtype) -> int:
+    """Element size in bytes; typed PRNG keys count their data words
+    (threefry: 2 x uint32 = 8 bytes)."""
+    try:
+        import jax
+
+        if jax.dtypes.issubdtype(dtype, jax.dtypes.prng_key):
+            return 8
+    except Exception:
+        pass
+    return int(np.dtype(dtype).itemsize)
+
+
+def tile_padded_bytes(shape, dtype) -> int:
+    """Bytes the TPU tiler allocates for an array of ``shape``/``dtype``
+    once minor dims are padded to the (sublane, LANE) tile."""
+    item = _itemsize(dtype)
+    shape = tuple(int(s) for s in shape)
+    if not shape:
+        return item
+    sub = _SUBLANE.get(item, 8)
+    minor = math.ceil(shape[-1] / LANE) * LANE
+    if len(shape) == 1:
+        return minor * item
+    sublane = math.ceil(shape[-2] / sub) * sub
+    lead = 1
+    for s in shape[:-2]:
+        lead *= s
+    return lead * sublane * minor * item
+
+
+def aval_bytes(aval) -> int:
+    """Tile-padded bytes of an abstract value (0 for non-array avals)."""
+    shape = getattr(aval, "shape", None)
+    dtype = getattr(aval, "dtype", None)
+    if shape is None or dtype is None:
+        return 0
+    return tile_padded_bytes(shape, dtype)
+
+
+def _as_jaxpr(v):
+    import jax
+
+    if isinstance(v, jax.core.ClosedJaxpr):
+        return v.jaxpr
+    if isinstance(v, jax.core.Jaxpr):
+        return v
+    return None
+
+
+def subjaxprs(eqn):
+    """Every (open) sub-jaxpr held in ``eqn.params`` — call-like
+    primitives (pjit, scan, cond, while, custom_*) all store their
+    bodies there."""
+    out = []
+    for val in eqn.params.values():
+        if isinstance(val, (tuple, list)):
+            for v in val:
+                j = _as_jaxpr(v)
+                if j is not None:
+                    out.append(j)
+        else:
+            j = _as_jaxpr(val)
+            if j is not None:
+                out.append(j)
+    return out
+
+
+def iter_eqns(jaxpr, depth=0):
+    """Yield ``(eqn, depth)`` over ``jaxpr`` and every nested sub-jaxpr
+    (pre-order; depth counts call-primitive nesting)."""
+    for eqn in jaxpr.eqns:
+        yield eqn, depth
+        for sub in subjaxprs(eqn):
+            yield from iter_eqns(sub, depth + 1)
+
+
+#: path fragment marking frames that belong to this repository — dots
+#: emitted from inside jax library helpers (cho_solve's ``_mm`` etc.)
+#: attribute to the repo call site, not the library internals
+_REPO_FRAGMENT = "pulsar_timing_gibbsspec_tpu"
+
+#: ...but never to the auditor itself (its trace wrapper is a repo
+#: frame on every equation's stack)
+_SELF_FRAGMENT = "analysis" + "/" + "jaxprcheck"
+
+
+def source_of(eqn):
+    """``(file_name, line, function_name)`` of the frame that emitted
+    ``eqn`` — the location a violation report points at.  Prefers the
+    innermost frame inside this repository (excluding jaxprcheck's own
+    tracing machinery); falls back to jax's notion of the user frame
+    (so library-internal helpers attribute to the repo function that
+    called them, and code outside the repo attributes to itself)."""
+    try:
+        from jax._src import source_info_util
+
+        for frame in source_info_util.user_frames(eqn.source_info):
+            f = frame.file_name.replace("\\", "/")
+            if _REPO_FRAGMENT in f and _SELF_FRAGMENT not in f:
+                return (frame.file_name, int(frame.start_line),
+                        frame.function_name)
+        frame = source_info_util.user_frame(eqn.source_info)
+    except Exception:
+        frame = None
+    if frame is None:
+        return ("<unknown>", 0, "<unknown>")
+    return (frame.file_name, int(frame.start_line), frame.function_name)
+
+
+def trace_jaxpr(fn, example_args):
+    """Abstractly trace ``fn`` (jitted or plain) to a ClosedJaxpr —
+    never executes: ``ShapeDtypeStruct`` arguments stay abstract and
+    concrete example arrays are only read for shape/dtype."""
+    import jax
+
+    traced = jax.jit(fn).trace(*example_args)
+    return traced.jaxpr

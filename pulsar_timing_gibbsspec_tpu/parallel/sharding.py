@@ -159,31 +159,9 @@ def collective_report(fn, *example_args, max_gather_elems=None):
     local.  The MULTICHIP budget ({'all-reduce': 5, 'all-gather': 3} at
     r05) is measured on the CRN sweep, which never enters the joint draw.
     """
-    import re
+    # counting core absorbed into analysis.jaxprcheck.collectives (the
+    # C2 census contract): one set of regexes serves both this ad-hoc
+    # probe and the committed-contract gate
+    from ..analysis.jaxprcheck.collectives import census
 
-    import jax
-
-    hlo = (jax.jit(fn).lower(*example_args)
-           .compile().as_text())
-    counts = {"all-reduce": len(re.findall(r"\ball-reduce(?:-start)?\(",
-                                           hlo)),
-              "all-gather": len(re.findall(r"\ball-gather(?:-start)?\(",
-                                           hlo))}
-    elems = []
-    for m in re.finditer(r"all-gather(?:-start)?\(", hlo):
-        # operand shape precedes the op name on the defining line:
-        #   %x = f32[6,17]{...} all-gather(...)
-        line = hlo[hlo.rfind("\n", 0, m.start()) + 1:m.start()]
-        sm = re.search(r"\[([0-9,]*)\]", line)
-        if sm:
-            dims = [int(v) for v in sm.group(1).split(",") if v]
-            elems.append(int(np.prod(dims)) if dims else 1)
-    counts["gather_elems"] = sorted(elems)
-    if max_gather_elems is not None:
-        too_big = [e for e in elems if e > max_gather_elems]
-        if too_big:
-            raise RuntimeError(
-                f"all-gather operand(s) of {too_big} elements exceed the "
-                f"{max_gather_elems}-element budget — a basis-sized array "
-                "is crossing the mesh")
-    return counts
+    return census(fn, *example_args, max_gather_elems=max_gather_elems)

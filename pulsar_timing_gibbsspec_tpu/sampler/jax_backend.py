@@ -38,6 +38,7 @@ chain adaptation state), so C chains multiply posterior samples/sec by
 
 from __future__ import annotations
 
+import contextlib
 import os
 import time
 import warnings
@@ -3146,11 +3147,19 @@ class JaxGibbsDriver:
                 with self._dispatch_guard():
                     return fn(*args)
 
+            # a cache-miss chunk legitimately compiles at this dispatch:
+            # bracket it so phase-scoped retrace counters don't charge
+            # it against the steady-state zero-retrace contract
+            from ..analysis.guards import planned_compile
+            pc = planned_compile() if fresh_compile \
+                else contextlib.nullcontext()
             t0 = time.monotonic()
-            if wd is not None:
-                x, b_dev, xs, bs, health = wd.call(_go, what=f"chunk@{ii}")
-            else:
-                x, b_dev, xs, bs, health = _go()
+            with pc:
+                if wd is not None:
+                    x, b_dev, xs, bs, health = wd.call(_go,
+                                                       what=f"chunk@{ii}")
+                else:
+                    x, b_dev, xs, bs, health = _go()
             m = max(0, -(-(n - off) // self.record_every))
             if pending is not None:
                 # start both host copies in flight together before the
@@ -3345,3 +3354,104 @@ class JaxGibbsDriver:
                 "resume checkpoint lacks ECORR adaptation state "
                 "(chol/mode_ecorr); delete the chain directory to start "
                 "fresh")
+
+
+# ===========================================================================
+# stable trace entry points (static auditing — analysis/jaxprcheck)
+# ===========================================================================
+# Each returns a jittable ``fn`` plus example arguments whose abstract
+# trace / AOT lowering is a faithful stand-in for the production program
+# at the given configuration, with no device execution beyond staging
+# tiny host constants.  analysis/jaxprcheck walks these jaxprs/HLO
+# against the contracts committed in contracts/*.json; the entries live
+# here, next to the kernels they trace, so a kernel refactor updates its
+# audit surface in the same diff (docs/LINTING.md, "jaxprcheck").
+
+
+def gram_trace_entry(cm: CompiledPTA, nchains: int):
+    """The exact (f64-accumulated) b-draw vmapped over ``nchains`` — the
+    program whose Gram accumulation scratch is THE out-of-memory term of
+    wide-chain compiles (ROADMAP item 1, README r4 notes: a
+    ``(nseg, C, P, Nmax, B1)`` operand copy the TPU tiler pads ~3.4x
+    past 15.75 GB at C=128).
+
+    Returns ``(fn, example_args)`` with every argument an abstract
+    ``jax.ShapeDtypeStruct``: ``jax.jit(fn).trace(*example_args)``
+    yields the jaxpr the C1 HBM contract sizes without touching a
+    device."""
+    import jax
+    import jax.random as jr
+
+    def draw(x, key):
+        return draw_b_fn(cm, x, key, exact=True)
+
+    x = jax.ShapeDtypeStruct((int(nchains), cm.nx), cm.cdtype)
+    keys = jax.ShapeDtypeStruct((int(nchains),), jr.key(0).dtype)
+    return jax.vmap(draw), (x, keys)
+
+
+def sweep_chunk_entry(pta, nchains, *, chunk=2, pad_pulsars=None, seed=0):
+    """A steady-state compiled-chunk function plus abstract example
+    arguments, built WITHOUT running warmup: the driver gets placeholder
+    adaptation state (identity white-proposal factors, zero modes, an
+    ACT of 2, no DE history) whose shapes and dataflow are identical to
+    the adapted production chunk — values are irrelevant to a static
+    audit.
+
+    Returns ``(fn, example_args, drv)``; ``fn`` is the driver's cached
+    jitted chunk (key ``(chunk, 0)``) and the example arguments mirror
+    ``run()``'s staging ``(x, b, key, it0, aux, n_keep)``.  The aux
+    pytree holds tiny concrete arrays (abstracted by ``.trace``); the
+    carries and key are ``ShapeDtypeStruct``."""
+    import jax
+    import jax.numpy as jnp
+    import jax.random as jr
+
+    drv = JaxGibbsDriver(pta, nchains=int(nchains), seed=seed,
+                         pad_pulsars=pad_pulsars, chunk_size=int(chunk))
+    cm = drv.cm
+    C = drv.C
+    if len(cm.idx.white):
+        W = int(np.asarray(cm.white_par_ix).shape[1])
+        eye = np.tile(np.eye(W, dtype=np.float64), (C, cm.P, 1, 1))
+        drv.aclength_white = 2
+        drv.chol_white = eye
+        drv.asqrt_white = eye.copy()
+        drv.mode_white = np.zeros((C, cm.P, W), np.float64)
+    if len(cm.idx.ecorr) and (cm.ec_cols.shape[1] or cm.has_ke):
+        E = int(np.asarray(cm.ecorr_par_ix).shape[1])
+        eye = np.tile(np.eye(E, dtype=np.float64), (C, cm.P, 1, 1))
+        drv.aclength_ecorr = 2
+        drv.chol_ecorr = eye
+        drv.asqrt_ecorr = eye.copy()
+        drv.mode_ecorr = np.zeros((C, cm.P, E), np.float64)
+    fn = drv._chunk_fn(int(chunk), 0)
+    args = (
+        jax.ShapeDtypeStruct((C, cm.nx), cm.cdtype),
+        jax.ShapeDtypeStruct((C, cm.P, cm.Bmax), cm.cdtype),
+        jax.ShapeDtypeStruct((), jr.key(0).dtype),
+        jnp.asarray(0, jnp.int32),
+        drv._aux(),
+        jnp.asarray(chunk, jnp.int32),
+    )
+    return fn, args, drv
+
+
+def sharded_sweep_step(cm: CompiledPTA, x, b, key):
+    """One CRN sweep with the :class:`CompiledPTA` passed as a jit
+    ARGUMENT — the canonical surface of the C2 collective-census
+    contract, mirroring ``__graft_entry__._dryrun_multichip_inner``
+    (closure-captured jax.Arrays lower as replicated constants and GSPMD
+    silently drops their shardings, so only argument shardings reach the
+    partitioner).  The committed budget {'all-reduce': 5, 'all-gather':
+    3} (MULTICHIP_r*.json) is measured on exactly this step."""
+    import jax.random as jr
+
+    k = jr.split(key, 5)
+    r2 = residual_sq(cm, b)
+    x, _ = mh_scan(cm, x, k[0], lambda q: lnlike_white_fn(cm, q, r2),
+                   cm.idx.white, 3)
+    x = red_conditional_update(cm, x, b, k[1])
+    x = rho_update(cm, x, b, k[2])
+    b = draw_b_fn(cm, x, k[3])
+    return x, b

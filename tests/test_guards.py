@@ -57,6 +57,50 @@ def test_recompile_counter_counts_compiles_not_cache_hits():
     assert rc.events == n
 
 
+def test_recompile_counter_phase_attribution():
+    import jax
+    import jax.numpy as jnp
+
+    with guards.count_recompiles() as rc:
+        rc.phase("warmup")
+        jax.jit(lambda x: x * 3.0)(jnp.zeros((2,), jnp.float32))
+        assert rc.per_phase["warmup"] > 0
+        rc.phase("steady")
+        assert rc.per_phase["steady"] == 0
+        jax.jit(lambda x: x * 5.0)(jnp.zeros((2,), jnp.float32))
+        assert rc.per_phase["steady"] > 0
+        # warmup compiles cannot pollute the steady bucket
+        assert rc.unplanned("warmup") == rc.per_phase["warmup"]
+
+
+def test_recompile_counter_planned_window_not_charged_as_unplanned():
+    import jax
+    import jax.numpy as jnp
+
+    with guards.count_recompiles() as rc:
+        rc.phase("steady")
+        # a legitimate cache-miss compile, bracketed the way the driver
+        # brackets its chunk dispatch: planned, not a retrace
+        with guards.planned_compile():
+            jax.jit(lambda x: x * 7.0)(jnp.zeros((2,), jnp.float32))
+        assert rc.per_phase["steady"] > 0
+        assert rc.unplanned("steady") == 0
+        # an unbracketed compile in the same phase IS a retrace
+        jax.jit(lambda x: x * 11.0)(jnp.zeros((2,), jnp.float32))
+        assert rc.unplanned("steady") > 0
+
+
+def test_recompile_counter_reset_zeroes_phases():
+    import jax
+    import jax.numpy as jnp
+
+    with guards.count_recompiles() as rc:
+        rc.phase("a")
+        jax.jit(lambda x: x * 13.0)(jnp.zeros((2,), jnp.float32))
+        rc.reset()
+        assert rc.events == 0 and rc.unplanned("a") == 0
+
+
 def test_recompile_counter_exported_via_profiling():
     import jax
     import jax.numpy as jnp

@@ -316,6 +316,72 @@ def test_r6_suppressed_by_pragma():
     assert rules_of(src) == []
 
 
+# ---------------------------------------------------------------------------
+# R7: host-sync leaks
+# ---------------------------------------------------------------------------
+
+def test_r7_fires_on_bool_and_int_of_traced_values():
+    src = """
+        import jax
+        @jax.jit
+        def f(x):
+            if bool(x):
+                return x
+            return int(x) + x
+    """
+    assert rules_of(src) == ["R7", "R7"]
+
+
+def test_r7_fires_on_implicit_bool_of_jnp_expression():
+    src = """
+        import jax
+        import jax.numpy as jnp
+        @jax.jit
+        def f(x):
+            if jnp.any(x > 0):
+                return x
+            while jnp.all(x):
+                x = x - 1
+            assert jnp.isfinite(x)
+            return not jnp.any(x)
+    """
+    assert rules_of(src) == ["R7", "R7", "R7", "R7"]
+
+
+def test_r7_static_branching_and_constants_are_fine():
+    src = """
+        import jax
+        @jax.jit
+        def f(x, flag=None):
+            if flag is None:
+                flag = True
+            n = int(3.5)
+            return x * n
+    """
+    assert rules_of(src) == []
+
+
+def test_r7_untraced_code_is_fine():
+    src = """
+        import numpy as np
+        def host(x):
+            if bool(x.any()):
+                return int(x.sum())
+            return 0
+    """
+    assert rules_of(src) == []
+
+
+def test_r7_suppressed_by_pragma():
+    src = """
+        import jax
+        @jax.jit
+        def f(x):
+            return int(x)  # jaxlint: disable=R7
+    """
+    assert rules_of(src) == []
+
+
 def test_pragma_all_silences_everything():
     src = """
         import jax

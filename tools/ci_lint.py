@@ -1,0 +1,59 @@
+"""The static gate, one command: ``python tools/ci_lint.py``.
+
+Runs the three analysis layers in cost order and reports a combined
+status — the same set the ``lint`` pytest marker covers:
+
+1. ruff        — generic Python lint (pyflakes/pycodestyle/isort),
+                 skipped with a note when not installed;
+2. jaxlint     — AST-level JAX discipline (rules R1-R7), ratcheted
+                 against ``jaxlint_baseline.json``;
+3. jaxprcheck  — jaxpr/HLO contract audit of the fast (CPU-traceable)
+                 contracts in ``contracts/``, ratcheted against
+                 ``jaxprcheck_baseline.json``.
+
+Each layer runs in its own interpreter (jaxprcheck must configure the
+JAX platform before jax is first imported), so a crash in one cannot
+mask another.  Exit status is 0 only when every layer passes.
+Importing this module has no side effects.
+"""
+
+
+def main(argv=None) -> int:
+    import os
+    import shutil
+    import subprocess
+    import sys
+
+    repo = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+    extra = list(argv) if argv is not None else sys.argv[1:]
+
+    layers = []
+    exe = shutil.which("ruff")
+    if exe is None:
+        print("ci_lint: ruff not installed; skipping generic lint")
+    else:
+        layers.append(("ruff", [exe, "check", "."]))
+    layers.append(("jaxlint",
+                   [sys.executable, "-m",
+                    "pulsar_timing_gibbsspec_tpu.analysis"]))
+    layers.append(("jaxprcheck",
+                   [sys.executable, "-m",
+                    "pulsar_timing_gibbsspec_tpu.analysis.jaxprcheck",
+                    "--fast", *extra]))
+
+    failed = []
+    for name, cmd in layers:
+        shown = [os.path.basename(cmd[0])] + cmd[1:]
+        print(f"ci_lint: [{name}] {' '.join(shown)}")
+        rc = subprocess.run(cmd, cwd=repo, check=False).returncode
+        if rc != 0:
+            failed.append(name)
+    if failed:
+        print(f"ci_lint: FAILED ({', '.join(failed)})", file=sys.stderr)
+        return 1
+    print(f"ci_lint: OK ({len(layers)} layer(s) clean)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
