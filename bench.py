@@ -383,10 +383,81 @@ def thinned_probe(orf, n_psr, niter, adapt, nchains, record, k=4):
     }
 
 
+def bench_serve(quick=False, niter=None, slots=2, chunk=4):
+    """Serving-mode benchmark: multiplexed aggregate samples/s and
+    warm-start admission latency of the resident service, on synthetic
+    datasets (standalone — no reference data needed).
+
+    Two phases: a *cold* phase pays the bucket compile with two
+    multiplexed tenants; a *warm* phase then admits two FRESH tenants
+    (new PRNG streams, one on a dataset shape the bucket has never
+    seen) onto the already-compiled program — its wall clock is the
+    steady multiplexed throughput and its first-sample latencies are
+    the warm-start SLO.  Any unplanned retrace in either phase is
+    reported (and must be zero: contracts/serve_buckets.json)."""
+    import shutil
+    import tempfile
+
+    from pulsar_timing_gibbsspec_tpu import profiling
+    from pulsar_timing_gibbsspec_tpu.analysis.jaxprcheck.entries import (
+        build_model, synthetic_pulsars)
+    from pulsar_timing_gibbsspec_tpu.runtime import telemetry
+    from pulsar_timing_gibbsspec_tpu.serve import (
+        BucketSpec, BucketTable, SamplerService)
+
+    niter = niter or (16 if quick else 64)
+    ptas = [build_model(synthetic_pulsars(2, 24 + 6 * i, tm_cols=3,
+                                          seed=i), 3)
+            for i in range(3)]
+    table = BucketTable([BucketSpec(2, 48, 24, 3)])
+    root = tempfile.mkdtemp(prefix="bench_serve_")
+    telemetry.reset()
+    try:
+        svc = SamplerService(root, table, slots=slots, chunk=chunk,
+                             quantum=10 ** 9)
+        with profiling.recompile_counter() as rc:
+            rc.phase("cold")
+            cold = [svc.submit(ptas[i], niter, tenant_id=i)
+                    for i in range(2)]
+            t0 = time.time()
+            svc.run()
+            cold_wall = time.time() - t0
+            rc.phase("warm")
+            warm = [svc.submit(ptas[d], niter, tenant_id=t)
+                    for d, t in ((2, 2), (0, 3))]
+            t0 = time.time()
+            svc.run()
+            warm_wall = time.time() - t0
+        rows = sum(j.it for j in warm)
+        lat = [j.time_to_first_sample_ms() for j in warm]
+        return {
+            "niter": niter, "slots": slots, "chunk": chunk,
+            "jobs": {j.job_id: j.state for j in cold + warm},
+            "cold_wall_s": round(cold_wall, 3),
+            "cold_samples_per_s": round(
+                sum(j.it for j in cold) / cold_wall, 2),
+            "aggregate_samples_per_s": round(rows / warm_wall, 2),
+            "warm_start_latency_ms": round(min(lat), 2),
+            "warm_start_latency_ms_worst": round(max(lat), 2),
+            "warm_hit_rate": svc.cache.warm_hit_rate(),
+            "unplanned_retraces": {
+                "cold": rc.unplanned("cold"),
+                "warm": rc.unplanned("warm")},
+            "gauges": telemetry.gauges(),
+        }
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true",
                     help="8 pulsars, fewer iterations (smoke test)")
+    ap.add_argument("--serve", action="store_true",
+                    help="serving-mode benchmark: multiplexed aggregate "
+                    "samples/s + warm-start latency of the resident "
+                    "service on synthetic data (no reference data "
+                    "needed); prints its own JSON line and exits")
     ap.add_argument("--niter", type=int, default=None)
     ap.add_argument("--numpy-iters", type=int, default=None)
     ap.add_argument("--nchains", type=int, default=None)
@@ -411,6 +482,26 @@ def main(argv=None):
 
     import jax
 
+    if args.serve:
+        serving = bench_serve(quick=args.quick, niter=args.niter)
+        from pulsar_timing_gibbsspec_tpu.runtime import telemetry
+        out = {
+            "metric": "serve_aggregate_samples_per_sec",
+            "value": serving["aggregate_samples_per_s"],
+            "unit": "samples/s",
+            "device_kind": jax.devices()[0].device_kind,
+            "serving": serving,
+            "resilience": {"counters": telemetry.snapshot(),
+                           "gauges": telemetry.gauges(),
+                           "serving": serving},
+        }
+        print(json.dumps(out))
+        print(f"# serve: {serving['aggregate_samples_per_s']:.2f} "
+              f"multiplexed samples/s ({serving['slots']} slots), "
+              f"warm start {serving['warm_start_latency_ms']:.0f} ms, "
+              f"unplanned retraces {serving['unplanned_retraces']}",
+              file=sys.stderr)
+        return
     n_psr = 8 if args.quick else 45
     niter = args.niter or (300 if args.quick else 1000)
     np_iters = args.numpy_iters or (20 if args.quick else 100)

@@ -18,6 +18,12 @@ Entry kinds (the ``entry`` field of a contract):
 - ``sharded_step`` — one CRN sweep step under pulsar-axis sharding on
   a host-device mesh (mirrors the MULTICHIP dry-run): the C2 census
   target.
+- ``serve_mux`` — the routed multiplexed steady chunk of the serving
+  layer: >= 3 heterogeneous synthetic datasets snapped into ONE bucket,
+  grafted onto one static box, stacked, and traced as one program.  The
+  entry *raises* (-> an ``error`` violation) when routing diverges, the
+  cache fails to warm-hit, or the stacked pytree's treedef/avals drift
+  — the static zero-retrace contract (``serve_buckets``).
 """
 
 from __future__ import annotations
@@ -121,8 +127,54 @@ def _sharded_step_entry(spec):
     return step, (cm, x0, b0, jr.key(0)), {}
 
 
+def _serve_mux_entry(spec):
+    """Routed multiplexed chunk over heterogeneous datasets sharing one
+    bucket.  Every condition the serving layer's zero-retrace guarantee
+    rests on is asserted host-side before the trace: same routed
+    bucket, warm cache hits after the first admission, one treedef and
+    identical leaf avals across the stack."""
+    import jax.numpy as jnp
+    import jax.random as jr
+
+    from ...serve.buckets import BucketSpec, BucketTable
+    from ...serve.engine import (ProgramCache, compile_bucket, mux_body,
+                                 stack_cms)
+
+    ntoas = [int(n) for n in spec.get("ntoas", (24, 30, 36))]
+    if len(ntoas) < 3:
+        raise ValueError("serve_mux needs >= 3 heterogeneous datasets")
+    bucket = BucketSpec(*spec.get("bucket", (2, 40, 24, 3)))
+    table = BucketTable([bucket])
+    cache = ProgramCache()
+    cms = []
+    for i, ntoa in enumerate(ntoas):
+        pta = build_model(
+            synthetic_pulsars(spec.get("n_psr", 2), ntoa,
+                              tm_cols=spec.get("tm_cols", 3), seed=i),
+            spec.get("nmodes", 3))
+        routed = table.route_pta(pta)
+        if routed != bucket:
+            raise AssertionError(
+                f"dataset {i} (ntoa={ntoa}) routed to {routed}, "
+                f"not the shared bucket {bucket}")
+        cm, warm = cache.adopt(routed, compile_bucket(pta, routed))
+        if warm != (i > 0):
+            raise AssertionError(
+                f"program cache {'missed' if i else 'hit'} on dataset "
+                f"{i} — the box graft no longer deduplicates")
+        cms.append(cm)
+    stack = stack_cms(cms)      # raises SignatureMismatch on aval drift
+    T, cm0 = len(cms), cms[0]
+    x = jnp.zeros((T, cm0.nx), cm0.cdtype)
+    b = jnp.zeros((T, cm0.P, cm0.Bmax), cm0.cdtype)
+    tkeys = jr.split(jr.key(spec.get("seed", 0)), T)
+    it0 = jnp.ones((T,), jnp.int32)
+    return mux_body(spec.get("chunk", 2)), (stack, x, b, tkeys, it0), {}
+
+
 _ENTRIES = {"gram": _gram_entry, "chunk": _chunk_entry,
-            "sharded_step": _sharded_step_entry}
+            "sharded_step": _sharded_step_entry,
+            "serve_mux": _serve_mux_entry}
 
 
 def resolve_entry(spec: dict):

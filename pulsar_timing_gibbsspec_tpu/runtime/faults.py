@@ -19,6 +19,12 @@ Seam points (``fire``):
 - ``"dispatch.chunk"`` — inside the jax driver's watchdog-guarded chunk
   dispatch, before the compiled chunk runs; ``row`` is the absolute
   iteration index of the chunk start.
+- ``"serve.chunk"`` — in the multi-tenant service's scheduler loop,
+  between multiplexed chunks (after the previous chunk's rows were
+  checkpoint-eligible, before the next dispatch); ``row`` is the
+  service's global chunk counter.  Crash/stall/sigterm kinds work here
+  like at any seam; the service additionally polls
+  :func:`tenant_evict_request` at the same point.
 
 Fault kinds:
 
@@ -44,6 +50,10 @@ Fault kinds:
   return ``devices`` — simulates the pool handing the next incarnation
   a different device count than the checkpoint was written under
   (``integrity.reshard_restore`` consults it).
+- ``"tenant_evict"`` make :func:`tenant_evict_request` return True at
+  the ``"serve.chunk"`` seam — forces the serving scheduler to evict a
+  resident tenant back to the queue (checkpoint + requeue), the churn
+  half of the kill-mid-multiplex chaos drill.
 """
 
 from __future__ import annotations
@@ -175,6 +185,17 @@ def device_count_override(default=None):
     hits = _take("resume.device_count", None, None,
                  ("device_count_change_on_resume",))
     return hits[-1].devices if hits else default
+
+
+def tenant_evict_request(row=None):
+    """Consume an armed ``tenant_evict`` fault at the ``serve.chunk``
+    seam (counting a firing).  Returns True when the serving scheduler
+    should evict a resident tenant this chunk — the service checkpoints
+    the tenant and requeues it, so the drill proves mid-multiplex churn
+    is loss-free.  False when nothing is armed."""
+    if not _armed:
+        return False
+    return bool(_take("serve.chunk", row, None, ("tenant_evict",)))
 
 
 def _damage(path, kind):

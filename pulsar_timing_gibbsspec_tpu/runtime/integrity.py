@@ -251,3 +251,33 @@ def rollback(outdir) -> bool:
     os.replace(tmp, outdir / MANIFEST)
     telemetry.incr("rollbacks")
     return True
+
+
+def load_resume(outdir):
+    """Standalone verified checkpoint load for a bare directory.
+
+    ``ChainStore.load_resume`` needs a live store instance (the facade
+    owns one); the serving scheduler readmits evicted jobs knowing only
+    their per-job checkpoint dir.  This helper reconstructs the store
+    from the directory's own ``pars_chain.txt``/``pars_bchain.txt`` and
+    delegates — same manifest verification, ``.bak`` rollback and
+    :class:`CheckpointError` semantics.  Returns
+    ``(chain, bchain, start_iter, adapt_state)`` or ``None`` when there
+    is nothing to resume from.
+    """
+    from ..sampler.chains import ChainStore
+
+    outdir = Path(outdir)
+    if not (outdir / "chain.npy").exists():
+        return None
+
+    def _names(fname):
+        p = outdir / fname
+        if not p.exists():
+            return []
+        return [ln.strip() for ln in p.read_text().splitlines()
+                if ln.strip()]
+
+    store = ChainStore(outdir, _names("pars_chain.txt"),
+                       _names("pars_bchain.txt"))
+    return store.load_resume()

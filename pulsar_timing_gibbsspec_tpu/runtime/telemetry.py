@@ -28,6 +28,18 @@ closed set):
 Gauges (:func:`gauge`) carry last-value measurements (floats) next to
 the counters — e.g. ``drain_latency_ms``, the request-to-verified-
 checkpoint time of the most recent preemption drain.
+
+Serving-layer gauges (``serve.service``, glossary in docs/SERVING.md):
+
+- ``queue_depth``              requests waiting for a batch-row slot
+- ``warm_hit_rate``            fraction of admissions that landed on an
+                               already-compiled bucket program
+- ``compile_stalls``           admissions that had to wait for a bucket
+                               program compile (cold bucket)
+- ``tenant_evictions``         residents checkpointed + requeued to make
+                               room (fair-share churn or injected)
+- ``time_to_first_sample_ms``  submit-to-first-recorded-sweep latency of
+                               the most recent request
 """
 
 from __future__ import annotations

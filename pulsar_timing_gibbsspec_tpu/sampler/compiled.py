@@ -619,11 +619,21 @@ def _as_i32(a):
 
 
 def compile_pta(pta, pad_pulsars: int | None = None,
-                kernel_ecorr: bool = False) -> CompiledPTA:
+                kernel_ecorr: bool = False,
+                pad_toas: int | None = None,
+                pad_basis: int | None = None) -> CompiledPTA:
     """Compile a host :class:`~..models.pta.PTA` into a CompiledPTA.
 
     ``pad_pulsars``: total pulsar-axis length (>= len(pta.pulsars)); extra
     slots are inert dummy pulsars so the axis divides a device-mesh size.
+
+    ``pad_toas`` / ``pad_basis``: force the TOA axis (``Nmax``) and basis
+    axis (``Bmax``) to a fixed length at least the data-derived maximum.
+    Pad TOA rows carry y=0, T=0, sigma2=1, constant efac=1 and
+    equad=-40 (Nvec=1, zero masked log-likelihood) and pad basis columns
+    carry phi_base=1 with basis_mask=0, so forcing larger axes is exact —
+    the serve/ bucket router uses this to land heterogeneous datasets on
+    one compiled program shape.
 
     ``kernel_ecorr``: execute ECORR epoch blocks inside N (Woodbury, the
     reference's ``ecorrsample='kernel'`` semantics — its own path is dead
@@ -663,6 +673,12 @@ def compile_pta(pta, pad_pulsars: int | None = None,
     if P < P_real:
         raise ValueError("pad_pulsars smaller than the pulsar count")
     Nmax = max(m.pulsar.ntoa for m in models)
+    if pad_toas is not None:
+        if pad_toas < Nmax:
+            raise ValueError(
+                f"pad_toas={pad_toas} smaller than the largest TOA count "
+                f"{Nmax}")
+        Nmax = int(pad_toas)
     if kernel_ecorr and not any(m._ecorr for m in models):
         raise ValueError(
             "ecorrsample='kernel' requested but the model has no ECORR "
@@ -677,6 +693,12 @@ def compile_pta(pta, pad_pulsars: int | None = None,
 
     widths = tuple(_width(m) for m in models)
     Bmax = max(widths)
+    if pad_basis is not None:
+        if pad_basis < Bmax:
+            raise ValueError(
+                f"pad_basis={pad_basis} smaller than the widest basis "
+                f"{Bmax}")
+        Bmax = int(pad_basis)
 
     efac1 = const_ref(1.0)
     equad_off = const_ref(-40.0)

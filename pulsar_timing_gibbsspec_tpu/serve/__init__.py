@@ -1,0 +1,23 @@
+"""Resident multi-tenant sampler service.
+
+Turns the one-shot batch sampler into an always-on posterior engine
+(ROADMAP open item 3): a small table of padded compiled shapes
+(:mod:`.buckets`), a program cache that lands heterogeneous datasets on
+one compiled sweep without retracing (:mod:`.engine`), per-request
+state + checkpointing (:mod:`.jobs`), and a fair-share scheduler that
+multiplexes independent analyses as extra batch rows of one compiled
+program (:mod:`.service`).  Contracts and the gauge glossary live in
+``docs/SERVING.md``; the static zero-retrace contract is
+``contracts/serve_buckets.json``.
+"""
+
+from .buckets import BucketOverflow, BucketSpec, BucketTable, probe_shape
+from .engine import ProgramCache, SignatureMismatch, model_signature
+from .jobs import JOB_STATES, Job
+from .service import SamplerService
+
+__all__ = [
+    "BucketOverflow", "BucketSpec", "BucketTable", "probe_shape",
+    "ProgramCache", "SignatureMismatch", "model_signature",
+    "JOB_STATES", "Job", "SamplerService",
+]

@@ -1,0 +1,136 @@
+"""Bucketed padding: snap any dataset to a small table of compiled shapes.
+
+A compiled sweep is a function of the padded array geometry
+``(P_pad, TOA_pad, B_pad, K)`` — pulsar axis, TOA axis, basis axis,
+common-process frequency count.  Compiling per dataset means a cold
+XLA compile per request; compiling per *bucket* means a handful of
+programs total, each warmed once, with every request snapped up to the
+smallest covering bucket.  The padding is exact, not approximate: pad
+TOA rows carry ``y=0, T=0, sigma2=1`` with constant ``efac=1`` /
+``equad=-40`` (unit Nvec, zero masked log-likelihood), pad basis
+columns carry ``phi_base=1`` with ``basis_mask=0``, and pad pulsars are
+fully inert (``sampler/compiled.py`` conventions) — so a dataset run in
+a larger bucket samples the identical posterior.
+
+The first three axes pad; ``K`` does not.  The frequency count is
+structural (it sets the rho-block parameter count and the Fourier
+basis), so a bucket only covers datasets with exactly its ``modes``.
+
+Routing never over-pads silently and never reaches
+``compile_pta``'s shape errors: a dataset beyond the largest covering
+shape raises a typed :class:`BucketOverflow` carrying the nearest
+bucket so the caller can renegotiate (split the dataset, or provision
+a bigger table) instead of crashing mid-compile.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class BucketSpec:
+    """One compiled-program shape: pad targets per axis + exact mode
+    count.  Hashable (dict key of the program cache)."""
+
+    pulsars: int    # padded pulsar-axis length (compile_pta pad_pulsars)
+    toas: int       # padded TOA axis (compile_pta pad_toas -> Nmax)
+    basis: int      # padded basis axis (compile_pta pad_basis -> Bmax)
+    modes: int      # common-process frequency count K (exact match)
+
+    def covers(self, shape: "DatasetShape") -> bool:
+        return (self.pulsars >= shape.pulsars and self.toas >= shape.toas
+                and self.basis >= shape.basis
+                and self.modes == shape.modes)
+
+    def cost(self) -> int:
+        """Padded element count of the dominant (P, Nmax, Bmax) basis
+        tensor — the 'smallest covering bucket' ordering."""
+        return self.pulsars * self.toas * self.basis
+
+    def as_tuple(self):
+        return (self.pulsars, self.toas, self.basis, self.modes)
+
+
+@dataclasses.dataclass(frozen=True)
+class DatasetShape:
+    """The routed quantities of one dataset (see :func:`probe_shape`)."""
+
+    pulsars: int    # real pulsar count
+    toas: int       # largest per-pulsar TOA count
+    basis: int      # widest per-pulsar basis
+    modes: int      # common free-spectrum frequency count
+
+
+class BucketOverflow(ValueError):
+    """No bucket covers the dataset.
+
+    Carries the offending ``shape`` (:class:`DatasetShape`) and the
+    ``nearest`` bucket — the largest-capacity bucket with the right
+    mode count (or the largest overall when no bucket matches the mode
+    count) — so callers can report exactly which axis overflowed and by
+    how much instead of dying inside ``pad_pulsars``/``compile_pta``.
+    """
+
+    def __init__(self, shape: DatasetShape, nearest: BucketSpec | None):
+        self.shape = shape
+        self.nearest = nearest
+        near = (f"nearest bucket {nearest.as_tuple()}"
+                if nearest is not None else "empty table")
+        super().__init__(
+            f"dataset shape (P={shape.pulsars}, TOA={shape.toas}, "
+            f"B={shape.basis}, K={shape.modes}) exceeds every bucket; "
+            f"{near}")
+
+
+def probe_shape(pta) -> DatasetShape:
+    """Measure the routed quantities of a host PTA model: real pulsar
+    count, largest TOA count, widest basis, and the common
+    free-spectrum frequency count (the rho-block size)."""
+    from ..sampler.blocks import BlockIndex
+
+    models = [pta.model(ii) for ii in range(len(pta.pulsars))]
+    idx = BlockIndex.build(list(pta.param_names))
+    return DatasetShape(
+        pulsars=len(models),
+        toas=max(m.pulsar.ntoa for m in models),
+        basis=max(m.get_basis().shape[1] for m in models),
+        modes=int(len(idx.rho)))
+
+
+class BucketTable:
+    """An ordered set of :class:`BucketSpec` shapes with smallest-cover
+    routing."""
+
+    def __init__(self, buckets):
+        buckets = list(buckets)
+        if not buckets:
+            raise ValueError("BucketTable needs at least one bucket")
+        self.buckets = sorted(buckets, key=BucketSpec.cost)
+
+    @classmethod
+    def ladder(cls, modes, pulsars=(8, 46), toas=(128, 1024),
+               basis=None) -> "BucketTable":
+        """A simple doubling ladder: the cross product of the given
+        pulsar and TOA pads (basis defaults to a generous
+        ``tm + 2*modes*2`` per TOA tier)."""
+        if basis is None:
+            basis = tuple(20 + 4 * int(modes) for _ in toas)
+        out = []
+        for p in pulsars:
+            for t, b in zip(toas, basis):
+                out.append(BucketSpec(int(p), int(t), int(b), int(modes)))
+        return cls(out)
+
+    def route(self, shape: DatasetShape) -> BucketSpec:
+        """Smallest covering bucket, or raise :class:`BucketOverflow`
+        (typed, with the nearest bucket attached)."""
+        for b in self.buckets:          # sorted by cost: first hit wins
+            if b.covers(shape):
+                return b
+        same_k = [b for b in self.buckets if b.modes == shape.modes]
+        nearest = max(same_k or self.buckets, key=BucketSpec.cost)
+        raise BucketOverflow(shape, nearest)
+
+    def route_pta(self, pta) -> BucketSpec:
+        return self.route(probe_shape(pta))

@@ -1,0 +1,239 @@
+"""Program cache + batch-row multiplexed sweep engine.
+
+Zero-retrace across datasets rests on two facts about the
+``CompiledPTA`` pytree (``sampler/compiled.py``):
+
+1. jit cache keys compare the static aux data by *box identity*
+   (``_StaticBox.__hash__ = id``), so two CompiledPTA instances — even
+   with equal static values — miss each other's cache entries;
+2. everything trace-relevant that is NOT in the box is an array leaf,
+   and the padding conventions make bucket-forced shapes exact.
+
+So the cache keeps one *canonical* CompiledPTA per (bucket, model
+signature) and grafts its box onto every later dataset compiled into
+the same bucket (:func:`adopt_static`) — after verifying that every
+static field a traced kernel can read (shapes, counts, kinds, prior
+bounds, Gibbs block indices) is value-identical.  ``param_names`` may
+differ (host-only labels); anything else differing is a
+:class:`SignatureMismatch`, never a silent wrong-constant graft.
+
+Multiplexing then stacks T grafted CompiledPTAs leaf-wise
+(:func:`stack_cms`) and runs one jitted chunk that ``lax.scan``s sweeps
+of ``jax.vmap(sharded_sweep_step)`` over the tenant axis — tenants ride
+the vmap axis the way chains do, mathematically independent rows (vmap
+introduces no cross-row ops), so a tenant's chain is bitwise identical
+whether it runs solo or next to others, and admission/eviction between
+chunks swaps leaf *data* under the same treedef + box → the jit cache
+hits every time.
+
+Per-tenant PRNG streams extend the repo policy
+``fold_in(fold_in(base_key, iteration), chain)``: each tenant carries
+its own base key (host-derived ``fold_in(service_key, tenant_id)``),
+the chunk folds ``(iteration, 0)`` in-trace, and the step splits — the
+stream is a pure function of (tenant key, absolute iteration), so row
+placement, chunk grid, and co-residents are all bitwise-irrelevant.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class SignatureMismatch(ValueError):
+    """Two CompiledPTAs cannot share a compiled program: a
+    trace-relevant static field differs."""
+
+
+#: static fields a traced kernel reads (directly or as baked constants);
+#: value equality is required before a box graft.  ``param_names`` is
+#: deliberately absent — host-only labels.
+_GRAFT_EQ_FIELDS = (
+    "P", "P_real", "Nmax", "Bmax", "nx", "K", "Kr", "widths",
+    "gw_kind", "red_kind", "orf_name", "red_shares_gw",
+    "rhomin", "rhomax", "red_rhomin", "red_rhomax",
+)
+
+#: optional array fields whose None-ness changes the pytree structure
+_NONEABLE_FIELDS = ("orf_Ginv", "gp_mask", "red_f", "red_df", "orf_B",
+                    "orf_par_ix", "ke_eid", "ke_par_ix")
+
+
+def model_signature(cm) -> tuple:
+    """Hashable trace-relevant identity of a CompiledPTA: two models
+    with equal signatures (plus equal Gibbs block indices, verified at
+    graft time) compile to the same program under one static box."""
+    return (
+        tuple((f, getattr(cm, f)) for f in _GRAFT_EQ_FIELDS),
+        ("dtype", np.dtype(cm.dtype).name),
+        ("cdtype", np.dtype(cm.cdtype).name),
+        ("components", tuple(c.kind for c in cm.components)),
+        ("none", tuple(getattr(cm, f) is None for f in _NONEABLE_FIELDS)),
+    )
+
+
+def adopt_static(cm, canon):
+    """Graft ``canon``'s static box onto ``cm`` so the two share every
+    jit cache entry.  Verifies the full trace-relevant static surface
+    first; raises :class:`SignatureMismatch` on any difference."""
+    sig, csig = model_signature(cm), model_signature(canon)
+    if sig != csig:
+        diff = [a for a, b in zip(sig, csig) if a != b]
+        raise SignatureMismatch(
+            f"cannot share a compiled program: {diff!r}")
+    # Gibbs block positions are baked into traced gathers (mh_scan runs
+    # over cm.idx.white as a constant) — value equality required even
+    # though the names behind them differ per dataset
+    for f in ("rho", "red", "red_rho", "white", "ecorr", "orf"):
+        if not np.array_equal(getattr(cm.idx, f), getattr(canon.idx, f)):
+            raise SignatureMismatch(
+                f"Gibbs block index '{f}' differs between datasets "
+                "with equal shape signatures")
+    from jax import tree_util
+
+    tree_util.tree_flatten(canon)       # memoize the canonical box
+    cm.__dict__["_staticbox"] = canon.__dict__["_staticbox"]
+    return cm
+
+
+def compile_bucket(pta, bucket):
+    """Compile ``pta`` at the bucket's padded geometry (exact by the
+    pad-inertness conventions; see :mod:`.buckets`)."""
+    from ..sampler.compiled import compile_pta
+
+    return compile_pta(pta, pad_pulsars=int(bucket.pulsars),
+                       pad_toas=int(bucket.toas),
+                       pad_basis=int(bucket.basis))
+
+
+def stack_cms(cms):
+    """Stack T grafted CompiledPTAs into one batched pytree (leaves gain
+    a leading tenant axis).  All members must share one treedef — i.e.
+    one canonical box (:func:`adopt_static`) — or the stack raises
+    :class:`SignatureMismatch` instead of silently retracing."""
+    import jax.numpy as jnp
+    from jax import tree_util
+
+    flat0, treedef0 = tree_util.tree_flatten(cms[0])
+    cols = [flat0]
+    for cm in cms[1:]:
+        flat, treedef = tree_util.tree_flatten(cm)
+        if treedef != treedef0:
+            raise SignatureMismatch(
+                "stacked CompiledPTAs have different treedefs — "
+                "adopt_static() was skipped or failed")
+        for a, b in zip(flat0, flat):
+            a, b = np.asarray(a), np.asarray(b)
+            if a.shape != b.shape or a.dtype != b.dtype:
+                raise SignatureMismatch(
+                    f"stacked leaf aval mismatch: {a.shape}/{a.dtype} "
+                    f"vs {b.shape}/{b.dtype}")
+        cols.append(flat)
+    leaves = [jnp.stack([c[i] for c in cols], axis=0)
+              for i in range(len(flat0))]
+    return tree_util.tree_unflatten(treedef0, leaves)
+
+
+def mux_body(chunk: int):
+    """The raw (unjitted) multiplexed chunk: ``lax.scan`` of
+    ``vmap(sharded_sweep_step)`` over the tenant axis.
+
+    ``mux(cm_stack, x, b, tkeys, it0) -> (x, b, xs, bs)`` with
+    ``x (T, nx)``, ``b (T, P, Bmax)``, ``tkeys (T,)`` typed keys,
+    ``it0 (T,) int32`` per-tenant absolute iteration of the chunk start
+    (tenants admitted at different times run at different absolute
+    iterations in the same chunk).  ``xs``/``bs`` record every sweep:
+    ``(chunk, T, ...)``.  Exposed unjitted so jaxprcheck can trace the
+    same program the service runs (``contracts/serve_buckets.json``).
+    """
+    import jax
+    import jax.numpy as jnp
+    import jax.random as jr
+
+    from ..sampler import jax_backend as jb
+
+    n = int(chunk)
+
+    def mux(cm_stack, x, b, tkeys, it0):
+        def sweep(carry, s):
+            x, b = carry
+            keys = jax.vmap(
+                lambda kk, i0: jr.fold_in(jr.fold_in(kk, i0 + s), 0)
+            )(tkeys, it0)
+            x, b = jax.vmap(jb.sharded_sweep_step)(cm_stack, x, b, keys)
+            return (x, b), (x, b)
+
+        (x, b), (xs, bs) = jax.lax.scan(
+            sweep, (x, b), jnp.arange(n, dtype=jnp.int32))
+        return x, b, xs, bs
+
+    return mux
+
+
+def make_mux(chunk: int):
+    """The jitted :func:`mux_body` with the (x, b) carries donated — the
+    scheduler threads them as device-resident carries between chunks."""
+    import jax
+
+    return jax.jit(mux_body(chunk), donate_argnums=(1, 2))
+
+
+def make_init():
+    """Jitted fresh-tenant b-init: one conditional draw at the reserved
+    iteration 0 (the recorded sweeps start at absolute iteration 1), so
+    no sweep ever sees the degenerate ``b = 0`` state the drivers also
+    avoid."""
+    import jax
+
+    from ..sampler import jax_backend as jb
+
+    def init_b(cm, x, key):
+        return jb.draw_b_fn(cm, x, key)
+
+    return jax.jit(init_b)
+
+
+class ProgramCache:
+    """Canonical statics + jitted programs, keyed by (bucket, model
+    signature).  ``hits``/``misses`` count admissions that found /
+    created a canonical entry — the ``warm_hit_rate`` gauge."""
+
+    def __init__(self):
+        self._canon: dict = {}
+        self._mux: dict = {}
+        self._init = None
+        self.hits = 0
+        self.misses = 0
+
+    def adopt(self, bucket, cm):
+        """Register ``cm`` under its (bucket, signature); graft the
+        canonical box when one exists.  Returns ``(cm, warm)`` where
+        ``warm`` is True on a cache hit."""
+        key = (bucket, model_signature(cm))
+        canon = self._canon.get(key)
+        if canon is None:
+            self._canon[key] = cm
+            self.misses += 1
+            return cm, False
+        adopt_static(cm, canon)
+        self.hits += 1
+        return cm, True
+
+    def canonical(self, bucket, cm):
+        """The canonical CompiledPTA sharing ``cm``'s program (used for
+        inert filler rows in partially occupied stacks)."""
+        return self._canon[(bucket, model_signature(cm))]
+
+    def mux(self, chunk: int):
+        fn = self._mux.get(int(chunk))
+        if fn is None:
+            fn = self._mux[int(chunk)] = make_mux(chunk)
+        return fn
+
+    def init_fn(self):
+        if self._init is None:
+            self._init = make_init()
+        return self._init
+
+    def warm_hit_rate(self) -> float:
+        tot = self.hits + self.misses
+        return (self.hits / tot) if tot else 0.0

@@ -1,0 +1,149 @@
+"""Per-request state: lifecycle, PRNG identity, per-job checkpoints.
+
+A job's randomness is fully determined by ``(service_seed,
+tenant_id)``: the tenant base key is ``fold_in(key(service_seed),
+tenant_id)`` and every sweep folds the absolute iteration in-trace —
+so a job resumed after eviction, crash, or in a fresh process replays
+bit-identically, and two jobs never share a stream.
+
+Each job owns a checkpoint directory with the standard verified set
+(``ChainStore``: chain.npy / bchain.npy / adapt.npz + manifest.json +
+rotating ``.bak``), so the whole integrity / rollback / reshard
+machinery of ``runtime/`` applies per request.  ``adapt.npz`` carries
+the device carries ``(x, b)`` and the iteration count; the manifest's
+``serve`` section records the identity needed to readmit the job
+anywhere (:func:`Job.manifest_extra`).
+
+States (mapped onto the supervisor failure taxonomy by the service):
+
+- ``queued``    waiting for a batch-row slot
+- ``warming``   bucket routing / compile / graft / b-init in progress
+- ``sampling``  resident: riding the vmap axis of the compiled sweep
+- ``draining``  preemption drain: checkpointing to a verified set
+- ``done``      niter recorded rows checkpointed
+- ``failed``    terminal failure (``Job.failure`` carries the class)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+import numpy as np
+
+JOB_STATES = ("queued", "warming", "sampling", "draining", "done", "failed")
+
+
+@dataclasses.dataclass
+class Job:
+    """One analysis request and its runtime state."""
+
+    job_id: str
+    pta: object
+    niter: int
+    tenant_id: int
+    outdir: str
+    state: str = "queued"
+    failure: str | None = None
+
+    # routing / compiled artifacts (populated at admission)
+    bucket: object = None
+    cm: object = None            # grafted CompiledPTA
+    store: object = None         # ChainStore over outdir
+
+    # progress
+    it: int = 0                  # recorded rows so far
+    chain: np.ndarray | None = None    # (niter, nx) float64
+    bchain: np.ndarray | None = None   # (niter, P*Bmax) float64
+    x: np.ndarray | None = None        # (nx,) current state
+    b: np.ndarray | None = None        # (P, Bmax) current coefficients
+    retries: int = 0
+    chunks_resident: int = 0     # chunks since last admission (fair share)
+
+    # SLO bookkeeping
+    submitted_at: float = dataclasses.field(default_factory=time.monotonic)
+    first_sample_at: float | None = None
+    admitted_at: float | None = None
+
+    def set_state(self, state: str):
+        if state not in JOB_STATES:
+            raise ValueError(f"unknown job state {state!r}")
+        self.state = state
+
+    @property
+    def done(self) -> bool:
+        return self.it >= self.niter
+
+    def time_to_first_sample_ms(self) -> float | None:
+        if self.first_sample_at is None:
+            return None
+        return 1e3 * (self.first_sample_at - self.submitted_at)
+
+    # -- checkpointing ------------------------------------------------------
+
+    def open_store(self):
+        """Create the per-job ChainStore (writes the pars sidecars that
+        ``integrity.load_resume`` reconstructs the store from)."""
+        from ..sampler.chains import ChainStore
+
+        cm = self.cm
+        bnames = [f"b_p{p}_c{j}" for p in range(cm.P)
+                  for j in range(cm.Bmax)]
+        self.store = ChainStore(self.outdir, list(self.pta.param_names),
+                                bnames)
+        return self.store
+
+    def manifest_extra(self) -> dict:
+        """Identity the next incarnation needs to readmit this job with
+        the same PRNG stream and progress accounting."""
+        return {"serve": {
+            "job_id": self.job_id,
+            "tenant_id": int(self.tenant_id),
+            "niter": int(self.niter),
+            "bucket": list(self.bucket.as_tuple()),
+            "state": self.state,
+        }}
+
+    def adapt_state(self) -> dict:
+        # ChainStore.save stamps ``iter`` itself (from ``upto``)
+        return {
+            "x": np.asarray(self.x, np.float64),
+            "b": np.asarray(self.b, np.float64),
+            "tenant_id": np.asarray(self.tenant_id, np.int64),
+        }
+
+    def checkpoint(self):
+        """Persist rows [0, it) + carries through the verified-save
+        protocol (tmp+replace per file, manifest last, ``.bak``
+        rotation)."""
+        self.store.save(self.chain[:self.it], self.bchain[:self.it],
+                        self.it, adapt_state=self.adapt_state(),
+                        extra=self.manifest_extra())
+
+    def try_resume(self) -> bool:
+        """Load a verified checkpoint from ``outdir`` if one exists
+        (``integrity.load_resume`` semantics: manifest verification,
+        ``.bak`` rollback, ``CheckpointError`` when unrecoverable).
+        Returns True when progress was restored."""
+        from ..runtime import integrity
+
+        got = integrity.load_resume(self.outdir)
+        if got is None:
+            return False
+        chain, bchain, upto, adapt = got
+        if int(adapt["tenant_id"]) != int(self.tenant_id):
+            raise RuntimeError(
+                f"checkpoint in {self.outdir} belongs to tenant "
+                f"{int(adapt['tenant_id'])}, not {self.tenant_id} — "
+                "refusing a stream-crossing resume")
+        self.it = int(upto)
+        self.chain[:self.it] = chain[:self.it]
+        self.bchain[:self.it] = bchain[:self.it]
+        self.x = np.asarray(adapt["x"], np.float64)
+        self.b = np.asarray(adapt["b"], np.float64)
+        return True
+
+    def alloc(self, nx: int, nb: int):
+        """Host record buffers (f64, like the facade's)."""
+        self.chain = np.zeros((self.niter, nx), np.float64)
+        self.bchain = np.zeros((self.niter, nb), np.float64)

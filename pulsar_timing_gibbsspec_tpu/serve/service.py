@@ -1,0 +1,475 @@
+"""Fair-share scheduler: requests → batch rows of one compiled sweep.
+
+The service owns T resident *slots* (the tenant/vmap axis width of the
+compiled program, fixed at construction so occupancy changes never
+change shapes), a FIFO queue, and the :class:`~.engine.ProgramCache`.
+Each :meth:`step` runs one multiplexed chunk for the resident jobs,
+with admission/eviction strictly *between* chunks:
+
+- **admission** fills free slots from the queue head.  All residents
+  must share one (bucket, model-signature) program; a queued job that
+  routes elsewhere waits until the current group drains (its compile
+  still happens once, at first consideration, and is cached).
+- **fair share** when the queue is non-empty, a resident that has held
+  its slot for ``quantum`` chunks is checkpointed and requeued
+  (``tenant_evictions`` gauge) — no request can starve the queue.
+- **empty slots** carry an inert filler row (the bucket's canonical
+  model with a fixed filler stream): rows are mathematically
+  independent under vmap, so fillers cost compute but never touch a
+  tenant's values, and the program never retraces for occupancy.
+
+Failure handling maps onto the supervisor taxonomy
+(``runtime/supervisor.classify_failure``): ``user`` errors re-raise
+immediately, a non-finite chunk row fails that job alone
+(``divergence``), and device/crash classes retry the whole step with
+deterministic backoff after reverting every resident to its verified
+checkpoint — each retry replays bit-exactly from the last save, so
+recovery is bounded by ``save_every`` chunks.  A preemption drain
+(``runtime/preemption``) checkpoints every resident to a verified set,
+marks the drain, and raises :class:`~..runtime.preemption.Preempted`
+(``EXIT_PREEMPTED=75`` semantics preserved per job: every in-flight
+request resumes from its own directory).
+
+Chaos seam: ``faults.fire("serve.chunk", row=<global chunk>)`` runs
+before every dispatch, and ``faults.tenant_evict_request`` forces an
+eviction — the ``tenant_evict`` drill in ``tools/chaos_probe.py``.
+"""
+
+from __future__ import annotations
+
+import time
+from pathlib import Path
+
+import numpy as np
+
+from ..runtime import faults, preemption, supervisor, telemetry
+from .buckets import BucketOverflow, BucketTable, probe_shape
+from .engine import ProgramCache, compile_bucket, stack_cms
+from .jobs import Job
+
+#: tenant index of the inert filler stream (far above any real tenant)
+FILLER_TENANT = 0x7FFFFFFF
+
+
+class SamplerService:
+    """Resident multi-tenant sampler over one device program.
+
+    ``slots`` is the tenant-axis width (compiled once per bucket);
+    ``chunk`` the sweeps per dispatch; ``save_every`` the checkpoint
+    cadence in chunks; ``quantum`` the fair-share slice in chunks.
+    """
+
+    def __init__(self, root, table: BucketTable, *, slots=2, chunk=4,
+                 save_every=1, quantum=8, service_seed=0, max_retries=2,
+                 backoff_base=0.0, cache: ProgramCache | None = None):
+        self.root = Path(root)
+        self.root.mkdir(parents=True, exist_ok=True)
+        self.table = table
+        self.slots = int(slots)
+        self.chunk = int(chunk)
+        self.save_every = max(1, int(save_every))
+        self.quantum = max(1, int(quantum))
+        self.service_seed = int(service_seed)
+        self.max_retries = int(max_retries)
+        self.backoff_base = float(backoff_base)
+
+        # a caller-supplied cache lets a successor service (warm restart
+        # in the same process) reuse the predecessor's compiled programs
+        self.cache = ProgramCache() if cache is None else cache
+        self.jobs: dict[str, Job] = {}
+        self.queue: list[Job] = []
+        self.residents: list[Job | None] = [None] * self.slots
+        self.global_chunk = 0
+        self._active = None          # (bucket, signature) of residents
+        self._dirty = True           # membership changed since last stack
+        self._stack = None
+        self._X = self._B = self._K = None
+        self._warmed: set = set()    # (chunk, active) combos already compiled
+        self._fillers: dict = {}     # active-key -> (x, b) host filler state
+        self._evictions = 0
+        self._compile_stalls = 0
+        self._next_tenant = 0
+        self._retries = 0
+
+    # -- request intake -----------------------------------------------------
+
+    def submit(self, pta, niter, job_id=None, tenant_id=None,
+               outdir=None) -> Job:
+        """Queue an analysis request.  ``tenant_id`` (with the service
+        seed) IS the PRNG identity — pass the original value to readmit
+        a job in a fresh process, or leave None for a new stream."""
+        if job_id is None:
+            job_id = f"job{len(self.jobs):04d}"
+        if job_id in self.jobs:
+            raise ValueError(f"duplicate job_id {job_id!r}")
+        if tenant_id is None:
+            tenant_id = self._next_tenant
+        self._next_tenant = max(self._next_tenant, int(tenant_id) + 1)
+        if outdir is None:
+            outdir = self.root / job_id
+        job = Job(job_id=job_id, pta=pta, niter=int(niter),
+                  tenant_id=int(tenant_id), outdir=str(outdir))
+        self.jobs[job_id] = job
+        self.queue.append(job)
+        telemetry.gauge("queue_depth", float(len(self.queue)))
+        return job
+
+    # -- PRNG / state derivation -------------------------------------------
+
+    def _service_key(self):
+        import jax.random as jr
+
+        return jr.key(self.service_seed)
+
+    def _tenant_key(self, tenant_id):
+        import jax.random as jr
+
+        return jr.fold_in(self._service_key(), int(tenant_id))
+
+    def _init_key(self, tenant_id):
+        """Reserved iteration-0 key for the fresh-tenant b draw."""
+        import jax.random as jr
+
+        return jr.fold_in(jr.fold_in(self._tenant_key(tenant_id), 0), 0)
+
+    def _x0(self, job) -> np.ndarray:
+        """Deterministic per-(service_seed, tenant) initial state — part
+        of the stream identity, so solo and multiplexed runs agree."""
+        rng = np.random.default_rng([self.service_seed,
+                                     int(job.tenant_id)])
+        return np.asarray(job.pta.initial_sample(rng), np.float64)
+
+    # -- admission / eviction ----------------------------------------------
+
+    def _prepare(self, job) -> bool:
+        """Route + compile + graft (idempotent; cached on the job).
+        Returns False after marking the job failed on a routing error."""
+        if job.cm is not None:
+            return True
+        job.set_state("warming")
+        try:
+            job.bucket = self.table.route(probe_shape(job.pta))
+        except BucketOverflow as exc:
+            job.failure = f"overflow: {exc}"
+            job.set_state("failed")
+            return False
+        from ..analysis import guards
+
+        # staging a new dataset compiles small host->device programs;
+        # mark them planned so retrace accounting only sees the sweep
+        with guards.planned_compile():
+            cm = compile_bucket(job.pta, job.bucket)
+            cm, warm = self.cache.adopt(job.bucket, cm)
+        job.cm = cm
+        if not warm:
+            self._compile_stalls += 1
+            telemetry.gauge("compile_stalls", float(self._compile_stalls))
+        telemetry.gauge("warm_hit_rate", self.cache.warm_hit_rate())
+        return True
+
+    def _group_key(self, job):
+        from .engine import model_signature
+
+        return (job.bucket, model_signature(job.cm))
+
+    def _admit(self, job, slot):
+        import jax.numpy as jnp
+
+        from ..analysis import guards
+
+        job.set_state("warming")
+        cm = job.cm
+        if job.chain is None:
+            job.alloc(cm.nx, cm.P * cm.Bmax)
+        if job.store is None:
+            job.open_store()
+            if not job.try_resume():
+                job.x = self._x0(job)
+                with guards.planned_compile():
+                    b = self.cache.init_fn()(
+                        cm, jnp.asarray(job.x, cm.cdtype),
+                        self._init_key(job.tenant_id))
+                job.b = np.asarray(b, np.float64)
+        job.chunks_resident = 0
+        job.admitted_at = time.monotonic()
+        self.residents[slot] = job
+        job.set_state("sampling")
+        self._dirty = True
+
+    def _evict(self, slot, reason):
+        job = self.residents[slot]
+        job.checkpoint()
+        job.set_state("queued")
+        self.residents[slot] = None
+        self.queue.append(job)
+        self._evictions += 1
+        telemetry.gauge("tenant_evictions", float(self._evictions))
+        telemetry.gauge("queue_depth", float(len(self.queue)))
+        self._dirty = True
+
+    def _admissions(self):
+        """Fill free slots from the queue head, constrained to one
+        (bucket, signature) group at a time."""
+        if not any(self.residents):
+            self._active = None
+        for slot in range(self.slots):
+            if self.residents[slot] is not None:
+                continue
+            take = None
+            for job in self.queue:
+                if not self._prepare(job):
+                    continue            # failed routing; skip
+                key = self._group_key(job)
+                if self._active is None:
+                    self._active = key
+                if key == self._active:
+                    take = job
+                    break
+            if take is None:
+                break
+            self.queue.remove(take)
+            self.queue[:] = [j for j in self.queue
+                             if j.state != "failed"]
+            telemetry.gauge("queue_depth", float(len(self.queue)))
+            self._admit(take, slot)
+        # drop failed-routing jobs that never got picked
+        self.queue[:] = [j for j in self.queue if j.state != "failed"]
+
+    # -- filler rows --------------------------------------------------------
+
+    def _filler_state(self, canon):
+        """Host (x, b) for the inert filler stream of the active group
+        (prior-midpoint state, reserved-iteration b draw)."""
+        key = self._active
+        got = self._fillers.get(key)
+        if got is not None:
+            return got
+        import jax.numpy as jnp
+
+        from ..analysis import guards
+
+        pa = np.asarray(canon.pa, np.float64)
+        pb = np.asarray(canon.pb, np.float64)
+        pk = np.asarray(canon.pkind, np.int64)
+        # uniform/linexp: bound midpoint; normal: the mean (pa)
+        x = np.where(pk == 1, pa, 0.5 * (pa + pb))
+        with guards.planned_compile():
+            b = self.cache.init_fn()(
+                canon, jnp.asarray(x, canon.cdtype),
+                self._init_key(FILLER_TENANT))
+        got = (x, np.asarray(b, np.float64))
+        self._fillers[key] = got
+        return got
+
+    # -- the multiplexed chunk ---------------------------------------------
+
+    def _build_stack(self):
+        import jax.numpy as jnp
+
+        live = [j for j in self.residents if j is not None]
+        canon = self.cache.canonical(live[0].bucket, live[0].cm)
+        fx, fb = self._filler_state(canon)
+        cms, X, B, K = [], [], [], []
+        for job in self.residents:
+            if job is not None:
+                cms.append(job.cm)
+                X.append(job.x)
+                B.append(job.b)
+                K.append(self._tenant_key(job.tenant_id))
+            else:
+                cms.append(canon)
+                X.append(fx)
+                B.append(fb)
+                K.append(self._tenant_key(FILLER_TENANT))
+        cdtype = canon.cdtype
+        self._stack = stack_cms(cms)
+        self._X = jnp.asarray(np.stack(X), cdtype)
+        self._B = jnp.asarray(np.stack(B), cdtype)
+        self._K = jnp.stack(K)
+        self._dirty = False
+
+    def _it0(self):
+        import jax.numpy as jnp
+
+        vals = [(j.it + 1) if j is not None else 1
+                for j in self.residents]
+        return jnp.asarray(vals, jnp.int32)
+
+    def _dispatch(self):
+        """One compiled multiplexed chunk; scatter rows to job buffers."""
+        from ..analysis import guards
+
+        if self._dirty:
+            # membership change: restacking compiles small staging
+            # programs (jnp.stack per leaf) — planned, not a retrace
+            with guards.planned_compile():
+                self._build_stack()
+        mux = self.cache.mux(self.chunk)
+        warm_key = (self.chunk, self._active)
+        if warm_key not in self._warmed:
+            with guards.planned_compile():
+                args = (self._stack, self._X, self._B, self._K,
+                        self._it0())
+                X, B, xs, bs = mux(*args)
+            self._warmed.add(warm_key)
+        else:
+            # the zero-retrace contract lives HERE: a steady chunk with
+            # a warmed (chunk, group) must compile nothing
+            X, B, xs, bs = mux(self._stack, self._X, self._B, self._K,
+                               self._it0())
+        self._X, self._B = X, B
+        np_xs = np.asarray(xs, np.float64)         # (chunk, T, nx)
+        np_bs = np.asarray(bs, np.float64)         # (chunk, T, P, Bmax)
+        now = time.monotonic()
+        for slot, job in enumerate(self.residents):
+            if job is None:
+                continue
+            rows = np_xs[:, slot]
+            brows = np_bs[:, slot].reshape(self.chunk, -1)
+            take = min(self.chunk, job.niter - job.it)
+            if not (np.isfinite(rows[:take]).all()
+                    and np.isfinite(brows[:take]).all()):
+                telemetry.incr("sentinel_trips")
+                job.failure = "divergence: non-finite chunk rows"
+                job.set_state("failed")
+                self.residents[slot] = None
+                self._dirty = True
+                continue
+            job.chain[job.it:job.it + take] = rows[:take]
+            job.bchain[job.it:job.it + take] = brows[:take]
+            job.it += take
+            job.x = rows[take - 1].copy()
+            job.b = np_bs[take - 1, slot].copy()
+            job.chunks_resident += 1
+            if job.first_sample_at is None:
+                job.first_sample_at = now
+                telemetry.gauge("time_to_first_sample_ms",
+                                job.time_to_first_sample_ms())
+
+    # -- drain / recovery ---------------------------------------------------
+
+    def _drain(self):
+        """Checkpoint every resident to a verified set and raise
+        ``Preempted`` — each job resumes from its own directory."""
+        from ..runtime import integrity
+
+        rows = 0
+        all_ok = True
+        for slot, job in enumerate(self.residents):
+            if job is None:
+                continue
+            job.set_state("draining")
+            job.checkpoint()
+            res = integrity.verify(job.store.outdir)
+            if not res["ok"]:
+                all_ok = integrity.rollback(job.store.outdir) and all_ok
+            rows += job.it
+            job.set_state("queued")     # resumable, not failed
+        preemption.mark_drained()
+        raise preemption.Preempted(
+            f"service drained {sum(1 for j in self.residents if j)} "
+            f"job(s) to per-job checkpoints", rows=rows, verified=all_ok)
+
+    def _revert_residents(self):
+        """Roll every resident back to its last verified checkpoint
+        (retry path: the replay from there is bit-exact)."""
+        for slot, job in enumerate(self.residents):
+            if job is None:
+                continue
+            job.it = 0
+            if not job.try_resume():
+                job.x = self._x0(job)
+                import jax.numpy as jnp
+
+                from ..analysis import guards
+
+                with guards.planned_compile():
+                    b = self.cache.init_fn()(
+                        job.cm, jnp.asarray(job.x, job.cm.cdtype),
+                        self._init_key(job.tenant_id))
+                job.b = np.asarray(b, np.float64)
+        self._dirty = True
+
+    # -- scheduler loop -----------------------------------------------------
+
+    def step(self) -> bool:
+        """One scheduling round: seam, churn, admission, one chunk,
+        checkpoints.  Returns False when there is nothing to run."""
+        if preemption.drain_requested() and any(self.residents):
+            self._drain()
+        self.global_chunk += 1
+        faults.fire("serve.chunk", row=self.global_chunk)
+        if faults.tenant_evict_request(row=self.global_chunk):
+            for slot, job in enumerate(self.residents):
+                if job is not None:
+                    self._evict(slot, "injected")
+                    break
+        # fair share: the longest-resident tenant yields to a non-empty
+        # queue after its quantum
+        if self.queue:
+            held = [(j.chunks_resident, s)
+                    for s, j in enumerate(self.residents) if j is not None]
+            if held:
+                most, slot = max(held)
+                if most >= self.quantum:
+                    self._evict(slot, "quantum")
+        self._admissions()
+        if not any(self.residents):
+            return False
+        self._dispatch()
+        for slot, job in enumerate(self.residents):
+            if job is None:
+                continue
+            if job.done:
+                job.checkpoint()
+                job.set_state("done")
+                self.residents[slot] = None
+                self._dirty = True
+            elif job.chunks_resident % self.save_every == 0:
+                job.checkpoint()
+        telemetry.gauge("queue_depth", float(len(self.queue)))
+        return True
+
+    def run(self) -> dict:
+        """Drive every submitted job to done/failed.  Retries
+        retryable step failures (device/crash/stall classes) with
+        deterministic backoff after reverting residents to their
+        checkpoints; re-raises ``user`` errors and ``Preempted``."""
+        while True:
+            try:
+                worked = self.step()
+            except preemption.Preempted:
+                raise
+            except Exception as exc:             # noqa: BLE001
+                cls = supervisor.classify_failure(exc)
+                if cls in ("user", "unknown") \
+                        or self._retries >= self.max_retries:
+                    raise
+                self._retries += 1
+                telemetry.incr("retries")
+                time.sleep(supervisor.backoff_delay(
+                    self._retries, base=self.backoff_base, jitter=0.0,
+                    seed=self.service_seed))
+                self._revert_residents()
+                continue
+            if not worked and not self.queue:
+                break
+        return self.report()
+
+    def report(self) -> dict:
+        jobs = {jid: {"state": j.state, "it": int(j.it),
+                      "tenant_id": int(j.tenant_id),
+                      "retries": int(j.retries),
+                      "failure": j.failure,
+                      "time_to_first_sample_ms":
+                          j.time_to_first_sample_ms()}
+                for jid, j in self.jobs.items()}
+        return {
+            "jobs": jobs,
+            "chunks": int(self.global_chunk),
+            "evictions": int(self._evictions),
+            "compile_stalls": int(self._compile_stalls),
+            "warm_hit_rate": self.cache.warm_hit_rate(),
+            "service_retries": int(self._retries),
+            "gauges": telemetry.gauges(),
+        }

@@ -1,0 +1,328 @@
+"""Serving-layer contracts (pulsar_timing_gibbsspec_tpu/serve/).
+
+The load-bearing claims, each tested here end-to-end on tiny synthetic
+datasets:
+
+- routing snaps a dataset to the SMALLEST covering bucket and refuses
+  anything larger with a typed :class:`BucketOverflow` carrying the
+  nearest bucket (never a crash inside ``compile_pta``);
+- heterogeneous datasets sharing one bucket share ONE compiled program
+  (warm cache hits, zero unplanned steady-phase retraces across
+  membership churn);
+- a tenant's chain is bitwise identical whether it runs solo,
+  multiplexed next to other tenants, in a different slot, or in a
+  wider service — the vmap-row independence + CRN stream identity
+  contract;
+- admission/eviction, service crash, and preemption drain all recover
+  every in-flight job bit-exactly from its own verified checkpoint
+  directory (``integrity.load_resume``).
+"""
+
+import numpy as np
+import pytest
+
+from pulsar_timing_gibbsspec_tpu.serve.buckets import (
+    BucketOverflow, BucketSpec, BucketTable, DatasetShape, probe_shape)
+
+NITER = 12
+
+
+def _mk(ntoa, seed, nmodes=3):
+    from pulsar_timing_gibbsspec_tpu.analysis.jaxprcheck.entries import (
+        build_model, synthetic_pulsars)
+
+    return build_model(synthetic_pulsars(2, ntoa, tm_cols=3, seed=seed),
+                       nmodes)
+
+
+_CACHE = None
+
+
+def _service(root, table, **kw):
+    """Fresh service sharing the module-wide program cache (the
+    warm-restart path: a successor process reusing compiled programs)
+    so the suite compiles each bucket/width once, not per service."""
+    global _CACHE
+    from pulsar_timing_gibbsspec_tpu.serve import ProgramCache, SamplerService
+
+    if _CACHE is None:
+        _CACHE = ProgramCache()
+    kw.setdefault("cache", _CACHE)
+    kw.setdefault("slots", 2)
+    kw.setdefault("chunk", 4)
+    kw.setdefault("quantum", 100)
+    return SamplerService(root, table, **kw)
+
+
+@pytest.fixture(scope="module")
+def ptas3():
+    """Three heterogeneous datasets (TOA counts 24/30/36, different
+    noise realizations) with identical structure -> one bucket."""
+    return [_mk(24, 0), _mk(30, 1), _mk(36, 2)]
+
+
+@pytest.fixture(scope="module")
+def table():
+    return BucketTable([BucketSpec(2, 40, 24, 3)])
+
+
+@pytest.fixture(scope="module")
+def solo_chains(ptas3, table, tmp_path_factory):
+    """Uninterrupted single-tenant baselines, one service each."""
+    base = tmp_path_factory.mktemp("serve_solo")
+    out = []
+    for i, pta in enumerate(ptas3):
+        svc = _service(base / f"s{i}", table)
+        job = svc.submit(pta, NITER, job_id=f"job{i}", tenant_id=i)
+        svc.run()
+        assert job.state == "done"
+        out.append((job.chain.copy(), job.bchain.copy()))
+    return out
+
+
+# -- routing ---------------------------------------------------------------
+
+def test_route_smallest_cover():
+    small, mid, big = (BucketSpec(2, 40, 24, 3), BucketSpec(4, 100, 30, 3),
+                       BucketSpec(8, 1000, 60, 3))
+    t = BucketTable([mid, small, big])
+    assert t.route(DatasetShape(2, 30, 20, 3)) == small
+    assert t.route(DatasetShape(3, 90, 28, 3)) == mid
+    assert t.route(DatasetShape(8, 1000, 60, 3)) == big
+
+
+def test_ladder_sorted_and_routes():
+    t = BucketTable.ladder(3, pulsars=(2, 4), toas=(64, 256))
+    costs = [b.cost() for b in t.buckets]
+    assert costs == sorted(costs)
+    assert t.route(DatasetShape(2, 100, 20, 3)).toas == 256
+
+
+def test_overflow_typed_with_nearest():
+    t = BucketTable([BucketSpec(2, 40, 24, 3)])
+    with pytest.raises(BucketOverflow) as ei:
+        t.route(DatasetShape(2, 41, 24, 3))
+    e = ei.value
+    assert isinstance(e, ValueError)          # typed, but catchable broadly
+    assert e.nearest == BucketSpec(2, 40, 24, 3)
+    assert e.shape.toas == 41
+    assert "TOA=41" in str(e) and "(2, 40, 24, 3)" in str(e)
+
+
+def test_overflow_prefers_same_mode_nearest():
+    k3, k5 = BucketSpec(2, 40, 24, 3), BucketSpec(2, 80, 24, 5)
+    t = BucketTable([k3, k5])
+    with pytest.raises(BucketOverflow) as ei:
+        t.route(DatasetShape(2, 50, 24, 3))   # K=3: only k3 is comparable
+    assert ei.value.nearest == k3
+
+
+def test_probe_shape_and_route_pta(ptas3, table):
+    s = probe_shape(ptas3[2])
+    assert (s.pulsars, s.toas, s.modes) == (2, 36, 3)
+    assert s.basis <= 24
+    assert table.route_pta(ptas3[2]) == table.buckets[0]
+
+
+def test_compile_pad_validation(ptas3):
+    from pulsar_timing_gibbsspec_tpu.sampler.compiled import compile_pta
+
+    with pytest.raises(ValueError, match="pad_toas"):
+        compile_pta(ptas3[0], pad_toas=8)
+    with pytest.raises(ValueError, match="pad_basis"):
+        compile_pta(ptas3[0], pad_basis=2)
+
+
+def test_signature_mismatch_refuses_graft(ptas3):
+    from pulsar_timing_gibbsspec_tpu.serve.engine import (
+        SignatureMismatch, adopt_static, compile_bucket)
+
+    a = compile_bucket(ptas3[0], BucketSpec(2, 40, 24, 3))
+    b = compile_bucket(ptas3[0], BucketSpec(2, 48, 24, 3))
+    with pytest.raises(SignatureMismatch):
+        adopt_static(b, a)                    # Nmax differs: no sharing
+
+
+# -- multiplexing ----------------------------------------------------------
+
+def test_multiplex_bitwise_and_zero_retrace(ptas3, table, solo_chains,
+                                            tmp_path):
+    """>= 3 heterogeneous datasets through one bucket, 2 concurrent
+    slots, forced fair-share churn: zero unplanned steady retraces and
+    every chain bitwise equal to its solo baseline (memory AND disk)."""
+    from pulsar_timing_gibbsspec_tpu.profiling import recompile_counter
+    from pulsar_timing_gibbsspec_tpu.runtime import telemetry
+    from pulsar_timing_gibbsspec_tpu.serve import ProgramCache
+
+    # own cache: warm_hit_rate must reflect THIS service's admissions
+    svc = _service(tmp_path / "mux", table, quantum=2,
+                   cache=ProgramCache())
+    with recompile_counter() as rc:
+        rc.phase("steady")
+        jobs = [svc.submit(p, NITER, job_id=f"job{i}", tenant_id=i)
+                for i, p in enumerate(ptas3)]
+        report = svc.run()
+    assert rc.unplanned("steady") == 0
+    assert report["evictions"] >= 1           # quantum=2 forced churn
+    assert report["warm_hit_rate"] == pytest.approx(2.0 / 3.0)
+    for i, job in enumerate(jobs):
+        assert job.state == "done"
+        np.testing.assert_array_equal(job.chain, solo_chains[i][0])
+        np.testing.assert_array_equal(job.bchain, solo_chains[i][1])
+        disk = np.load(tmp_path / "mux" / job.job_id / "chain.npy")
+        np.testing.assert_array_equal(disk, solo_chains[i][0])
+    gauges = telemetry.gauges()
+    for name in ("queue_depth", "warm_hit_rate", "compile_stalls",
+                 "tenant_evictions", "time_to_first_sample_ms"):
+        assert name in gauges
+
+
+def test_capacity_independence(ptas3, table, solo_chains, tmp_path):
+    """A wider service (3 slots: different compiled program, different
+    co-residents) produces bitwise-identical per-tenant chains."""
+    svc = _service(tmp_path / "wide", table, slots=3)
+    jobs = [svc.submit(p, NITER, job_id=f"job{i}", tenant_id=i)
+            for i, p in enumerate(ptas3)]
+    svc.run()
+    for i, job in enumerate(jobs):
+        assert job.state == "done"
+        np.testing.assert_array_equal(job.chain, solo_chains[i][0])
+
+
+# -- recovery --------------------------------------------------------------
+
+def test_eviction_midrun_resume(ptas3, table, solo_chains, tmp_path):
+    """A job checkpointed mid-run is loadable with the standalone
+    ``integrity.load_resume`` and a fresh service incarnation readmits
+    it bit-exactly."""
+    from pulsar_timing_gibbsspec_tpu.runtime import integrity
+
+    root = tmp_path / "resume"
+    svc = _service(root, table, save_every=1)
+    for i in range(2):
+        svc.submit(ptas3[i], NITER, job_id=f"job{i}", tenant_id=i)
+    assert svc.step()                         # one chunk: 4 rows each
+    got = integrity.load_resume(root / "job0")
+    assert got is not None
+    chain, bchain, upto, adapt = got
+    assert upto == 4
+    np.testing.assert_array_equal(chain[:upto], solo_chains[0][0][:upto])
+    assert int(adapt["tenant_id"]) == 0
+
+    svc2 = _service(root, table)              # fresh process semantics
+    jobs2 = [svc2.submit(ptas3[i], NITER, job_id=f"job{i}", tenant_id=i)
+             for i in range(2)]
+    svc2.run()
+    for i, job in enumerate(jobs2):
+        assert job.state == "done"
+        np.testing.assert_array_equal(job.chain, solo_chains[i][0])
+
+
+def test_resume_refuses_stream_crossing(tmp_path):
+    """A checkpoint written under one tenant stream must not seed a
+    different tenant's chain — the PRNG identity is (seed, tenant)."""
+    from pulsar_timing_gibbsspec_tpu.sampler.chains import ChainStore
+    from pulsar_timing_gibbsspec_tpu.serve.jobs import Job
+
+    store = ChainStore(tmp_path / "jobX", ["p0", "p1"], ["b0"])
+    store.save(np.ones((2, 2)), np.ones((2, 1)), 2,
+               adapt_state={"x": np.ones(2), "b": np.ones((1, 1)),
+                            "tenant_id": np.asarray(7, np.int64)})
+    job = Job(job_id="jobX", pta=None, niter=4, tenant_id=3,
+              outdir=str(tmp_path / "jobX"))
+    job.chain = np.zeros((4, 2))
+    job.bchain = np.zeros((4, 1))
+    with pytest.raises(RuntimeError, match="stream-crossing"):
+        job.try_resume()
+    job.tenant_id = 7
+    assert job.try_resume()
+    assert job.it == 2 and job.chain[:2].all()
+
+
+@pytest.mark.chaos
+def test_tenant_evict_crash_recovery(ptas3, table, solo_chains, tmp_path):
+    """Eviction churn + service death mid-multiplex: every in-flight
+    job resumes from its own verified checkpoint dir, bitwise."""
+    from pulsar_timing_gibbsspec_tpu.runtime import faults, integrity
+    from pulsar_timing_gibbsspec_tpu.runtime.faults import InjectedCrash
+
+    root = tmp_path / "mux"
+    faults.clear()
+    faults.inject("tenant_evict", point="serve.chunk", at_row=2, times=1)
+    faults.inject("crash", point="serve.chunk", at_row=3, times=1)
+    svc = _service(root, table, max_retries=0)
+    jobs = [svc.submit(p, NITER, job_id=f"job{i}", tenant_id=i)
+            for i, p in enumerate(ptas3)]
+    try:
+        with pytest.raises(InjectedCrash):
+            svc.run()
+    finally:
+        faults.clear()
+    in_flight = [j for j in jobs if 0 < j.it < NITER]
+    assert in_flight                          # the kill landed mid-run
+    for job in jobs:
+        if job.it > 0:
+            assert integrity.verify(root / job.job_id)["ok"]
+
+    svc2 = _service(root, table)
+    jobs2 = [svc2.submit(p, NITER, job_id=f"job{i}", tenant_id=i)
+             for i, p in enumerate(ptas3)]
+    svc2.run()
+    for i, job in enumerate(jobs2):
+        assert job.state == "done"
+        np.testing.assert_array_equal(job.chain, solo_chains[i][0])
+
+
+@pytest.mark.chaos
+def test_transient_device_error_retries(ptas3, table, solo_chains,
+                                        tmp_path):
+    """A transient device error at the chunk seam is classified
+    retryable; residents revert to their checkpoints and the replay is
+    bit-exact."""
+    from pulsar_timing_gibbsspec_tpu.runtime import faults
+
+    faults.clear()
+    faults.inject("xla_error", point="serve.chunk", at_row=2, times=1)
+    svc = _service(tmp_path / "retry", table, save_every=1)
+    jobs = [svc.submit(p, NITER, job_id=f"job{i}", tenant_id=i)
+            for i, p in enumerate(ptas3[:2])]
+    try:
+        report = svc.run()
+    finally:
+        faults.clear()
+    assert report["service_retries"] == 1
+    for i, job in enumerate(jobs):
+        assert job.state == "done"
+        np.testing.assert_array_equal(job.chain, solo_chains[i][0])
+
+
+def test_drain_preempted_per_job_checkpoints(ptas3, table, solo_chains,
+                                             tmp_path):
+    """A drain request checkpoints every resident to a verified set,
+    raises ``Preempted``, and a fresh incarnation resumes bitwise."""
+    from pulsar_timing_gibbsspec_tpu.runtime import integrity, preemption
+
+    root = tmp_path / "drain"
+    preemption.reset()
+    try:
+        svc = _service(root, table)
+        jobs = [svc.submit(p, NITER, job_id=f"job{i}", tenant_id=i)
+                for i, p in enumerate(ptas3)]
+        assert svc.step()
+        preemption.request_drain(reason="test")
+        with pytest.raises(preemption.Preempted) as ei:
+            svc.run()
+        assert ei.value.verified
+        for job in jobs:
+            if job.it > 0:
+                assert job.state == "queued"  # resumable, not failed
+                assert integrity.verify(root / job.job_id)["ok"]
+    finally:
+        preemption.reset()
+    svc2 = _service(root, table)
+    jobs2 = [svc2.submit(p, NITER, job_id=f"job{i}", tenant_id=i)
+             for i, p in enumerate(ptas3)]
+    svc2.run()
+    for i, job in enumerate(jobs2):
+        assert job.state == "done"
+        np.testing.assert_array_equal(job.chain, solo_chains[i][0])

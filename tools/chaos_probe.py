@@ -23,8 +23,14 @@ Scenarios (``--scenario``):
   extended chain is bitwise-identical to the uninterrupted 8-device
   baseline — the elasticity contract (jax backend, forces 8 virtual
   host devices).
+- ``tenant_evict``: the serving drill — three heterogeneous jobs
+  multiplexed through one bucket get churned by injected evictions and
+  then the whole service is killed mid-multiplex; a fresh incarnation
+  readmits every in-flight job from its own verified checkpoint dir and
+  each finishes bit-identical to its uninterrupted solo baseline (jax
+  backend).
 
-Usage: python tools/chaos_probe.py [--scenario fault|preempt|stall|reshard]
+Usage: python tools/chaos_probe.py [--scenario fault|preempt|stall|reshard|tenant_evict]
        [--fault kill|truncate|corrupt|nan|xla] [--niter N]
        [--save-every N] [--at-row N] [--devices N] [--outdir DIR]
 """
@@ -256,10 +262,84 @@ def scenario_reshard(args, base):
     }
 
 
+def scenario_tenant_evict(args, base):
+    """Service killed mid-multiplex; every in-flight job resumes from
+    its own verified checkpoint dir, bitwise vs solo baselines."""
+    from pulsar_timing_gibbsspec_tpu.analysis.jaxprcheck.entries import (
+        build_model, synthetic_pulsars)
+    from pulsar_timing_gibbsspec_tpu.runtime import (
+        faults, integrity, telemetry)
+    from pulsar_timing_gibbsspec_tpu.runtime.faults import InjectedCrash
+    from pulsar_timing_gibbsspec_tpu.serve import (
+        BucketSpec, BucketTable, SamplerService)
+
+    ptas = [build_model(synthetic_pulsars(2, ntoa, tm_cols=3, seed=i), 3)
+            for i, ntoa in enumerate((24, 30, 36))]
+    table = BucketTable([BucketSpec(2, 40, 24, 3)])
+    svc_kw = dict(slots=2, chunk=4, save_every=1)
+
+    solos = []
+    for i, pta in enumerate(ptas):
+        svc = SamplerService(base / f"solo{i}", table, **svc_kw)
+        job = svc.submit(pta, args.niter, job_id=f"job{i}", tenant_id=i)
+        svc.run()
+        solos.append(job.chain.copy())
+
+    # churn residency with injected evictions, then kill the service
+    # while >= 2 jobs are mid-flight (max_retries=0: the crash escapes)
+    telemetry.reset()
+    faults.clear()
+    mux_root = base / "mux"
+    faults.inject("tenant_evict", point="serve.chunk", at_row=2, times=2)
+    faults.inject("crash", point="serve.chunk", at_row=args.at_row,
+                  times=1)
+    svc = SamplerService(mux_root, table, max_retries=0, **svc_kw)
+    jobs = [svc.submit(pta, args.niter, job_id=f"job{i}", tenant_id=i)
+            for i, pta in enumerate(ptas)]
+    died = False
+    try:
+        svc.run()
+    except InjectedCrash:
+        died = True
+    finally:
+        faults.clear()
+    evictions = int(telemetry.get_gauge("tenant_evictions") or 0)
+    in_flight = [j.job_id for j in jobs if 0 < j.it < args.niter]
+    rows_at_kill = {j.job_id: int(j.it) for j in jobs}
+    checkpoints = {j.job_id: integrity.verify(mux_root / j.job_id)
+                   for j in jobs if j.it > 0}
+
+    # fresh incarnation: resubmit the same identities, run to done
+    svc2 = SamplerService(mux_root, table, **svc_kw)
+    jobs2 = [svc2.submit(pta, args.niter, job_id=f"job{i}", tenant_id=i)
+             for i, pta in enumerate(ptas)]
+    svc2.run()
+    bitwise = {j.job_id: bool(np.array_equal(j.chain, solos[i])
+                              and np.array_equal(
+                                  np.load(mux_root / j.job_id / "chain.npy"),
+                                  solos[i]))
+               for i, j in enumerate(jobs2)}
+    ok = (died and evictions >= 1 and len(in_flight) >= 2
+          and all(v["ok"] for v in checkpoints.values())
+          and all(j.state == "done" for j in jobs2)
+          and all(bitwise.values()))
+    return ok, {
+        "service_died": died,
+        "tenant_evictions": evictions,
+        "in_flight_at_kill": in_flight,
+        "checkpoints_verified": {k: v["ok"] for k, v in checkpoints.items()},
+        "resumed_states": {j.job_id: j.state for j in jobs2},
+        "bitwise_recovery": bitwise,
+        "rows_at_kill": rows_at_kill,
+    }
+
+
 SCENARIOS = {"fault": scenario_fault, "preempt": scenario_preempt,
-             "stall": scenario_stall, "reshard": scenario_reshard}
+             "stall": scenario_stall, "reshard": scenario_reshard,
+             "tenant_evict": scenario_tenant_evict}
 #: jax-backed scenarios run chunked; small defaults keep them quick
-_JAX_DEFAULTS = {"stall": (16, 4), "reshard": (16, 4)}
+_JAX_DEFAULTS = {"stall": (16, 4), "reshard": (16, 4),
+                 "tenant_evict": (12, 4)}
 
 
 def main():
@@ -284,9 +364,14 @@ def main():
     args.niter = dflt[0] if args.niter is None else args.niter
     args.save_every = dflt[1] if args.save_every is None else args.save_every
     if args.at_row is None:
-        # land past the warmup/compile chunks for the jax scenarios
-        args.at_row = args.niter // 2 + (3 if args.scenario == "stall"
-                                         else 0)
+        if args.scenario == "tenant_evict":
+            # the serve.chunk seam counts CHUNKS, not rows: kill at the
+            # 4th chunk, after the eviction churn but mid-multiplex
+            args.at_row = 4
+        else:
+            # land past the warmup/compile chunks for the jax scenarios
+            args.at_row = args.niter // 2 + (3 if args.scenario == "stall"
+                                             else 0)
 
     if args.scenario == "reshard":
         # must precede the first jax import: the contract drill needs 8
