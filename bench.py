@@ -154,10 +154,14 @@ def bench_jax(pta, x0, niter, adapt_iters, nchains, profile=False,
     chunk = max(10, min(100, niter // 8))
     if record_every > 1:
         chunk = max(record_every, chunk - chunk % record_every)
+    # streaming diagnostic sketch rides the chunk (obs/): device-side
+    # ACT/ESS come off the bounded summary slab instead of the shipped
+    # chains.  lags=256 comfortably covers the measured rho taus
+    # (~45-50 sweeps; Sokal window ~5*tau)
     drv = JaxGibbsDriver(pta, seed=1, common_rho=True,
                          white_adapt_iters=adapt_iters, chunk_size=chunk,
                          nchains=nchains, record_precision=record,
-                         record_every=record_every)
+                         record_every=record_every, obs={"lags": 256})
     C = drv.C
     cshape, bshape = drv.chain_shapes(niter)
     chain = np.zeros(cshape)
@@ -205,7 +209,13 @@ def bench_jax(pta, x0, niter, adapt_iters, nchains, profile=False,
         fl = profiling.sweep_flops(drv.cm, nchains=C)
         print(profiling.format_report(times, fl, steady), file=sys.stderr)
         prof = times
-    return steady, windows, C, drv, prof, raw, chain, n_retraces
+    try:
+        obs_sum = drv.obs_summary()
+    except Exception as exc:  # noqa: BLE001 — diagnostics never kill a bench
+        print(f"# obs summary failed: {type(exc).__name__}: {exc}",
+              file=sys.stderr)
+        obs_sum = None
+    return steady, windows, C, drv, prof, raw, chain, n_retraces, obs_sum
 
 
 def bench_numpy(gibbs, x0, niter, act_iters=0):
@@ -272,7 +282,7 @@ def bench_config(orf, n_psr, niter, np_iters, adapt, nchains, profile,
     if orf != "crn" and len(idx.orf):
         # parameterized/fixed correlated ORFs start at G = identity
         x0[idx.orf] = 0.0
-    jax_rate, windows, C, drv, prof, raw, chain, n_retraces = \
+    jax_rate, windows, C, drv, prof, raw, chain, n_retraces, obs_sum = \
         _retry_transport(
         lambda: bench_jax(pta, x0, niter, adapt, nchains, profile=profile,
                           record=record, record_every=record_every))
@@ -309,6 +319,13 @@ def bench_config(orf, n_psr, niter, np_iters, adapt, nchains, profile,
         out["sum_blocks_ms"] = round(prof["sum_blocks_ms"], 3)
         out["full_sweep_ms"] = round(prof["full_sweep_ms"], 3)
         out["dispatch_ms"] = round(prof["dispatch_ms"], 3)
+        # where one REAL chunk's wall goes (profiling.dispatch_breakdown):
+        # host-prep vs enqueue vs device wait vs record writeback — the
+        # per-chunk complement of the bare jit-overhead dispatch_ms
+        if prof.get("dispatch_breakdown_ms"):
+            out["dispatch_breakdown_ms"] = {
+                k: round(v, 3)
+                for k, v in prof["dispatch_breakdown_ms"].items()}
     # resilience counters (runtime.telemetry): retries/rollbacks/refolds
     # accumulated during this process plus the driver's last on-device
     # health reductions — a long bench that silently retried or rolled
@@ -355,6 +372,24 @@ def bench_config(orf, n_psr, niter, np_iters, adapt, nchains, profile,
     oracle_ess = np_rate / max(oracle_act, 1.0)
     out["oracle_ess_per_sec"] = round(oracle_ess, 2)
     out["vs_oracle_ess"] = round(out["ess_per_sec"] / oracle_ess, 2)
+    # device-side mixing from the streaming sketch (obs/): rho-ACT in
+    # SWEEP units straight off the bounded summary slab — no chain
+    # transfer involved — plus a parity ratio against the host Sokal on
+    # this run's own thinned chains (row-ACT x record_every converts to
+    # sweep units; the obs acceptance band is 10%, i.e. parity in
+    # [0.9, 1.1] modulo the host burn window)
+    if obs_sum is not None:
+        act_dev = float(obs_sum["act_rho_med"])
+        out["rho_act_device"] = round(act_dev, 2)
+        out["ess_per_sec_device"] = round(
+            C * jax_rate / max(act_dev, 1.0), 1)
+        host_sweeps = act_med * record_every
+        out["act_parity_device_vs_host"] = (
+            round(act_dev / host_sweeps, 4) if host_sweeps > 0 else None)
+        if obs_sum.get("rhat_max") is not None:
+            out["rhat_max_device"] = round(float(obs_sum["rhat_max"]), 4)
+        if obs_sum.get("window_saturated"):
+            out["obs_window_saturated"] = True
     return out
 
 
@@ -368,11 +403,11 @@ def thinned_probe(orf, n_psr, niter, adapt, nchains, record, k=4):
     idx = BlockIndex.build(pta.param_names)
     if orf != "crn" and len(idx.orf):
         x0[idx.orf] = 0.0
-    rate, windows, C, drv, _, raw, chain, _ = bench_jax(
+    rate, windows, C, drv, _, raw, chain, _, obs_sum = bench_jax(
         pta, x0, niter, adapt, nchains, profile=False, record=record,
         record_every=k)
     act = _rho_act(chain, idx.rho, min(len(chain) // 4, 200))
-    return {
+    out = {
         "record_every": k,
         "sweeps_per_sec": round(rate, 2),
         "rate_windows": [round(w, 2) for w in windows],
@@ -381,6 +416,13 @@ def thinned_probe(orf, n_psr, niter, adapt, nchains, record, k=4):
         "ess_per_sec": round(C * (rate / k) / max(act, 1.0), 1),
         "raw": raw,
     }
+    # the thinned leg is where the device sketch earns its keep: the
+    # host ACT only sees every k-th row, the sketch saw every sweep
+    if obs_sum is not None:
+        act_dev = float(obs_sum["act_rho_med"])
+        out["rho_act_device"] = round(act_dev, 2)
+        out["ess_per_sec_device"] = round(C * rate / max(act_dev, 1.0), 1)
+    return out
 
 
 def bench_serve(quick=False, niter=None, slots=2, chunk=4):
@@ -608,13 +650,19 @@ def main(argv=None):
                                 # ESS-based reading next to it
                                 "rho_act_median", "ess_per_sec",
                                 "oracle_rho_act", "oracle_ess_per_sec",
-                                "vs_oracle_ess") if k in head},
+                                "vs_oracle_ess",
+                                # device-sketch companions (obs/): ACT/ESS
+                                # off the summary slab, never the shipped
+                                # chains, with the host-Sokal parity ratio
+                                "rho_act_device", "ess_per_sec_device",
+                                "act_parity_device_vs_host",
+                                "rhat_max_device") if k in head},
     }
     if head.get("thinned_k4") is not None:
         out["thinned_k4"] = head["thinned_k4"]
     if crn is not None and "per_block_ms" in crn:
         for k in ("per_block_ms", "per_block_in_sweep", "sum_blocks_ms",
-                  "full_sweep_ms", "dispatch_ms"):
+                  "full_sweep_ms", "dispatch_ms", "dispatch_breakdown_ms"):
             if k in crn:
                 out[k] = crn[k]
     if hd is not None:

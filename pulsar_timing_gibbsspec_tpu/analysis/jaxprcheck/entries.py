@@ -95,6 +95,25 @@ def _chunk_entry(spec):
     return fn, args, {"driver": drv}
 
 
+def _obs_chunk_entry(spec):
+    """The obs-instrumented steady chunk: same synthetic model as
+    ``chunk``, driver built with ``obs=True`` so the streaming
+    diagnostic sketch (obs/sketch.py) rides the scan.  The contract
+    (``obs_quick``) pins that instrumentation adds zero collectives,
+    keeps key lineage and donation intact, and bounds the total output
+    bytes to the summary slab."""
+    from ...sampler import jax_backend as jb
+
+    psrs = synthetic_pulsars(spec.get("n_psr", 3), spec.get("ntoa", 40),
+                             tm_cols=spec.get("tm_cols", 3),
+                             seed=spec.get("seed", 0))
+    pta = build_model(psrs, spec.get("nmodes", 3))
+    fn, args, drv = jb.obs_sweep_chunk_entry(
+        pta, spec.get("nchains", 4), chunk=spec.get("chunk", 2),
+        pad_pulsars=spec.get("pad_pulsars"), seed=spec.get("seed", 0))
+    return fn, args, {"driver": drv}
+
+
 def _sharded_step_entry(spec):
     """Mirror of the MULTICHIP dry-run step: pad + shard the compiled
     model over a 1-d host-device mesh, trace one CRN sweep step."""
@@ -173,6 +192,7 @@ def _serve_mux_entry(spec):
 
 
 _ENTRIES = {"gram": _gram_entry, "chunk": _chunk_entry,
+            "obs_chunk": _obs_chunk_entry,
             "sharded_step": _sharded_step_entry,
             "serve_mux": _serve_mux_entry}
 

@@ -200,9 +200,37 @@ def _check_donation(chk, closed, fn, args):
     return out, {"aliased_outputs": aliased}
 
 
+def _check_outputs(chk, closed, fn, args):
+    """Bound the entry's total output bytes — the static form of "no
+    transfer beyond the bounded summary slab": every device result an
+    instrumented chunk can ship is an outvar of this jaxpr, so pinning
+    their aggregate size (and count) here means instrumentation cannot
+    quietly grow the device->host surface (contracts/obs_quick.json)."""
+    import numpy as np
+
+    outs = closed.jaxpr.outvars
+    per = [int(np.prod(v.aval.shape, dtype=np.int64))
+           * np.dtype(v.aval.dtype).itemsize for v in outs]
+    total = int(sum(per))
+    facts = {"count": len(outs), "total_bytes": total,
+             "largest_bytes": max(per) if per else 0}
+    out = []
+    max_bytes = chk.get("max_bytes")
+    if max_bytes is not None and total > int(max_bytes):
+        out.append(
+            f"output surface grew: {total} bytes across {len(outs)} "
+            f"outputs exceeds the contract's {int(max_bytes)}-byte "
+            "summary-slab bound")
+    max_count = chk.get("max_count")
+    if max_count is not None and len(outs) > int(max_count):
+        out.append(f"output count {len(outs)} exceeds the contract's "
+                   f"{int(max_count)}")
+    return out, facts
+
+
 _CHECKS = {"hbm": _check_hbm, "collectives": _check_collectives,
            "dtypes": _check_dtypes, "keys": _check_keys,
-           "donation": _check_donation}
+           "donation": _check_donation, "outputs": _check_outputs}
 
 
 def run_contract(contract: dict):

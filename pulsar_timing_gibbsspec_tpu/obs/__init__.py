@@ -1,0 +1,40 @@
+"""On-device streaming diagnostics + host-side trace/metrics export.
+
+Two halves (docs/OBSERVABILITY.md):
+
+- **Device half** (:mod:`.sketch`, finalized by :mod:`.summary`):
+  streaming Welford/cross-covariance moments, a one-pass batched
+  lagged-product ACF accumulator (the vmapped generalization of
+  ``ops/acf.py``), and per-block move-rate sums, carried through the
+  scanned chunk so ESS/ACT/R-hat ship as a tiny summary slab instead of
+  raw chains.
+- **Host half** (:mod:`.trace`, :mod:`.metrics`, :mod:`.convergence`):
+  nested monotonic trace spans around the dispatch pipeline (Perfetto/
+  Chrome ``trace.json`` + ``metrics.jsonl`` events), a dependency-free
+  Prometheus text exposition writer over the labeled telemetry
+  registry, and exact rank-normalized split-R-hat for host-side
+  record slabs.
+
+This ``__init__`` stays import-light: :mod:`.trace` is stdlib-only and
+eagerly available (the driver hot path touches it every chunk); the
+jax/numpy halves load on first attribute access.
+"""
+
+from . import trace  # noqa: F401  (stdlib-only; hot-path no-op when disabled)
+
+_LAZY = {
+    "sketch": ".sketch",
+    "summary": ".summary",
+    "metrics": ".metrics",
+    "convergence": ".convergence",
+}
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        import importlib
+
+        mod = importlib.import_module(_LAZY[name], __name__)
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,72 @@
+"""Prometheus text-format exposition over the telemetry registry.
+
+Dependency-free writer for the 0.0.4 text format: counters and gauges
+from :mod:`runtime.telemetry` (including its labeled composite keys,
+which already use the Prometheus ``name{k="v"}`` syntax) render into
+one scrape body.  ``SamplerService.prometheus()`` is the intended
+caller; ``tools/obs_probe.py`` writes the same body to disk.
+"""
+
+from __future__ import annotations
+
+import re
+
+_NAME_OK = re.compile(r"[^a-zA-Z0-9_:]")
+_KEY_RE = re.compile(r"^([^{]+)(?:\{(.*)\})?$")
+_LABEL_RE = re.compile(r'(\w+)="((?:[^"\\]|\\.)*)"')
+
+
+def sanitize(name: str) -> str:
+    name = _NAME_OK.sub("_", name)
+    return "_" + name if name[:1].isdigit() else name
+
+
+def split_key(key: str):
+    """``'name{a="b"}'`` -> ``('name', {'a': 'b'})``; plain names pass
+    through with empty labels."""
+    m = _KEY_RE.match(key)
+    if not m:
+        return key, {}
+    labels = dict(_LABEL_RE.findall(m.group(2))) if m.group(2) else {}
+    return m.group(1), labels
+
+
+def _escape(v: str) -> str:
+    return str(v).replace("\\", "\\\\").replace('"', '\\"').replace(
+        "\n", "\\n")
+
+
+def _render_family(out, seen, name, labels, value, kind, prefix):
+    metric = sanitize(f"{prefix}_{name}" if prefix else name)
+    if metric not in seen:
+        out.append(f"# TYPE {metric} {kind}")
+        seen.add(metric)
+    if labels:
+        lab = ",".join(f'{sanitize(k)}="{_escape(v)}"'
+                       for k, v in sorted(labels.items()))
+        out.append(f"{metric}{{{lab}}} {value}")
+    else:
+        out.append(f"{metric} {value}")
+
+
+def render(counts=None, gauges=None, prefix: str = "ptgibbs") -> str:
+    """Render counter/gauge dicts (telemetry ``snapshot()``/``gauges()``
+    shapes — possibly with labeled composite keys) as a Prometheus
+    scrape body."""
+    out: list = []
+    seen: set = set()
+    for key, v in sorted((counts or {}).items()):
+        name, labels = split_key(key)
+        _render_family(out, seen, name + "_total", labels, int(v),
+                       "counter", prefix)
+    for key, v in sorted((gauges or {}).items()):
+        name, labels = split_key(key)
+        _render_family(out, seen, name, labels, float(v), "gauge", prefix)
+    return "\n".join(out) + ("\n" if out else "")
+
+
+def render_telemetry(prefix: str = "ptgibbs") -> str:
+    """One-call scrape body of the live process-wide registry."""
+    from ..runtime import telemetry
+
+    return render(telemetry.snapshot(), telemetry.gauges(), prefix=prefix)

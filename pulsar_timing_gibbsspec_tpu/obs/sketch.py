@@ -1,0 +1,210 @@
+"""Streaming diagnostic sketches computed inside the compiled chunk.
+
+The driver's record transfer is the sweep-wall bottleneck (ROADMAP
+item 4), so convergence diagnostics must not depend on shipping raw
+chains: this module accumulates, ON DEVICE, everything the host needs
+to finalize mean/variance, small-k cross-covariance, a Sokal-windowed
+ACT/ESS per chain and channel, per-block move rates, and a
+moment-based split-R-hat — as a state pytree of fixed, tiny shapes
+carried from chunk to chunk.
+
+The sketch reads only the chunk's full-precision pre-thinning state
+stack ``xs`` (and the chunk-entry state, for the first transition), it
+consumes no PRNG keys, and it writes nothing back into the sweep carry
+— so an instrumented chunk is **bitwise identical in its sampling
+outputs** to an uninstrumented one
+(tests/test_obs.py::test_instrumented_chunk_bitwise_identical).
+
+Estimators (exact streaming identities, not approximations, except
+where noted):
+
+- moments: Chan et al. pairwise update of ``(n, mean, M2)`` per
+  (chain, channel), plus the matching co-moment update for the first
+  ``cross_k`` channels;
+- ACF: raw lagged-product sums ``S_l = sum_{t=l}^{n-1} x_t x_{t-l}``
+  via an ``L``-sample tail window concatenated onto each chunk (the
+  zero-initialized pre-stream tail contributes exactly 0 to every
+  product, so ``S_l`` is exact with pair count ``n - l``).  The host
+  turns these into autocovariances with the plug-in mean,
+  ``gamma_l = S_l/(n-l) - mean^2`` — the one place a full two-pass
+  estimator is not reproduced exactly (the plug-in mean is the
+  full-stream mean rather than per-lag window means; the difference is
+  O(tau/n), far inside the 10% parity budget the acceptance pins);
+- move rates: per transition and block group, the mean over the
+  group's parameters of a changed-value indicator — the same movement
+  proxy ``runtime.sentinels.chunk_health`` uses, summed per group so
+  the host can report per-block acceptance-style rates (exact MH
+  accept indicators are discarded inside the fused sweep bodies;
+  movement is the observable proxy, and for the MH blocks a proposal
+  that moves IS an acceptance).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+#: channel cap: diagnostics follow the science-critical blocks first
+#: (common rho, then hypers); a cap keeps the sketch state and the
+#: per-chunk update cost O(C * channels * lags), independent of nx.
+DEFAULT_CHANNELS = 32
+DEFAULT_CROSS = 8
+DEFAULT_LAGS = 64
+
+
+@dataclasses.dataclass(frozen=True)
+class SketchSpec:
+    """Static description of what the device sketch tracks.
+
+    ``channels`` are positions into the flat state vector ``x``;
+    ``groups`` are the Gibbs block index arrays the move-rate sums are
+    computed over (only non-empty blocks appear).
+    """
+
+    channels: np.ndarray        # (D,) int32 -> x
+    names: tuple                # (D,) parameter names of the channels
+    cross_k: int                # leading channels with full cross-cov
+    lags: int                   # L, ACF window length
+    groups: tuple               # ((name, (g,) int32 -> x), ...)
+
+    @property
+    def D(self) -> int:
+        return int(self.channels.shape[0])
+
+    @property
+    def G(self) -> int:
+        return len(self.groups)
+
+
+def make_sketch_spec(cm, channels: int = DEFAULT_CHANNELS,
+                     cross: int = DEFAULT_CROSS,
+                     lags: int = DEFAULT_LAGS) -> SketchSpec:
+    """Build the diagnostic channel selection from a CompiledPTA.
+
+    Channel priority mirrors what the bench reports on: the common
+    free-spectrum rho block first (the slow direction — ACT ~45 vs b's
+    ~2, docs/ACT_TABLE.md), then red/ORF hypers, then white/ECORR,
+    truncated at ``channels``.
+    """
+    idx = cm.idx
+    order, seen = [], set()
+    for block in (idx.rho, idx.red, idx.orf, idx.red_rho, idx.white,
+                  idx.ecorr):
+        for i in np.asarray(block).ravel():
+            i = int(i)
+            if i not in seen:
+                seen.add(i)
+                order.append(i)
+    if not order:
+        # a model with no recognized block still gets *some* channels
+        order = list(range(min(int(channels), int(cm.nx))))
+    ch = np.asarray(order[: int(channels)], dtype=np.int32)
+    names = tuple(cm.param_names[i] for i in ch)
+    groups = tuple(
+        (nm, np.asarray(g, dtype=np.int32))
+        for nm, g in (("rho", idx.rho), ("red", idx.red),
+                      ("red_rho", idx.red_rho), ("white", idx.white),
+                      ("ecorr", idx.ecorr), ("orf", idx.orf))
+        if len(np.asarray(g)))
+    return SketchSpec(channels=ch, names=names,
+                      cross_k=min(int(cross), len(ch)), lags=int(lags),
+                      groups=groups)
+
+
+def init_state(spec: SketchSpec, nchains: int):
+    """Zero sketch state (a dict pytree of f64 device arrays).
+
+    The zero tail window is load-bearing: lagged products against the
+    pre-stream zeros vanish, so ``S_l`` needs no special-casing at the
+    stream head.
+    """
+    import jax.numpy as jnp
+
+    C, D, L, Kc, G = (int(nchains), spec.D, spec.lags, spec.cross_k,
+                      spec.G)
+    f8 = jnp.float64
+    return {
+        "n": jnp.zeros((), f8),
+        "mean": jnp.zeros((C, D), f8),
+        "m2": jnp.zeros((C, D), f8),
+        "cross": jnp.zeros((C, Kc, Kc), f8),
+        "lag": jnp.zeros((C, D, L), f8),
+        "tail": jnp.zeros((C, D, L), f8),
+        "move": jnp.zeros((C, G), f8),
+        "moven": jnp.zeros((), f8),
+    }
+
+
+def state_bytes(spec: SketchSpec, nchains: int) -> int:
+    """Size of the summary slab — the ONLY extra device output an
+    instrumented chunk produces (pinned by contracts/obs_quick.json)."""
+    C, D, L, Kc, G = (int(nchains), spec.D, spec.lags, spec.cross_k,
+                      spec.G)
+    return 8 * (1 + C * D * 2 + C * Kc * Kc + C * D * L * 2 + C * G + 1)
+
+
+def update(spec: SketchSpec, state, x0, xs):
+    """Fold one chunk's state stack into the sketch (traced, jit-safe).
+
+    ``x0`` is the chunk-entry state ``(C, nx)`` (first move transition),
+    ``xs`` the full pre-thinning per-sweep stack ``(n, C, nx)`` in the
+    compute dtype.  Returns the updated state pytree; everything is
+    O(C * D * (n + L)) — no term scales with nx beyond the two gathers.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    nc = int(xs.shape[0])
+    ch = jnp.asarray(spec.channels, jnp.int32)
+    z = jnp.moveaxis(xs[:, :, ch].astype(jnp.float64), 0, -1)  # (C, D, n)
+
+    na = state["n"]
+    nb = jnp.asarray(float(nc), jnp.float64)
+    tot = na + nb
+
+    # Chan pairwise merge of (n, mean, M2); exact for na == 0 too
+    cmean = jnp.mean(z, axis=-1)                               # (C, D)
+    cm2 = jnp.sum((z - cmean[..., None]) ** 2, axis=-1)
+    delta = cmean - state["mean"]
+    mean = state["mean"] + delta * (nb / tot)
+    m2 = state["m2"] + cm2 + delta**2 * (na * nb / tot)
+
+    # co-moment merge over the leading cross_k channels
+    Kc = spec.cross_k
+    zk = z[:, :Kc]
+    ckm = cmean[:, :Kc]
+    zc = zk - ckm[..., None]
+    ccov = jnp.einsum("cin,cjn->cij", zc, zc)
+    dk = ckm - state["mean"][:, :Kc]
+    cross = (state["cross"] + ccov
+             + dk[:, :, None] * dk[:, None, :] * (na * nb / tot))
+
+    # one-pass lagged-product sums across the chunk boundary: the tail
+    # window makes every cross-boundary pair available exactly once
+    L = spec.lags
+    ext = jnp.concatenate([state["tail"], z], axis=-1)         # (C, D, L+n)
+    cur = ext[..., L:]
+
+    def lag_body(_, lag):
+        seg = jax.lax.dynamic_slice_in_dim(ext, L - lag, nc, axis=-1)
+        return None, jnp.sum(cur * seg, axis=-1)
+
+    _, lsum = jax.lax.scan(lag_body, None, jnp.arange(L))      # (L, C, D)
+    lag = state["lag"] + jnp.moveaxis(lsum, 0, -1)
+    tail = ext[..., -L:]
+
+    # per-block move fractions over the chunk's n transitions
+    full = jnp.concatenate([x0[None], xs], axis=0)             # (n+1, C, nx)
+    changed = full[1:] != full[:-1]                            # (n, C, nx)
+    gmoves = [
+        jnp.sum(jnp.mean(
+            changed[:, :, jnp.asarray(gi, jnp.int32)].astype(jnp.float64),
+            axis=-1), axis=0)
+        for _, gi in spec.groups]                              # each (C,)
+    move = state["move"] + (jnp.stack(gmoves, axis=-1) if gmoves
+                            else jnp.zeros_like(state["move"]))
+
+    return {"n": tot, "mean": mean, "m2": m2, "cross": cross,
+            "lag": lag, "tail": tail, "move": move,
+            "moven": state["moven"] + nb}

@@ -1,0 +1,151 @@
+"""Structured pipeline trace spans (stdlib-only, zero-cost when off).
+
+A process-wide recorder of nested spans with monotonic timestamps,
+wired into the dispatch pipeline seams (``JaxGibbsDriver.run``,
+``DispatchWatchdog``, ``serve.SamplerService``).  Disabled, every call
+is a shared ``nullcontext`` / early return — the hot loop pays one
+attribute load per span, no allocation, no lock.
+
+Enabled, finished spans/instants land in an in-memory buffer that
+exports to Perfetto/Chrome trace-event JSON (:func:`to_chrome`,
+``chrome://tracing`` / https://ui.perfetto.dev), and optionally stream
+to a ``sink`` callable — the hook ``tools/obs_probe.py`` and the serve
+layer use to append ``metrics.jsonl`` span events next to the
+supervisor's (span taxonomy: docs/OBSERVABILITY.md).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+
+_lock = threading.Lock()
+_enabled = False
+_events: list = []
+_t0 = 0.0
+_sink = None
+_tids: dict = {}
+_NULL = contextlib.nullcontext()
+#: cap so a forgotten enable() cannot grow without bound (~100 bytes/ev)
+MAX_EVENTS = 200_000
+
+
+def enable(sink=None) -> None:
+    """Start recording (clears the buffer).  ``sink``, if given, is
+    called with a dict per finished span/instant — exceptions from it
+    are swallowed (observability must not kill the run)."""
+    global _enabled, _t0, _sink
+    with _lock:
+        _events.clear()
+        _tids.clear()
+        _t0 = time.monotonic()
+        _sink = sink
+        _enabled = True
+
+
+def disable() -> None:
+    global _enabled, _sink
+    with _lock:
+        _enabled = False
+        _sink = None
+
+
+def is_enabled() -> bool:
+    return _enabled
+
+
+def _tid() -> int:
+    ident = threading.get_ident()
+    t = _tids.get(ident)
+    if t is None:
+        t = _tids[ident] = len(_tids) + 1
+    return t
+
+
+def _emit(ev: dict) -> None:
+    sink = _sink
+    with _lock:
+        if len(_events) < MAX_EVENTS:
+            _events.append(ev)
+    if sink is not None:
+        try:
+            sink(ev)
+        except Exception:
+            pass
+
+
+class _Span:
+    __slots__ = ("name", "args", "_start")
+
+    def __init__(self, name, args):
+        self.name = name
+        self.args = args
+
+    def __enter__(self):
+        self._start = time.monotonic()
+        return self
+
+    def __exit__(self, *exc):
+        if not _enabled:        # disabled mid-span: drop it
+            return False
+        end = time.monotonic()
+        _emit({"ph": "X", "name": self.name,
+               "ts": (self._start - _t0) * 1e6,
+               "dur": (end - self._start) * 1e6,
+               "pid": os.getpid(), "tid": _tid(),
+               "args": self.args})
+        return False
+
+
+def span(name: str, **args):
+    """Context manager timing a pipeline stage.  Nesting is expressed
+    by containment of the ``ts``/``dur`` intervals (Chrome 'X' complete
+    events), so concurrently open spans on one thread render stacked."""
+    if not _enabled:
+        return _NULL
+    return _Span(name, args)
+
+
+def instant(name: str, **args) -> None:
+    """A zero-duration marker (watchdog soft/stall events etc.)."""
+    if not _enabled:
+        return
+    _emit({"ph": "i", "name": name, "ts": (time.monotonic() - _t0) * 1e6,
+           "pid": os.getpid(), "tid": _tid(), "s": "t", "args": args})
+
+
+def events() -> list:
+    with _lock:
+        return list(_events)
+
+
+def to_chrome() -> dict:
+    """The Chrome/Perfetto trace-event JSON object."""
+    return {"traceEvents": events(), "displayTimeUnit": "ms"}
+
+
+def write_chrome(path) -> str:
+    path = os.fspath(path)
+    with open(path, "w") as fh:
+        json.dump(to_chrome(), fh)
+    return path
+
+
+def jsonl_sink(path):
+    """A ``sink`` that appends one metrics.jsonl line per event, in the
+    supervisor's record shape (``runtime.supervisor._log_event``)."""
+    path = os.fspath(path)
+
+    def _sink(ev):
+        rec = {"ts": round(time.time(), 3), "event": "trace_span"
+               if ev.get("ph") == "X" else "trace_instant",
+               "name": ev["name"], **ev.get("args", {})}
+        if ev.get("ph") == "X":
+            rec["ms"] = round(ev["dur"] / 1e3, 3)
+        with open(path, "a") as fh:
+            fh.write(json.dumps(rec) + "\n")
+
+    return _sink

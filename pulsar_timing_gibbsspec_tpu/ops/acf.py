@@ -27,6 +27,29 @@ def _autocorr_fft(x: np.ndarray) -> np.ndarray:
     return acf / acf[0]
 
 
+def act_from_rho(rho: np.ndarray, c: float = 5.0) -> np.ndarray:
+    """Sokal windowed ACT from normalized autocorrelations, batched.
+
+    ``rho`` is ``(..., L)`` with ``rho[..., 0] == 1``; the window is the
+    first lag ``W >= c * tau(W)`` per leading index (falling back to the
+    full window when none qualifies, as :func:`integrated_act` does).
+    This is the shared finalizer of the host estimator below and of the
+    on-device lagged-product sketch (``obs/sketch.py``), so the two
+    report the same statistic by construction.  Returns ``(...)`` floats
+    clipped to >= 1.0.
+    """
+    rho = np.asarray(rho, dtype=np.float64)
+    tau = 2.0 * np.cumsum(rho, axis=-1) - 1.0
+    windows = np.arange(rho.shape[-1])
+    ok = windows >= c * tau
+    # argmax finds the first qualifying window; rows with none get the
+    # full-window tau (argmax of all-False is 0 -> masked to L-1)
+    w = np.argmax(ok, axis=-1)
+    w = np.where(np.any(ok, axis=-1), w, rho.shape[-1] - 1)
+    return np.maximum(np.take_along_axis(tau, w[..., None],
+                                         axis=-1)[..., 0], 1.0)
+
+
 def integrated_act(x: np.ndarray, c: float = 5.0) -> float:
     """Sokal windowed integrated ACT: ``tau = 1 + 2 sum_t rho_t`` summed up
     to the first window ``W >= c * tau(W)``.  Returns >= 1.0."""
@@ -37,11 +60,4 @@ def integrated_act(x: np.ndarray, c: float = 5.0) -> float:
         return 1.0
     if acor_native.available():
         return acor_native.act(x)
-    rho = _autocorr_fft(x)
-    tau = 2.0 * np.cumsum(rho) - 1.0
-    windows = np.arange(len(tau))
-    ok = windows >= c * tau
-    if not np.any(ok):
-        return float(max(tau[-1], 1.0))
-    w = np.argmax(ok)
-    return float(max(tau[w], 1.0))
+    return float(act_from_rho(_autocorr_fft(x), c))

@@ -279,6 +279,10 @@ def profile_blocks(driver, x, repeats=5, inner=50):
         else:
             in_sweep[name] = True          # white/ecorr/red/rho blocks
     per_block_ms = {k: v * 1e3 for k, v in out.items()}
+    try:
+        breakdown = dispatch_breakdown(driver, x)
+    except Exception:     # noqa: BLE001 — the breakdown is best-effort
+        breakdown = None
     return {
         "per_block_ms": per_block_ms,
         "in_sweep": in_sweep,
@@ -286,7 +290,69 @@ def profile_blocks(driver, x, repeats=5, inner=50):
                              if in_sweep[k]),
         "full_sweep_ms": full_sweep * 1e3,
         "dispatch_ms": dispatch * 1e3,
+        "dispatch_breakdown_ms": breakdown,
     }
+
+
+def dispatch_breakdown(driver, x):
+    """Stage decomposition of ONE steady chunk dispatch, staged exactly
+    the way ``JaxGibbsDriver.run()`` stages it — the per-chunk analogue
+    of the span taxonomy in docs/OBSERVABILITY.md:
+
+    - ``host_prep``  argument staging (explicit ``device_put`` of the
+      host scalars, aux assembly) before the dispatch;
+    - ``enqueue``    the jitted chunk call returning — on an async
+      backend this is the host-side cost of getting the compiled
+      program in flight, NOT the compute;
+    - ``device``     the remaining wait for the chunk's results
+      (``block_until_ready`` beyond the enqueue return);
+    - ``writeback``  the device->host conversion of the recorded x/b
+      stacks (on a tunneled device this is the transfer).
+
+    ``dispatch_ms`` in the :func:`profile_blocks` report remains the
+    bare per-call jit overhead (a scalar no-op); this says where a real
+    chunk's wall actually goes.  The stages also emit ``profile.*``
+    trace spans when the obs trace layer is enabled.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from .obs import trace as otrace
+
+    cm = driver.cm
+    x = np.asarray(x, np.float64)
+    if x.ndim == 1:
+        x = np.tile(x, (driver.C, 1))
+    xd = jnp.asarray(x, cm.cdtype)
+    bd = jnp.asarray(driver.b)
+    n = driver.chunk_size
+    fn = driver._chunk_fn(n, 0)
+    obs_on = driver.obs is not None
+
+    def staged():
+        t0 = time.perf_counter()
+        with otrace.span("profile.host_prep"):
+            args = (xd, bd, driver.key, jax.device_put(np.int32(0)),
+                    driver._aux(), jax.device_put(np.int32(n)))
+            if obs_on:
+                args = args + (driver._obs_state,)
+        t1 = time.perf_counter()
+        with otrace.span("profile.enqueue"):
+            outs = fn(*args)
+        t2 = time.perf_counter()
+        with otrace.span("profile.device"):
+            jax.block_until_ready(outs[:5])
+        t3 = time.perf_counter()
+        with otrace.span("profile.writeback"):
+            np.asarray(outs[2])
+            np.asarray(outs[3])
+        t4 = time.perf_counter()
+        return t1 - t0, t2 - t1, t3 - t2, t4 - t3
+
+    staged()              # warm: the chunk fn may still need compiling
+    hp, eq, dv, wb = staged()
+    return {"host_prep": hp * 1e3, "enqueue": eq * 1e3,
+            "device": dv * 1e3, "writeback": wb * 1e3}
 
 
 def sweep_flops(cm, nchains=1):
@@ -321,6 +387,10 @@ def format_report(report: dict, flops: dict | None = None,
                  "ms")
     lines.append(f"  {'full_sweep':<20s} {report['full_sweep_ms']:8.2f} ms")
     lines.append(f"  {'dispatch':<20s} {report['dispatch_ms']:8.2f} ms")
+    bd = report.get("dispatch_breakdown_ms")
+    if bd:
+        parts = " + ".join(f"{k} {v:.1f}" for k, v in bd.items())
+        lines.append(f"  chunk stages: {parts} ms")
     if flops and sweeps_per_sec:
         achieved = flops["total"] * sweeps_per_sec
         peak = device_peak_flops()

@@ -29,17 +29,22 @@ Gauges (:func:`gauge`) carry last-value measurements (floats) next to
 the counters — e.g. ``drain_latency_ms``, the request-to-verified-
 checkpoint time of the most recent preemption drain.
 
-Serving-layer gauges (``serve.service``, glossary in docs/SERVING.md):
+Serving-layer gauges and their glossary moved to docs/OBSERVABILITY.md
+("Metric and label glossary") together with the per-job labeled serve
+gauges (``serve_ess_per_sec``/``serve_rhat_max``/``serve_accept_rate``).
 
-- ``queue_depth``              requests waiting for a batch-row slot
-- ``warm_hit_rate``            fraction of admissions that landed on an
-                               already-compiled bucket program
-- ``compile_stalls``           admissions that had to wait for a bucket
-                               program compile (cold bucket)
-- ``tenant_evictions``         residents checkpointed + requeued to make
-                               room (fair-share churn or injected)
-- ``time_to_first_sample_ms``  submit-to-first-recorded-sweep latency of
-                               the most recent request
+**Labels.**  ``incr``/``gauge`` (and their getters) accept keyword
+labels: ``gauge("serve_ess_per_sec", v, tenant="3")`` stores the series
+under the composite key ``serve_ess_per_sec{tenant="3"}`` (Prometheus
+exposition syntax, labels sorted — so per-tenant serve gauges never
+collide process-wide).  Plain-name calls are untouched; consumers that
+iterate :func:`snapshot`/:func:`gauges` see composite keys as strings,
+and ``obs.metrics`` parses them back into real Prometheus labels.
+
+**Scoping.**  :func:`snapshot`/:func:`gauges`/:func:`reset` take an
+optional ``prefix`` filtered on the BASE name (label part ignored), so
+chaos/serve tests can clear exactly their own namespace
+(``reset("serve_")``) without erasing counters another suite asserts on.
 """
 
 from __future__ import annotations
@@ -51,43 +56,68 @@ _counts: dict[str, int] = {}
 _gauges: dict[str, float] = {}
 
 
-def incr(name: str, n: int = 1) -> int:
+def labeled(name: str, **labels) -> str:
+    """The composite registry key of a labeled series (identity for no
+    labels).  Matches Prometheus exposition syntax; ``obs.metrics.
+    split_key`` is the inverse."""
+    if not labels:
+        return name
+    lab = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    return f"{name}{{{lab}}}"
+
+
+def _base(key: str) -> str:
+    return key.split("{", 1)[0]
+
+
+def incr(name: str, n: int = 1, **labels) -> int:
     """Add ``n`` to counter ``name`` (created at 0); returns the new value."""
+    key = labeled(name, **labels)
     with _lock:
-        _counts[name] = _counts.get(name, 0) + int(n)
-        return _counts[name]
+        _counts[key] = _counts.get(key, 0) + int(n)
+        return _counts[key]
 
 
-def get(name: str) -> int:
+def get(name: str, **labels) -> int:
     with _lock:
-        return _counts.get(name, 0)
+        return _counts.get(labeled(name, **labels), 0)
 
 
-def gauge(name: str, value: float) -> None:
+def gauge(name: str, value: float, **labels) -> None:
     """Record a last-value measurement (overwrites; e.g. latencies)."""
     with _lock:
-        _gauges[name] = float(value)
+        _gauges[labeled(name, **labels)] = float(value)
 
 
-def get_gauge(name: str, default: float | None = None):
+def get_gauge(name: str, default: float | None = None, **labels):
     with _lock:
-        return _gauges.get(name, default)
+        return _gauges.get(labeled(name, **labels), default)
 
 
-def gauges() -> dict[str, float]:
-    """Copy of all gauges, sorted by name."""
+def gauges(prefix: str | None = None) -> dict[str, float]:
+    """Copy of gauges, sorted by name; ``prefix`` filters on base name."""
     with _lock:
-        return dict(sorted(_gauges.items()))
+        return dict(sorted((k, v) for k, v in _gauges.items()
+                           if prefix is None or _base(k).startswith(prefix)))
 
 
-def snapshot() -> dict[str, int]:
-    """Copy of all counters, sorted by name (stable for JSON output)."""
+def snapshot(prefix: str | None = None) -> dict[str, int]:
+    """Copy of counters, sorted by name (stable for JSON output);
+    ``prefix`` filters on base name."""
     with _lock:
-        return dict(sorted(_counts.items()))
+        return dict(sorted((k, v) for k, v in _counts.items()
+                           if prefix is None or _base(k).startswith(prefix)))
 
 
-def reset() -> None:
-    """Zero every counter and gauge (tests; bench run isolation)."""
+def reset(prefix: str | None = None) -> None:
+    """Zero counters and gauges (tests; bench run isolation).  With
+    ``prefix``, only series whose BASE name starts with it are cleared —
+    scoped test isolation instead of process-wide erasure."""
     with _lock:
-        _counts.clear()
-        _gauges.clear()
+        if prefix is None:
+            _counts.clear()
+            _gauges.clear()
+            return
+        for d in (_counts, _gauges):
+            for k in [k for k in d if _base(k).startswith(prefix)]:
+                del d[k]

@@ -41,6 +41,7 @@ import time
 import traceback
 
 from . import telemetry
+from ..obs import trace as otrace
 
 
 class DispatchStall(RuntimeError):
@@ -126,6 +127,10 @@ class DispatchWatchdog:
             box["done"].set()
 
     def _emit(self, stage, info):
+        # stack dumps are huge; the trace keeps the escalation timeline,
+        # not the post-mortem payload (that goes through on_event)
+        otrace.instant(f"watchdog.{stage}",
+                       **{k: v for k, v in info.items() if k != "stacks"})
         if self.on_event is not None:
             try:
                 self.on_event(stage, info)

@@ -47,6 +47,7 @@ from typing import NamedTuple
 import numpy as np
 
 from ..config import settings
+from ..obs import trace as otrace
 from ..ops.acf import integrated_act
 from ..runtime import faults, preemption, telemetry
 from ..runtime.sentinels import SentinelMonitor, chunk_health
@@ -2102,7 +2103,7 @@ class JaxGibbsDriver:
                  warmup_white_steps=16, white_steps_max=64, nchains=1,
                  exact_every=EXACT_EVERY, record_precision=None,
                  record_every=1, transfer_guard=False, sentinels=True,
-                 joint_mixed=None, watchdog=None):
+                 joint_mixed=None, watchdog=None, obs=None):
         settings.apply()
         import jax
         import jax.random as jr
@@ -2294,6 +2295,29 @@ class JaxGibbsDriver:
         self.aclength_ecorr = None
         self.b = np.zeros((self.C, cm.P, cm.Bmax), dtype=cm.cdtype)
         self._sweep_fns = {}
+
+        #: on-device streaming diagnostics (obs/sketch.py): ``True``
+        #: enables the default sketch, a dict passes SketchSpec options
+        #: (channels/cross/lags), None/False runs uninstrumented —
+        #: OPT-IN so the default chunk keeps the dtype/donation census
+        #: pinned by contracts/crn_quick.json byte-identical.  The
+        #: sketch reads only the chunk's state stack (no keys, no carry
+        #: writes), so sampling outputs are bitwise-unchanged either
+        #: way; the instrumented program has its own static contract
+        #: (contracts/obs_quick.json: zero new collectives, donation
+        #: intact, summary-slab output bytes bounded).
+        self.obs = None
+        self._obs_state = None
+        #: per-writeback cumulative (n, mean, m2) host snapshots — the
+        #: ~kB trail moment_split_rhat() reconstructs half-stream
+        #: moments from by Chan subtraction (obs/summary.py)
+        self._obs_snaps = []
+        if obs:
+            from ..obs.sketch import init_state, make_sketch_spec
+
+            self.obs = make_sketch_spec(
+                cm, **(obs if isinstance(obs, dict) else {}))
+            self._obs_state = init_state(self.obs, self.C)
 
         # b passed through so large correlated-ORF models can take the
         # sequential conditional path (a no-op for the others)
@@ -2779,7 +2803,7 @@ class JaxGibbsDriver:
 
         return body
 
-    def _make_chunk(self, body, n, rec_off=0):
+    def _make_chunk(self, body, n, rec_off=0, obs=False):
         """Jitted scan of ``n`` sweeps, the single-chain ``body`` vmapped
         over the chains axis.
 
@@ -2817,7 +2841,7 @@ class JaxGibbsDriver:
         vexact = (None if body_exact is None
                   else jax.vmap(body_exact, in_axes=(0, 0, 0, None)))
 
-        def run_chunk(x, b, base_key, it0, aux, n_keep):
+        def _core(x, b, base_key, it0, aux, n_keep):
             u = jax.vmap(lambda b1: b_matvec(cm, b1))(b)
 
             def step(carry, t):
@@ -2880,9 +2904,33 @@ class JaxGibbsDriver:
             # device, so divergence/stuck-chain detection costs no extra
             # transfer (runtime.sentinels, docs/RESILIENCE.md)
             health = chunk_health(xs_rec, bs_rec)
-            return x_end, b_end, xs_rec.astype(self.rdtype), bs_flat, health
+            return (x_end, b_end, xs_rec.astype(self.rdtype), bs_flat,
+                    health, xs)
 
-        return jax.jit(run_chunk)
+        # the full f64 stack ``xs`` is an extra _core output only so the
+        # instrumented variant can fold it into the sketch; the plain
+        # variant drops it, and jit DCE restores the exact pre-obs
+        # program (contracts/crn_quick.json stays byte-identical)
+        def run_chunk(x, b, base_key, it0, aux, n_keep):
+            return _core(x, b, base_key, it0, aux, n_keep)[:5]
+
+        if not obs:
+            return jax.jit(run_chunk)
+
+        from ..obs import sketch as obs_sketch
+        spec = self.obs
+
+        def run_chunk_obs(x, b, base_key, it0, aux, n_keep, sk):
+            out = _core(x, b, base_key, it0, aux, n_keep)
+            # sketch the FULL pre-thinning stack: diagnostics see every
+            # sweep in f64 (ACT in sweep units) no matter how hard the
+            # record transfer is thinned — the point of the device half.
+            # No keys consumed, no carry touched: sampling outputs are
+            # bitwise those of run_chunk.
+            sk = obs_sketch.update(spec, sk, x, out[5])
+            return out[:5] + (sk,)
+
+        return jax.jit(run_chunk_obs)
 
     def _warmup_chunk_fn(self, n):
         if ("warmup", n) not in self._sweep_fns:
@@ -2903,8 +2951,8 @@ class JaxGibbsDriver:
                 # the factorization error (the same cadence contract as
                 # the CRN refresh; docs/EXACT_EVERY.md)
                 bodies = (self._sweep_body("mh"), self._sweep_body("exact"))
-            self._sweep_fns[(n, rec_off)] = self._make_chunk(bodies, n,
-                                                             rec_off)
+            self._sweep_fns[(n, rec_off)] = self._make_chunk(
+                bodies, n, rec_off, obs=self.obs is not None)
         return self._sweep_fns[(n, rec_off)]
 
     # ---- facade protocol ----------------------------------------------------
@@ -3005,6 +3053,15 @@ class JaxGibbsDriver:
         # sample() calls); the seed entry (-1) is still valid but cheap
         # to rebuild once per run
         self._de_dev_cache = {}
+        if self.obs is not None and start == 0:
+            # diagnostic sketches are per-run: a fresh run must not
+            # inherit a previous sample() call's moments (resume keeps
+            # accumulating within the process; a fresh process simply
+            # restarts the sketch — diagnostics, not sampled state)
+            from ..obs.sketch import init_state
+
+            self._obs_state = init_state(self.obs, self.C)
+            self._obs_snaps = []
         if self.sentinel is not None:
             # streak state is per-run: a supervised retry must not
             # inherit the failed attempt's stuck count
@@ -3020,10 +3077,11 @@ class JaxGibbsDriver:
             if W > 0:
                 self.key, sub = self._jr.split(self.key)
                 fn = self._warmup_chunk_fn(W)
-                x, b, xs, bs, health = fn(x, jnp.asarray(self.b), sub,
-                                          jnp.asarray(0, jnp.int32),
-                                          self._aux(),
-                                          jnp.asarray(W, jnp.int32))
+                with otrace.span("warmup.chunk", sweeps=W):
+                    x, b, xs, bs, health = fn(x, jnp.asarray(self.b), sub,
+                                              jnp.asarray(0, jnp.int32),
+                                              self._aux(),
+                                              jnp.asarray(W, jnp.int32))
                 self.b = b
                 xs_h = self._squeeze(np.asarray(xs, dtype=np.float64))
                 self._check_finite(xs_h, 0, "warmup state")
@@ -3076,27 +3134,42 @@ class JaxGibbsDriver:
         # Checkpoint consistency: the state yielded with chunk i's rows is
         # chunk i's own carry (x_end, b_end) — never the in-flight chunk's.
         b_dev = jnp.asarray(self.b)
-        pending = None    # (row, m, xs, bs, x_end, b_end, it_end, health)
+        obs_on = self.obs is not None
+        pending = None    # (row, m, xs, bs, x_end, b_end, it_end, health, sk)
 
-        def _writeback(row, m, xs, bs, x_end, b_end, it_end, health):
+        def _writeback(row, m, xs, bs, x_end, b_end, it_end, health,
+                       sk=None):
             # a trailing short chunk records extra rows (the compiled
             # chunk always runs full length); truncate HOST-side — an
             # eager device xs[:m] would dispatch with a host scalar
             # operand, an implicit transfer the transfer_guard mode
             # (rightly) rejects
-            xs_h = self._squeeze(np.asarray(xs, dtype=np.float64))[:m]
-            self._check_finite(xs_h, row, "chain state")
-            bs_h = self._squeeze(np.asarray(bs, np.float64))[:m]
-            self._check_finite(bs_h, row, "b coefficients")
-            # sentinel BEFORE the state advances: a stuck-chain raise
-            # leaves x_cur/_it_cur at the previous writeback, so the
-            # facade's checkpoint stays consistent for the rewind
-            self._observe_health(health, it_end)
-            chain[row:row + m] = xs_h
-            bchain[row:row + m] = bs_h
-            self.x_cur = np.asarray(x_end, dtype=np.float64)
-            self.b = b_end
-            self._it_cur = it_end
+            with otrace.span("chunk.d2h", row=row, rows=m):
+                # these conversions block on the chunk's device results
+                # AND run the device->host record copy — the span is
+                # honestly device-wait + transfer, not separable here
+                xs_h = self._squeeze(np.asarray(xs, dtype=np.float64))[:m]
+                bs_h = self._squeeze(np.asarray(bs, np.float64))[:m]
+            with otrace.span("chunk.writeback", row=row, rows=m):
+                self._check_finite(xs_h, row, "chain state")
+                self._check_finite(bs_h, row, "b coefficients")
+                # sentinel BEFORE the state advances: a stuck-chain raise
+                # leaves x_cur/_it_cur at the previous writeback, so the
+                # facade's checkpoint stays consistent for the rewind
+                self._observe_health(health, it_end)
+                chain[row:row + m] = xs_h
+                bchain[row:row + m] = bs_h
+                self.x_cur = np.asarray(x_end, dtype=np.float64)
+                self.b = b_end
+                self._it_cur = it_end
+                if sk is not None:
+                    # cumulative moment snapshot off THIS chunk's sketch
+                    # state (already computed — no wait on the in-flight
+                    # chunk): the split-R-hat half-stream trail
+                    self._obs_snaps.append(
+                        (float(np.asarray(sk["n"])),
+                         np.asarray(sk["mean"], np.float64),
+                         np.asarray(sk["m2"], np.float64)))
             return row + m
 
         it_base = self._it_base(niter)
@@ -3134,9 +3207,12 @@ class JaxGibbsDriver:
             # device_put (jnp.asarray of a Python scalar is an IMPLICIT
             # transfer and would trip the guard); the dispatch itself is
             # then transfer-free under transfer_guard("disallow")
-            dput = self._jax.device_put
-            args = (x, b_dev, self.key, dput(np.int32(ii)),
-                    self._aux(chain, ii), dput(np.int32(n)))
+            with otrace.span("chunk.host_prep", it0=ii):
+                dput = self._jax.device_put
+                args = (x, b_dev, self.key, dput(np.int32(ii)),
+                        self._aux(chain, ii), dput(np.int32(n)))
+                if obs_on:
+                    args = args + (self._obs_state,)
 
             def _go(fn=fn, args=args, it0=ii):
                 # the fault seam and the (thread-local!) transfer guard
@@ -3154,12 +3230,16 @@ class JaxGibbsDriver:
             pc = planned_compile() if fresh_compile \
                 else contextlib.nullcontext()
             t0 = time.monotonic()
-            with pc:
+            with pc, otrace.span(
+                    "chunk.compile_dispatch" if fresh_compile
+                    else "chunk.dispatch", it0=ii, n=n):
                 if wd is not None:
-                    x, b_dev, xs, bs, health = wd.call(_go,
-                                                       what=f"chunk@{ii}")
+                    outs = wd.call(_go, what=f"chunk@{ii}")
                 else:
-                    x, b_dev, xs, bs, health = _go()
+                    outs = _go()
+            x, b_dev, xs, bs, health = outs[:5]
+            if obs_on:
+                self._obs_state = outs[5]
             m = max(0, -(-(n - off) // self.record_every))
             if pending is not None:
                 # start both host copies in flight together before the
@@ -3190,7 +3270,8 @@ class JaxGibbsDriver:
                     0.3 * dt + 0.7 * wall_ema)
                 if wd is not None:
                     wd.observe(dt)
-            pending = (rowc, m, xs, bs, x, b_dev, ii + n, health)
+            pending = (rowc, m, xs, bs, x, b_dev, ii + n, health,
+                       self._obs_state if obs_on else None)
             ii += n
             rowc += m
         if pending is not None:
@@ -3200,8 +3281,33 @@ class JaxGibbsDriver:
                 # resume (per-sweep keys are pure in the absolute
                 # iteration index, so nothing is lost but wall time)
                 telemetry.incr("drain_abandoned_chunks")
+                otrace.instant("drain.abandon_chunk", row=pending[0])
             else:
                 yield _writeback(*pending)
+
+    def obs_summary(self):
+        """Finalize the on-device diagnostic sketches (obs/summary.py).
+
+        One bounded device->host transfer of the summary slab
+        (``obs.sketch.state_bytes``), then pure NumPy: per-chain/channel
+        mean/var, Sokal ACT/ESS in SWEEP units (the sketch streams every
+        sweep, before record thinning), cross-covariance, per-block move
+        rates, and the moment-based split-R-hat over the per-writeback
+        snapshot trail.  Raises if the driver was built without
+        ``obs=``."""
+        if self.obs is None:
+            raise RuntimeError(
+                "driver built without obs=; pass obs=True (or a dict of "
+                "sketch options) to JaxGibbsDriver to enable the "
+                "on-device diagnostics")
+        from ..obs.summary import finalize, moment_split_rhat
+
+        state_h = {k: np.asarray(v) for k, v in self._obs_state.items()}
+        out = finalize(self.obs, state_h)
+        rhat = moment_split_rhat(self._obs_snaps, state_h)
+        out["split_rhat_moment"] = rhat
+        out["rhat_max"] = float(np.max(rhat)) if rhat is not None else None
+        return out
 
     def _observe_health(self, health, it_end):
         """Fold a chunk's on-device health reductions into the monitor
@@ -3433,6 +3539,52 @@ def sweep_chunk_entry(pta, nchains, *, chunk=2, pad_pulsars=None, seed=0):
         jnp.asarray(0, jnp.int32),
         drv._aux(),
         jnp.asarray(chunk, jnp.int32),
+    )
+    return fn, args, drv
+
+
+def obs_sweep_chunk_entry(pta, nchains, *, chunk=2, pad_pulsars=None,
+                          seed=0, obs=True):
+    """The INSTRUMENTED steady chunk — :func:`sweep_chunk_entry` with
+    the obs sketch threaded through (``contracts/obs_quick.json``).
+
+    The extra argument/output pair is the sketch state pytree; the
+    contract pins that instrumenting the chunk adds zero collectives,
+    keeps the donation surface (carries + sketch state all aliased),
+    and bounds the total output bytes — i.e. the summary slab is the
+    ONLY new device output and there is no hidden host transfer."""
+    import jax
+    import jax.numpy as jnp
+    import jax.random as jr
+
+    drv = JaxGibbsDriver(pta, nchains=int(nchains), seed=seed,
+                         pad_pulsars=pad_pulsars, chunk_size=int(chunk),
+                         obs=obs)
+    cm = drv.cm
+    C = drv.C
+    if len(cm.idx.white):
+        W = int(np.asarray(cm.white_par_ix).shape[1])
+        eye = np.tile(np.eye(W, dtype=np.float64), (C, cm.P, 1, 1))
+        drv.aclength_white = 2
+        drv.chol_white = eye
+        drv.asqrt_white = eye.copy()
+        drv.mode_white = np.zeros((C, cm.P, W), np.float64)
+    if len(cm.idx.ecorr) and (cm.ec_cols.shape[1] or cm.has_ke):
+        E = int(np.asarray(cm.ecorr_par_ix).shape[1])
+        eye = np.tile(np.eye(E, dtype=np.float64), (C, cm.P, 1, 1))
+        drv.aclength_ecorr = 2
+        drv.chol_ecorr = eye
+        drv.asqrt_ecorr = eye.copy()
+        drv.mode_ecorr = np.zeros((C, cm.P, E), np.float64)
+    fn = drv._chunk_fn(int(chunk), 0)
+    args = (
+        jax.ShapeDtypeStruct((C, cm.nx), cm.cdtype),
+        jax.ShapeDtypeStruct((C, cm.P, cm.Bmax), cm.cdtype),
+        jax.ShapeDtypeStruct((), jr.key(0).dtype),
+        jnp.asarray(0, jnp.int32),
+        drv._aux(),
+        jnp.asarray(chunk, jnp.int32),
+        drv._obs_state,
     )
     return fn, args, drv
 

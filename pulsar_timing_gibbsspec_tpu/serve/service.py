@@ -42,6 +42,7 @@ from pathlib import Path
 
 import numpy as np
 
+from ..obs import trace as otrace
 from ..runtime import faults, preemption, supervisor, telemetry
 from .buckets import BucketOverflow, BucketTable, probe_shape
 from .engine import ProgramCache, compile_bucket, stack_cms
@@ -86,6 +87,7 @@ class SamplerService:
         self._X = self._B = self._K = None
         self._warmed: set = set()    # (chunk, active) combos already compiled
         self._fillers: dict = {}     # active-key -> (x, b) host filler state
+        self._diags: dict = {}       # job_id -> (RollingDiag, channel idx)
         self._evictions = 0
         self._compile_stalls = 0
         self._next_tenant = 0
@@ -157,7 +159,9 @@ class SamplerService:
 
         # staging a new dataset compiles small host->device programs;
         # mark them planned so retrace accounting only sees the sweep
-        with guards.planned_compile():
+        with guards.planned_compile(), \
+                otrace.span("serve.prepare", job=job.job_id,
+                            tenant=int(job.tenant_id)):
             cm = compile_bucket(job.pta, job.bucket)
             cm, warm = self.cache.adopt(job.bucket, cm)
         job.cm = cm
@@ -302,12 +306,14 @@ class SamplerService:
         if self._dirty:
             # membership change: restacking compiles small staging
             # programs (jnp.stack per leaf) — planned, not a retrace
-            with guards.planned_compile():
+            with guards.planned_compile(), otrace.span("serve.restack"):
                 self._build_stack()
         mux = self.cache.mux(self.chunk)
         warm_key = (self.chunk, self._active)
         if warm_key not in self._warmed:
-            with guards.planned_compile():
+            with guards.planned_compile(), \
+                    otrace.span("serve.compile_dispatch",
+                                chunk=self.global_chunk):
                 args = (self._stack, self._X, self._B, self._K,
                         self._it0())
                 X, B, xs, bs = mux(*args)
@@ -315,36 +321,57 @@ class SamplerService:
         else:
             # the zero-retrace contract lives HERE: a steady chunk with
             # a warmed (chunk, group) must compile nothing
-            X, B, xs, bs = mux(self._stack, self._X, self._B, self._K,
-                               self._it0())
+            with otrace.span("serve.dispatch", chunk=self.global_chunk):
+                X, B, xs, bs = mux(self._stack, self._X, self._B,
+                                   self._K, self._it0())
         self._X, self._B = X, B
-        np_xs = np.asarray(xs, np.float64)         # (chunk, T, nx)
-        np_bs = np.asarray(bs, np.float64)         # (chunk, T, P, Bmax)
+        with otrace.span("serve.d2h", chunk=self.global_chunk):
+            np_xs = np.asarray(xs, np.float64)     # (chunk, T, nx)
+            np_bs = np.asarray(bs, np.float64)     # (chunk, T, P, Bmax)
         now = time.monotonic()
-        for slot, job in enumerate(self.residents):
-            if job is None:
-                continue
-            rows = np_xs[:, slot]
-            brows = np_bs[:, slot].reshape(self.chunk, -1)
-            take = min(self.chunk, job.niter - job.it)
-            if not (np.isfinite(rows[:take]).all()
-                    and np.isfinite(brows[:take]).all()):
-                telemetry.incr("sentinel_trips")
-                job.failure = "divergence: non-finite chunk rows"
-                job.set_state("failed")
-                self.residents[slot] = None
-                self._dirty = True
-                continue
-            job.chain[job.it:job.it + take] = rows[:take]
-            job.bchain[job.it:job.it + take] = brows[:take]
-            job.it += take
-            job.x = rows[take - 1].copy()
-            job.b = np_bs[take - 1, slot].copy()
-            job.chunks_resident += 1
-            if job.first_sample_at is None:
-                job.first_sample_at = now
-                telemetry.gauge("time_to_first_sample_ms",
-                                job.time_to_first_sample_ms())
+        with otrace.span("serve.writeback", chunk=self.global_chunk):
+            for slot, job in enumerate(self.residents):
+                if job is None:
+                    continue
+                rows = np_xs[:, slot]
+                brows = np_bs[:, slot].reshape(self.chunk, -1)
+                take = min(self.chunk, job.niter - job.it)
+                if not (np.isfinite(rows[:take]).all()
+                        and np.isfinite(brows[:take]).all()):
+                    telemetry.incr("sentinel_trips")
+                    job.failure = "divergence: non-finite chunk rows"
+                    job.set_state("failed")
+                    self.residents[slot] = None
+                    self._dirty = True
+                    continue
+                job.chain[job.it:job.it + take] = rows[:take]
+                job.bchain[job.it:job.it + take] = brows[:take]
+                job.it += take
+                job.x = rows[take - 1].copy()
+                job.b = np_bs[take - 1, slot].copy()
+                job.chunks_resident += 1
+                if job.first_sample_at is None:
+                    job.first_sample_at = now
+                    telemetry.gauge("time_to_first_sample_ms",
+                                    job.time_to_first_sample_ms())
+                self._observe_job(job, rows[:take], now)
+
+    def _observe_job(self, job, rows, now):
+        """Feed the job's live diagnostics window and publish its SLO
+        gauges (labeled per job/tenant so series never collide)."""
+        got = self._diags.get(job.job_id)
+        if got is None:
+            from ..obs.sketch import make_sketch_spec
+            from ..obs.summary import RollingDiag
+
+            ch = np.asarray(make_sketch_spec(job.cm).channels)
+            got = self._diags[job.job_id] = (RollingDiag(), ch)
+        diag, ch = got
+        diag.observe(rows[:, ch], now)
+        lab = {"job": job.job_id, "tenant": str(int(job.tenant_id))}
+        telemetry.gauge("serve_ess_per_sec", diag.ess_per_sec(), **lab)
+        telemetry.gauge("serve_rhat_max", diag.rhat_max(), **lab)
+        telemetry.gauge("serve_accept_rate", diag.accept_rate(), **lab)
 
     # -- drain / recovery ---------------------------------------------------
 
@@ -355,16 +382,19 @@ class SamplerService:
 
         rows = 0
         all_ok = True
-        for slot, job in enumerate(self.residents):
-            if job is None:
-                continue
-            job.set_state("draining")
-            job.checkpoint()
-            res = integrity.verify(job.store.outdir)
-            if not res["ok"]:
-                all_ok = integrity.rollback(job.store.outdir) and all_ok
-            rows += job.it
-            job.set_state("queued")     # resumable, not failed
+        with otrace.span("serve.drain",
+                         jobs=sum(1 for j in self.residents if j)):
+            for slot, job in enumerate(self.residents):
+                if job is None:
+                    continue
+                job.set_state("draining")
+                job.checkpoint()
+                res = integrity.verify(job.store.outdir)
+                if not res["ok"]:
+                    all_ok = integrity.rollback(job.store.outdir) \
+                        and all_ok
+                rows += job.it
+                job.set_state("queued")     # resumable, not failed
         preemption.mark_drained()
         raise preemption.Preempted(
             f"service drained {sum(1 for j in self.residents if j)} "
@@ -455,6 +485,15 @@ class SamplerService:
             if not worked and not self.queue:
                 break
         return self.report()
+
+    def prometheus(self) -> str:
+        """Prometheus text-format exposition of the process telemetry
+        registry — counters (``_total``) and gauges, labels preserved,
+        including the per-job ``serve_ess_per_sec`` /
+        ``serve_rhat_max`` / ``serve_accept_rate`` SLO series."""
+        from ..obs import metrics
+
+        return metrics.render_telemetry()
 
     def report(self) -> dict:
         jobs = {jid: {"state": j.state, "it": int(j.it),

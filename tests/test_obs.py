@@ -1,0 +1,311 @@
+"""Observability stack: on-device sketches, convergence finalizers,
+trace spans, metrics exposition — and the bitwise-identity guarantee of
+the instrumented chunk (the obs acceptance contract).
+
+The sketch math tests drive :mod:`obs.sketch` directly with synthetic
+streams in uneven chunks (the driver's chunk grid must not matter);
+the driver test runs the real compiled chunk twice, obs off and on,
+and asserts byte-identical sampling outputs.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from pulsar_timing_gibbsspec_tpu.config import settings
+
+# the sketch state is f64 by contract; x64 must be on before any traced
+# op (normally settings.apply() runs at model-compile entry)
+settings.apply()
+
+from pulsar_timing_gibbsspec_tpu.obs import convergence, metrics, summary
+from pulsar_timing_gibbsspec_tpu.obs.sketch import (SketchSpec, init_state,
+                                                    state_bytes, update)
+from pulsar_timing_gibbsspec_tpu.obs.summary import (RollingDiag, finalize,
+                                                     moment_split_rhat)
+from pulsar_timing_gibbsspec_tpu.ops.acf import integrated_act
+from pulsar_timing_gibbsspec_tpu.runtime import telemetry
+
+
+def _spec(D=3, cross=2, lags=16):
+    return SketchSpec(
+        channels=np.arange(D, dtype=np.int32),
+        names=tuple(f"p{i}_gw_rho" for i in range(D)),
+        cross_k=cross, lags=lags,
+        groups=(("all", np.arange(D, dtype=np.int32)),))
+
+
+def _stream(spec, xs, chunks):
+    """Feed ``xs`` (n, C, D) through ``update`` on the given chunk grid,
+    returning (host state, per-chunk cumulative moment snapshots)."""
+    import jax.numpy as jnp
+
+    st = init_state(spec, xs.shape[1])
+    x0 = jnp.zeros(xs.shape[1:])
+    snaps, row = [], 0
+    for c in chunks:
+        blk = jnp.asarray(xs[row:row + c])
+        st = update(spec, st, x0, blk)
+        x0 = blk[-1]
+        row += c
+        snaps.append((float(np.asarray(st["n"])),
+                      np.asarray(st["mean"], np.float64),
+                      np.asarray(st["m2"], np.float64)))
+    assert row == len(xs)
+    return {k: np.asarray(v) for k, v in st.items()}, snaps
+
+
+def _ar1(rng, n, C, D, phi=0.7):
+    x = np.zeros((n, C, D))
+    e = rng.standard_normal((n, C, D)) * np.sqrt(1 - phi**2)
+    for t in range(1, n):
+        x[t] = phi * x[t - 1] + e[t]
+    return x
+
+
+# ---------------------------------------------------------------------------
+# sketch math
+
+
+def test_welford_and_cross_cov_match_numpy():
+    rng = np.random.default_rng(0)
+    spec = _spec(D=3, cross=3, lags=8)
+    xs = 3.0 + 2.0 * rng.standard_normal((57, 2, 3))
+    st, _ = _stream(spec, xs, chunks=(7, 13, 37))
+    fin = finalize(spec, st)
+    flat = np.moveaxis(xs, 0, -1)                     # (C, D, n)
+    np.testing.assert_allclose(fin["mean"], flat.mean(-1), atol=1e-10)
+    np.testing.assert_allclose(fin["var"], flat.var(-1, ddof=1),
+                               rtol=1e-10)
+    for c in range(2):
+        want = np.cov(flat[c], ddof=1)                # (D, D)
+        np.testing.assert_allclose(fin["cross_cov"][c], want, rtol=1e-8)
+
+
+def test_state_bytes_matches_pytree():
+    spec = _spec(D=5, cross=2, lags=32)
+    st = init_state(spec, 3)
+    got = sum(np.asarray(v).nbytes for v in st.values())
+    assert got == state_bytes(spec, 3)
+
+
+def test_device_act_matches_host_sokal_on_ar1():
+    """The acceptance bound: one-pass device ACT within 10% of the host
+    Sokal estimator on the same stream (AR(1), true tau ~ 5.67)."""
+    rng = np.random.default_rng(1)
+    phi = 0.7
+    spec = _spec(D=1, cross=1, lags=64)
+    xs = _ar1(rng, 4000, 2, 1, phi)
+    st, _ = _stream(spec, xs, chunks=(250,) * 16)
+    fin = finalize(spec, st)
+    for c in range(2):
+        host = integrated_act(xs[:, c, 0])
+        dev = float(fin["act"][c, 0])
+        assert abs(dev - host) / host < 0.10
+    # and both near the analytic tau = (1+phi)/(1-phi)
+    true_tau = (1 + phi) / (1 - phi)
+    assert abs(float(np.median(fin["act"])) - true_tau) / true_tau < 0.25
+    assert not fin["window_saturated"]
+    assert fin["act_rho_med"] > 1.0
+    assert fin["ess_total"] > 0
+
+
+def test_move_rate_counts_changed_transitions():
+    spec = _spec(D=2, cross=0, lags=4)
+    # chain 0 moves every sweep, chain 1 is frozen
+    xs = np.zeros((10, 2, 2))
+    xs[:, 0, :] = np.arange(10)[:, None]
+    st, _ = _stream(spec, xs, chunks=(4, 6))
+    fin = finalize(spec, st)
+    rate = fin["move_rate"]["all"]
+    assert rate[0] > 0.85           # first transition from x0=0 counts
+    assert rate[1] < 0.15
+
+
+# ---------------------------------------------------------------------------
+# convergence
+
+
+def test_rank_split_rhat_iid_near_one_and_shifted_large():
+    rng = np.random.default_rng(2)
+    iid = rng.standard_normal((4, 600))
+    assert convergence.rank_normalized_split_rhat(iid) < 1.05
+    shifted = iid + np.arange(4)[:, None] * 3.0
+    assert convergence.rank_normalized_split_rhat(shifted) > 1.5
+    # the folded half catches scale (tail) drift the bulk half misses
+    scaled = iid * (1.0 + 3.0 * np.arange(4))[:, None]
+    assert convergence.rank_normalized_split_rhat(scaled) > 1.2
+
+
+def test_ensemble_rhat_shapes():
+    rng = np.random.default_rng(3)
+    x = rng.standard_normal((3, 400, 5))
+    r = convergence.ensemble_rhat(x)
+    assert r.shape == (5,)
+    assert np.all(r < 1.05)
+
+
+def test_moment_split_rhat_from_snapshots():
+    rng = np.random.default_rng(4)
+    spec = _spec(D=2, cross=0, lags=4)
+    xs = rng.standard_normal((400, 3, 2))
+    st, snaps = _stream(spec, xs, chunks=(50,) * 8)
+    r = moment_split_rhat(snaps, st)
+    assert r.shape == (2,)
+    assert np.all(r < 1.05)
+    # a level shift halfway through the stream must blow up R-hat
+    xs2 = xs.copy()
+    xs2[200:] += 5.0
+    st2, snaps2 = _stream(spec, xs2, chunks=(50,) * 8)
+    r2 = moment_split_rhat(snaps2, st2)
+    assert np.all(r2 > 2.0)
+
+
+def test_rolling_diag_gauges():
+    rng = np.random.default_rng(5)
+    d = RollingDiag(cap=256)
+    rows = _ar1(rng, 300, 1, 3)[:, 0, :]
+    for i in range(0, 300, 25):
+        d.observe(rows[i:i + 25], now=float(i))
+    assert d.row_rate() > 0
+    assert d.act() >= 1.0
+    assert d.ess_per_sec() > 0
+    assert d.rhat_max() < 1.2
+    assert 0.0 <= d.accept_rate() <= 1.0
+
+
+# ---------------------------------------------------------------------------
+# the driver acceptance: instrumentation must not touch sampling
+
+
+def test_instrumented_chunk_bitwise_identical():
+    from pulsar_timing_gibbsspec_tpu.analysis.jaxprcheck.entries import (
+        build_model, synthetic_pulsars)
+    from pulsar_timing_gibbsspec_tpu.sampler.jax_backend import (
+        JaxGibbsDriver)
+
+    pta = build_model(synthetic_pulsars(2, 24, tm_cols=3, seed=0), 2)
+    x0 = pta.initial_sample(np.random.default_rng(0))
+    runs = {}
+    for obs in (None, True):
+        drv = JaxGibbsDriver(pta, seed=7, common_rho=True,
+                             white_adapt_iters=6, chunk_size=8,
+                             nchains=2, warmup_sweeps=6, obs=obs)
+        cs, bs = drv.chain_shapes(30)
+        chain, bchain = np.zeros(cs), np.zeros(bs)
+        for _ in drv.run(x0, chain, bchain, 0, 30):
+            pass
+        runs[obs] = (chain, bchain, drv)
+    assert runs[None][0].tobytes() == runs[True][0].tobytes()
+    assert runs[None][1].tobytes() == runs[True][1].tobytes()
+    s = runs[True][2].obs_summary()
+    assert s["n"] > 0
+    assert np.isfinite(s["act_rho_med"])
+    with pytest.raises(RuntimeError):
+        runs[None][2].obs_summary()
+
+
+# ---------------------------------------------------------------------------
+# trace layer
+
+
+def test_trace_spans_nest_and_export(tmp_path):
+    trace = __import__("pulsar_timing_gibbsspec_tpu.obs.trace",
+                       fromlist=["trace"])
+    sink_lines = []
+    trace.enable(lambda ev: sink_lines.append(ev))
+    try:
+        with trace.span("outer", row=1):
+            with trace.span("inner"):
+                pass
+        trace.instant("mark", x=2)
+        evs = trace.events()
+    finally:
+        path = trace.write_chrome(tmp_path / "t.json")
+        trace.disable()
+    names = [e["name"] for e in evs]
+    assert names == ["inner", "outer", "mark"]   # spans close inner-first
+    outer = evs[1]
+    inner = evs[0]
+    assert outer["ph"] == "X" and inner["ph"] == "X"
+    # containment: inner lies within outer on the same track
+    assert outer["ts"] <= inner["ts"]
+    assert inner["ts"] + inner["dur"] <= outer["ts"] + outer["dur"] + 1
+    assert outer["args"] == {"row": 1}
+    doc = json.loads((tmp_path / "t.json").read_text())
+    assert path == str(tmp_path / "t.json")
+    assert len(doc["traceEvents"]) == 3
+    # the sink saw the same three events as structured lines
+    assert [ev["name"] for ev in sink_lines] == names
+
+
+def test_trace_disabled_is_free():
+    from pulsar_timing_gibbsspec_tpu.obs import trace
+
+    trace.disable()
+    before = trace.events()             # disable keeps the buffer for
+    a = trace.span("x")                 # late export; enable() clears it
+    b = trace.span("y", k=1)
+    assert a is b                       # one shared nullcontext
+    with a:
+        pass
+    trace.instant("z")
+    assert trace.events() == before     # nothing recorded while off
+
+
+# ---------------------------------------------------------------------------
+# telemetry labels + metrics exposition
+
+
+def test_telemetry_labels_and_scoped_reset():
+    telemetry.reset("tobs_")
+    telemetry.incr("tobs_hits", job="a")
+    telemetry.incr("tobs_hits", 2, job="b")
+    telemetry.incr("tobs_hits")
+    telemetry.gauge("tobs_speed", 1.5, job="a")
+    assert telemetry.get("tobs_hits", job="b") == 2
+    assert telemetry.get("tobs_hits") == 1
+    snap = telemetry.snapshot("tobs_")
+    assert snap == {"tobs_hits": 1, 'tobs_hits{job="a"}': 1,
+                    'tobs_hits{job="b"}': 2}
+    assert telemetry.get_gauge("tobs_speed", job="a") == 1.5
+    # scoped reset clears ONLY this namespace, labels included
+    telemetry.incr("other_counter_tobs_test")
+    telemetry.reset("tobs_")
+    assert telemetry.snapshot("tobs_") == {}
+    assert telemetry.get("other_counter_tobs_test") == 1
+    telemetry.reset("other_counter_tobs_test")
+
+
+def test_prometheus_render_format():
+    body = metrics.render(
+        counts={"hits": 3, 'hits{job="a b"}': 1},
+        gauges={"speed": 1.5, 'depth{q="x\\"y"}': 2.0},
+        prefix="t")
+    lines = body.splitlines()
+    assert "# TYPE t_hits_total counter" in lines
+    assert "t_hits_total 3" in lines
+    assert 't_hits_total{job="a b"} 1' in lines
+    assert "# TYPE t_speed gauge" in lines
+    assert "t_speed 1.5" in lines
+    assert body.endswith("\n")
+    # family header appears once even with several labeled series
+    assert sum(1 for ln in lines
+               if ln == "# TYPE t_hits_total counter") == 1
+
+
+def test_prometheus_sanitize_and_split_key():
+    assert metrics.sanitize("a-b.c") == "a_b_c"
+    assert metrics.sanitize("9lives")[0] == "_"
+    name, labels = metrics.split_key('m{a="1",b="x"}')
+    assert name == "m" and labels == {"a": "1", "b": "x"}
+    assert metrics.split_key("plain") == ("plain", {})
+
+
+def test_render_telemetry_roundtrip():
+    telemetry.reset("tobs2_")
+    telemetry.gauge("tobs2_ess", 12.5, job="j1")
+    body = metrics.render_telemetry()
+    assert 'ptgibbs_tobs2_ess{job="j1"} 12.5' in body
+    telemetry.reset("tobs2_")

@@ -1,0 +1,156 @@
+"""Observability probe: instrumented short sample -> trace + scrape body.
+
+Runs a short chunked sample of a synthetic CRN model with the streaming
+diagnostic sketch enabled (``obs=True``), the trace layer recording the
+full span taxonomy (docs/OBSERVABILITY.md), and the driver's
+``transfer_guard`` armed — then writes the three artifacts the obs
+stack promises:
+
+- ``trace.json``    Chrome/Perfetto trace of the pipeline spans
+  (``warmup.chunk``, ``chunk.host_prep``/``dispatch``/``d2h``/
+  ``writeback``, ``profile.*``) — load in ``chrome://tracing`` or
+  https://ui.perfetto.dev;
+- ``metrics.jsonl`` the same spans streamed as structured events;
+- ``prometheus.txt``  the Prometheus text-format scrape body of the
+  telemetry registry, including the obs summary gauges.
+
+Exit is nonzero when the instrumented steady loop violates its static
+contract dynamically: any UNPLANNED retrace (the sketch must ride the
+one compiled chunk program), any implicit host transfer inside a
+dispatch (``transfer_guard`` raises — the summary slab is the only
+sanctioned device->host surface beyond the record), a failed obs
+summary, or non-finite diagnostics.
+
+Usage: python tools/obs_probe.py [--niter N] [--nchains C] [--chunk N]
+       [--n-psr P] [--nmodes K] [--lags L] [--outdir DIR]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+import numpy as np
+
+if __name__ == "__main__":   # script bootstrap; no import side effects
+    sys.path.insert(0, ".")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--niter", type=int, default=120,
+                    help="total recorded iterations (short by design)")
+    ap.add_argument("--nchains", type=int, default=4)
+    ap.add_argument("--chunk", type=int, default=10)
+    ap.add_argument("--n-psr", type=int, default=3)
+    ap.add_argument("--nmodes", type=int, default=3)
+    ap.add_argument("--lags", type=int, default=64,
+                    help="one-pass ACF window of the device sketch")
+    ap.add_argument("--outdir", default="/tmp/obs_probe")
+    args = ap.parse_args()
+
+    from pulsar_timing_gibbsspec_tpu.analysis.jaxprcheck.entries import (
+        build_model, synthetic_pulsars)
+    from pulsar_timing_gibbsspec_tpu.obs import metrics, trace
+    from pulsar_timing_gibbsspec_tpu.profiling import (
+        dispatch_breakdown, recompile_counter)
+    from pulsar_timing_gibbsspec_tpu.runtime import telemetry
+    from pulsar_timing_gibbsspec_tpu.sampler.jax_backend import (
+        JaxGibbsDriver)
+
+    out = Path(args.outdir)
+    out.mkdir(parents=True, exist_ok=True)
+    telemetry.reset()
+    trace.enable(trace.jsonl_sink(out / "metrics.jsonl"))
+
+    pta = build_model(
+        synthetic_pulsars(args.n_psr, 40, tm_cols=3, seed=0), args.nmodes)
+    x0 = pta.initial_sample(np.random.default_rng(0))
+    # transfer_guard arms jax.transfer_guard("disallow") around every
+    # steady dispatch: an instrumentation-added implicit host transfer
+    # raises right here instead of silently eating the tunnel
+    drv = JaxGibbsDriver(pta, seed=1, common_rho=True,
+                         white_adapt_iters=20, chunk_size=args.chunk,
+                         nchains=args.nchains, warmup_sweeps=20,
+                         transfer_guard=True, obs={"lags": args.lags})
+    cshape, bshape = drv.chain_shapes(args.niter)
+    chain = np.zeros(cshape)
+    bchain = np.zeros(bshape)
+
+    failures = []
+    with recompile_counter() as rc:
+        rc.phase("warmup")
+        it = drv.run(x0, chain, bchain, 0, args.niter)
+        try:
+            next(it)                     # warmup + first compiles
+            rc.phase("steady")
+            for _ in it:
+                pass
+        except Exception as exc:         # noqa: BLE001 — report, then fail
+            failures.append(f"instrumented run raised "
+                            f"{type(exc).__name__}: {exc}")
+    retraces = rc.unplanned("steady")
+    if retraces:
+        failures.append(f"{retraces} unplanned retrace(s) in the "
+                        "instrumented steady loop")
+
+    summary = None
+    if not failures:
+        try:
+            s = drv.obs_summary()
+            summary = {
+                "n": s["n"],
+                "act_rho_med": round(float(s["act_rho_med"]), 3),
+                "ess_total": round(float(s["ess_total"]), 1),
+                "rhat_max": (None if s.get("rhat_max") is None
+                             else round(float(s["rhat_max"]), 4)),
+                "window_saturated": bool(s.get("window_saturated")),
+                "move_rate": {k: round(float(np.mean(v)), 4)
+                              for k, v in s["move_rate"].items()},
+            }
+            if not np.isfinite(s["act_rho_med"]):
+                failures.append("non-finite device ACT")
+            telemetry.gauge("obs_act_rho_med", float(s["act_rho_med"]))
+            telemetry.gauge("obs_ess_total", float(s["ess_total"]))
+            if s.get("rhat_max") is not None:
+                telemetry.gauge("obs_rhat_max", float(s["rhat_max"]))
+        except Exception as exc:         # noqa: BLE001
+            failures.append(f"obs summary failed: "
+                            f"{type(exc).__name__}: {exc}")
+        try:
+            bd = dispatch_breakdown(drv, drv.x_cur)
+            for stage, ms in bd.items():
+                telemetry.gauge("chunk_stage_ms", ms, stage=stage)
+        except Exception as exc:         # noqa: BLE001
+            failures.append(f"dispatch breakdown failed: "
+                            f"{type(exc).__name__}: {exc}")
+
+    trace_path = trace.write_chrome(out / "trace.json")
+    (out / "prometheus.txt").write_text(metrics.render_telemetry())
+    trace.disable()
+
+    spans = {}
+    for ev in trace.events():
+        if ev.get("ph") == "X":
+            spans[ev["name"]] = spans.get(ev["name"], 0) + 1
+    report = {
+        "niter": args.niter, "nchains": args.nchains,
+        "chunk": args.chunk,
+        "unplanned_steady_retraces": retraces,
+        "span_counts": spans,
+        "obs_summary": summary,
+        "artifacts": {"trace": trace_path,
+                      "metrics": str(out / "metrics.jsonl"),
+                      "prometheus": str(out / "prometheus.txt")},
+        "failures": failures,
+    }
+    print(json.dumps(report, indent=2))
+    if failures:
+        print("FAIL: " + "; ".join(failures), file=sys.stderr)
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()
