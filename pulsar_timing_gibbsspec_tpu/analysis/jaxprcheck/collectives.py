@@ -60,14 +60,105 @@ def check_gather_budget(counts: dict, max_gather_elems):
             "is crossing the mesh")
 
 
-def census(fn, *example_args, max_gather_elems=None) -> dict:
+def optimized_hlo(fn, *example_args) -> str:
     """Lower + compile ``fn`` (host-side AOT only — nothing executes on
-    a device) and census the optimized HLO."""
+    a device) and return the optimized HLO text."""
     import jax
 
-    hlo = jax.jit(fn).lower(*example_args).compile().as_text()
-    counts = census_from_hlo(hlo)
+    return jax.jit(fn).lower(*example_args).compile().as_text()
+
+
+def census(fn, *example_args, max_gather_elems=None) -> dict:
+    """Census the optimized HLO of ``fn``."""
+    counts = census_from_hlo(optimized_hlo(fn, *example_args))
     msg = check_gather_budget(counts, max_gather_elems)
     if msg is not None:
         raise RuntimeError(msg)
     return counts
+
+
+_COLLECTIVE_OPS = ("all-reduce", "all-gather", "reduce-scatter",
+                   "all-to-all", "collective-permute",
+                   "collective-broadcast")
+
+
+def collective_groups(hlo: str) -> list:
+    """``[(op, groups)]`` for every collective defining line, with
+    ``groups`` as lists of global device ids.
+
+    Three HLO spellings are decoded: explicit
+    ``replica_groups={{0,1},{2,3}}`` lists, the iota form
+    ``replica_groups=[G,S]<=[dims](T(perm))?`` (arange over ``dims``,
+    optionally transposed, reshaped to G groups of S), and
+    ``collective-permute``'s ``source_target_pairs`` (each pair is a
+    2-device group).  A collective whose groups cannot be decoded —
+    including the bare ``replica_groups={}`` meaning *all devices* —
+    yields one group spanning every mentioned partition id, so an
+    unrecognized spelling fails an isolation check loudly instead of
+    slipping past it.
+    """
+    out = []
+    op_re = "|".join(re.escape(o) for o in _COLLECTIVE_OPS)
+    for m in re.finditer(r"\b(%s)(?:-start)?\(" % op_re, hlo):
+        start = hlo.rfind("\n", 0, m.start()) + 1
+        end = hlo.find("\n", m.start())
+        line = hlo[start:end if end >= 0 else len(hlo)]
+        op, groups = m.group(1), None
+        gm = re.search(r"replica_groups=(\{\{[0-9, ]*\}"
+                       r"(?:,\{[0-9, ]*\})*\})", line)
+        if gm:
+            groups = [[int(x) for x in g.split(",") if x.strip()]
+                      for g in re.findall(r"\{([0-9, ]*)\}", gm.group(1))]
+        else:
+            im = re.search(r"replica_groups=\[(\d+),(\d+)\]<=\[([0-9,]+)\]"
+                           r"(?:T\(([0-9,]+)\))?", line)
+            if im:
+                g, s = int(im.group(1)), int(im.group(2))
+                dims = [int(v) for v in im.group(3).split(",")]
+                ids = np.arange(int(np.prod(dims))).reshape(dims)
+                if im.group(4):
+                    perm = [int(v) for v in im.group(4).split(",")]
+                    ids = ids.transpose(perm)
+                groups = ids.reshape(g, s).tolist()
+        if groups is None and op == "collective-permute":
+            pm = re.search(r"source_target_pairs=(\{\{[0-9, ]*\}"
+                           r"(?:,\{[0-9, ]*\})*\})", line)
+            if pm:
+                groups = [[int(x) for x in g.split(",") if x.strip()]
+                          for g in re.findall(r"\{([0-9, ]*)\}",
+                                              pm.group(1))]
+        out.append((op, groups))
+    return out
+
+
+def check_axis_isolation(hlo: str, mesh_shape, axis=0) -> list:
+    """Messages for collectives whose replica groups cross ``axis`` of
+    a row-major device mesh of ``mesh_shape`` — the static proof that
+    an "embarrassingly parallel" mesh axis really carries zero
+    collective traffic.
+
+    With devices laid out row-major over ``mesh_shape`` (exactly what
+    ``parallel.sharding.make_mesh`` does), device ``d``'s coordinate
+    along ``axis`` is ``unravel_index(d, mesh_shape)[axis]``; a replica
+    group containing two distinct coordinates means bytes move across
+    that axis.  Undecodable group spellings are treated as
+    all-device groups (see :func:`collective_groups`) and therefore
+    fail here rather than pass silently.
+    """
+    shape = tuple(int(s) for s in mesh_shape)
+    n_dev = int(np.prod(shape))
+    msgs = []
+    for op, groups in collective_groups(hlo):
+        if not groups:
+            groups = [list(range(n_dev))]
+        for g in groups:
+            coords = {int(np.unravel_index(int(d), shape)[axis])
+                      for d in g}
+            if len(coords) > 1:
+                msgs.append(
+                    f"{op} replica group {g} spans coordinates "
+                    f"{sorted(coords)} of mesh axis {axis} (shape "
+                    f"{shape}) — this axis is contracted to carry "
+                    "zero collective traffic")
+                break
+    return msgs

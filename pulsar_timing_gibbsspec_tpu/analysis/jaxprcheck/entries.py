@@ -18,6 +18,10 @@ Entry kinds (the ``entry`` field of a contract):
 - ``sharded_step`` — one CRN sweep step under pulsar-axis sharding on
   a host-device mesh (mirrors the MULTICHIP dry-run): the C2 census
   target.
+- ``sharded_2d`` — the same step vmapped over chains on a 2-d
+  ``(chain, pulsar)`` mesh, carries chain-sharded: its census pinned
+  byte-identical to ``sharded_step``'s proves the chain axis is
+  collective-free (``crn_2d_mesh``).
 - ``serve_mux`` — the routed multiplexed steady chunk of the serving
   layer: >= 3 heterogeneous synthetic datasets snapped into ONE bucket,
   grafted onto one static box, stacked, and traced as one program.  The
@@ -146,6 +150,58 @@ def _sharded_step_entry(spec):
     return step, (cm, x0, b0, jr.key(0)), {}
 
 
+def _sharded_2d_entry(spec):
+    """2-d ``(chain, pulsar)`` mesh mirror of the MULTICHIP dry-run:
+    the compiled model pulsar-sharded over the LAST mesh axis, the
+    vmapped chain carry (x, b, per-chain keys) chain-sharded over the
+    first, one CRN sweep step per chain.
+
+    The chain axis must add ZERO collectives — chains are independent
+    Gibbs processes (per-chain ``fold_in`` streams, no cross-chain
+    term anywhere in the sweep) — so the census of this entry is
+    pinned byte-identical to the 1-d ``sharded_step`` census
+    (``crn_multichip``): equality of the two censuses IS the
+    zero-chain-collectives check, measured, not asserted."""
+    import jax
+    import jax.numpy as jnp
+    import jax.random as jr
+
+    from ...parallel.sharding import (chain_sharding, make_mesh,
+                                      shard_compiled)
+    from ...sampler import jax_backend as jb
+    from ...sampler.compiled import compile_pta
+
+    shape = tuple(int(s) for s in spec.get("mesh", (2, 4)))
+    C = int(spec.get("nchains", 4))
+    psrs = synthetic_pulsars(spec.get("n_psr", 15), spec.get("ntoa", 24),
+                             tm_cols=spec.get("tm_cols", 3),
+                             seed=spec.get("seed", 0))
+    pta = build_model(psrs, spec.get("nmodes", 3))
+    n_psr_dev = shape[1]
+    pad = spec.get("pad_pulsars",
+                   -(-len(psrs) // n_psr_dev) * n_psr_dev)
+    cm = compile_pta(pta, pad_pulsars=pad)
+    mesh = make_mesh(shape)
+    cm = shard_compiled(cm, mesh)
+
+    # cm rides as a jit ARGUMENT (closure constants lose shardings);
+    # the chain carries are committed with chain_sharding so the
+    # partitioner sees the 2-d placement the production driver stages
+    def step(cm_, x, b, keys):
+        return jax.vmap(
+            lambda xx, bb, kk: jb.sharded_sweep_step(cm_, xx, bb, kk)
+        )(x, b, keys)
+
+    x0 = jnp.tile(jnp.asarray(
+        pta.initial_sample(np.random.default_rng(0)), cm.cdtype), (C, 1))
+    b0 = jnp.zeros((C, cm.P, cm.Bmax), cm.cdtype)
+    keys = jr.split(jr.key(spec.get("seed", 0)), C)
+    x0 = jax.device_put(x0, chain_sharding(mesh, x0.ndim))
+    b0 = jax.device_put(b0, chain_sharding(mesh, b0.ndim))
+    keys = jax.device_put(keys, chain_sharding(mesh, keys.ndim))
+    return step, (cm, x0, b0, keys), {}
+
+
 def _serve_mux_entry(spec):
     """Routed multiplexed chunk over heterogeneous datasets sharing one
     bucket.  Every condition the serving layer's zero-retrace guarantee
@@ -194,6 +250,7 @@ def _serve_mux_entry(spec):
 _ENTRIES = {"gram": _gram_entry, "chunk": _chunk_entry,
             "obs_chunk": _obs_chunk_entry,
             "sharded_step": _sharded_step_entry,
+            "sharded_2d": _sharded_2d_entry,
             "serve_mux": _serve_mux_entry}
 
 

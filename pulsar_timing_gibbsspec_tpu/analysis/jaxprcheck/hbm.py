@@ -14,7 +14,7 @@ intermediates are dominated by one huge term — the exact-Gram
 materialize a segmented operand copy.  The scratch model is calibrated
 against the r4 measurement (README / ROADMAP item 1): an
 ``(nseg, C, P, Nmax, B1)`` copy with ``nseg = ceil(N_contract /
-GRAM_SEG_LEN)`` segments, tile-padded — which reproduces the measured
+gram_seg_len_exact)`` segments, tile-padded — which reproduces the measured
 3.4x pad ratio and 15.8 GiB at C=128 to <1%.  Because it is a
 calibrated heuristic, contracts that assert "passes" carry an expected
 estimate plus a relative tolerance, so silent drift of the *model* is
@@ -30,8 +30,13 @@ import os
 from .walk import aval_bytes, iter_eqns, source_of, tile_padded_bytes
 
 #: segment length of the scratch model — must track
-#: ``sampler.jax_backend.GRAM_SEG_LEN`` (imported lazily to keep this
-#: module jax-free until audit time)
+#: ``config.Settings.gram_seg_len_exact`` (the exact-Gram segment
+#: length; kept as a plain constant so this module stays jax-free and
+#: import-light until audit time).  The model and the program meet in
+#: the middle: a widening dot whose contraction is <= this length
+#: models as nseg=1 — exactly the segmented exact ``tnt_d`` path that
+#: killed the C=128 wall — while a monolithic contraction models the
+#: multi-segment operand-copy scratch the r4 measurement calibrated.
 DEFAULT_SEG_LEN = 96
 
 GiB = float(1 << 30)

@@ -13,7 +13,8 @@ A contract is a committed JSON file under ``<repo>/contracts/``:
         {"kind": "hbm", "budget_bytes": 16911433728,
          "expect": "violation", "expect_source_fn": "tnt_d",
          "expect_scratch_bytes": 16986931200, "tolerance_rel": 0.02},
-        {"kind": "collectives", "census": {"all-reduce": 6}, "...": 0},
+        {"kind": "collectives", "census": {"all-reduce": 6},
+         "isolate_axis": {"mesh": [2, 4], "axis": 0}, "...": 0},
         {"kind": "dtypes", "exact_fns": ["linalg.py"], "census": {}},
         {"kind": "keys", "policy": {"fold_depths_at_split": [2]}},
         {"kind": "donation", "donate_argnums": [0, 1], "min_aliased": 2}
@@ -43,7 +44,8 @@ import json
 import os
 from pathlib import Path
 
-from .collectives import census, check_gather_budget
+from .collectives import (census_from_hlo, check_axis_isolation,
+                          check_gather_budget, optimized_hlo)
 from .donation import audit_donation, check_aliasing
 from .dtypes import audit_dtypes, dot_census
 from .entries import resolve_entry
@@ -152,7 +154,8 @@ def _check_hbm(chk, closed, fn, args):
 
 
 def _check_collectives(chk, closed, fn, args):
-    got = census(fn, *args)
+    hlo = optimized_hlo(fn, *args)
+    got = census_from_hlo(hlo)
     facts = {"census": got}
     out = []
     want = chk.get("census")
@@ -165,6 +168,13 @@ def _check_collectives(chk, closed, fn, args):
     msg = check_gather_budget(got, chk.get("max_gather_elems"))
     if msg is not None:
         out.append(msg)
+    iso = chk.get("isolate_axis")
+    if iso is not None:
+        msgs = check_axis_isolation(hlo, iso["mesh"], iso.get("axis", 0))
+        facts["isolate_axis"] = {"mesh": [int(s) for s in iso["mesh"]],
+                                 "axis": int(iso.get("axis", 0)),
+                                 "clean": not msgs}
+        out.extend(msgs)
     return out, facts
 
 

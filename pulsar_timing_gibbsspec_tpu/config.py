@@ -51,6 +51,32 @@ class Settings:
     #: (reference uses 1000, pulsar_gibbs.py:228)
     rho_grid_size: int = 1000
 
+    #: TOA-segment length of the segmented-f32 MXU Gram
+    #: (sampler/jax_backend.tnt_d_seg).  Error model: f32 accumulation
+    #: inside a segment of ~seg TOAs is bounded (Cauchy-Schwarz, relative
+    #: to the Jacobi scale sqrt(G_bb G_cc)) by ~sqrt(seg)*eps_f32 —
+    #: measured 2.5e-7 on the 45-pulsar bench state at seg=96, an order
+    #: below the preconditioned system's smallest eigenvalue (~4.5e-6),
+    #: so factors of the resulting Sigma stay safely positive definite
+    #: while the einsum runs ~60x faster than f64 accumulation.
+    gram_seg_len: int = int(os.environ.get("PTGIBBS_GRAM_SEG", "96"))
+
+    #: TOA-segment length of the segmented EXACT Gram
+    #: (sampler/jax_backend.tnt_d): per-segment f64-accumulated partial
+    #: Grams over f32 operands, reduced over segments in f64.  Error
+    #: model: every f32*f32 product is exactly representable in f64, so
+    #: the only difference from a monolithic f64 accumulation is the f64
+    #: partial-sum ORDER — a <= 1 ULP reassociation class, NOT the f32
+    #: O(sqrt(seg)*eps_f32) class of gram_seg_len above.  What segmenting
+    #: buys is compile-time memory: XLA's widening dot_general otherwise
+    #: materializes a ceil(N/seg)-segment operand-copy scratch (the
+    #: 15.8 GiB C=128 wall, analysis/jaxprcheck/hbm.py); with the contract
+    #: dimension bounded by this length the scratch collapses to one
+    #: segment.  96 keeps the jaxprcheck HBM scratch model's calibration
+    #: (hbm.DEFAULT_SEG_LEN) aligned with the program it audits.
+    gram_seg_len_exact: int = int(os.environ.get("PTGIBBS_GRAM_SEG_EXACT",
+                                                 "96"))
+
     #: mixed-precision mode of the structured correlated-ORF joint b-draw
     #: (sampler/jax_backend.draw_b_joint_structured): when on, the steady
     #: (exact=False) draw factors both stages with the two-float MXU

@@ -42,18 +42,27 @@ _REPLICATED_FIELDS = ("const_pool", "pkind", "pa", "pb", "prop_scale",
                       "rho_ix_x")
 
 
-def make_mesh(n_devices: int | None = None, axis: str = "pulsar"):
-    """A 1-d device mesh over the first ``n_devices`` devices (all by
-    default).  Raises if fewer than ``n_devices`` devices exist — an
-    under-provisioned mesh would silently drop the sharding it is supposed
-    to exercise.  Multi-host extension: pass the global device list order so
-    the pulsar axis rides ICI within each slice before spanning DCN."""
+def make_mesh(n_devices=None, axis: str = "pulsar"):
+    """A device mesh: 1-d over the pulsar axis, or 2-d ``(chain, pulsar)``.
+
+    ``n_devices`` is an int (or None = all devices) for the classic 1-d
+    pulsar mesh, or a 2-tuple ``(n_chain_devs, n_pulsar_devs)`` for the
+    2-d mesh — chains are embarrassingly parallel (independent Gibbs
+    processes, per-chain fold_in key streams), so the chain axis carries
+    ZERO collectives by construction and the one common-rho all-reduce
+    stays the only pulsar-axis traffic.  Raises if fewer devices exist
+    than the mesh needs — an under-provisioned mesh would silently drop
+    the sharding it is supposed to exercise.  Multi-host extension: pass
+    the global device list order so the pulsar axis rides ICI within
+    each slice before spanning DCN (the chain axis, collective-free,
+    tolerates DCN)."""
     import jax
     from jax.sharding import Mesh
 
     devs = jax.devices()
-    if n_devices is not None:
-        if len(devs) < n_devices:
+
+    def _need(n):
+        if len(devs) < n:
             raise RuntimeError(
                 f"make_mesh({n_devices}) but only {len(devs)} "
                 f"{devs[0].platform if devs else '?'} device(s) are "
@@ -62,8 +71,34 @@ def make_mesh(n_devices: int | None = None, axis: str = "pulsar"):
                 "jax.config.update('jax_platforms', 'cpu') and "
                 "XLA_FLAGS=--xla_force_host_platform_device_count=N "
                 "before backend init.")
-        devs = devs[:n_devices]
+
+    if isinstance(n_devices, (tuple, list, np.ndarray)):
+        shape = tuple(int(s) for s in n_devices)
+        if len(shape) != 2 or any(s < 1 for s in shape):
+            raise ValueError(
+                f"make_mesh expects (n_chain_devs, n_pulsar_devs), "
+                f"got {n_devices!r}")
+        _need(shape[0] * shape[1])
+        grid = np.asarray(devs[:shape[0] * shape[1]]).reshape(shape)
+        return Mesh(grid, ("chain", axis))
+    if n_devices is not None:
+        _need(int(n_devices))
+        devs = devs[:int(n_devices)]
     return Mesh(np.asarray(devs), (axis,))
+
+
+def pulsar_submesh_size(mesh) -> int:
+    """Devices along the mesh's pulsar axis (the LAST axis: the whole
+    mesh for the 1-d layout, ``shape[1]`` for ``(chain, pulsar)``)."""
+    return int(mesh.devices.shape[-1])
+
+
+def chain_submesh_size(mesh) -> int:
+    """Devices along the mesh's chain axis; 1 when the mesh has none
+    (the 1-d pulsar layout replicates the chain axis)."""
+    if mesh is None or "chain" not in mesh.axis_names:
+        return 1
+    return int(mesh.devices.shape[list(mesh.axis_names).index("chain")])
 
 
 def mesh_layout(mesh):
@@ -74,22 +109,84 @@ def mesh_layout(mesh):
     order, padded pulsar width, per-chain key folding) lives in the
     manifest's ``layout`` section and pins the sampled process, while
     this record is advisory — ``integrity.reshard_restore`` may rebuild
-    the mesh with any device count that divides the padded width."""
+    the mesh with any axis shape whose pulsar size divides the padded
+    width and whose chain size divides the chain count.  ``axes`` lists
+    ``[name, size]`` per mesh axis in order (the 2-d record); ``axis``
+    stays the pulsar axis name for back-compat readers."""
     if mesh is None:
         return None
     devs = mesh.devices.ravel()
     return {"devices": int(devs.size),
-            "axis": str(mesh.axis_names[0]),
+            "axis": str(mesh.axis_names[-1]),
+            "axes": [[str(n), int(s)]
+                     for n, s in zip(mesh.axis_names, mesh.devices.shape)],
             "platform": str(devs[0].platform) if devs.size else "?"}
 
 
 def pulsar_sharding(mesh, ndim: int):
-    """NamedSharding that splits axis 0 over the mesh's pulsar axis and
-    replicates the rest."""
+    """NamedSharding that splits axis 0 over the mesh's pulsar axis
+    (always the LAST mesh axis) and replicates the rest — including,
+    on a 2-d mesh, replication across the chain axis (every chain
+    submesh row holds the full pulsar shard set)."""
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    axis = mesh.axis_names[0]
+    axis = mesh.axis_names[-1]
     return NamedSharding(mesh, P(axis, *([None] * (ndim - 1))))
+
+
+def chain_sharding(mesh, ndim: int):
+    """NamedSharding that splits axis 0 over the mesh's chain axis and
+    replicates the rest (pulsar axis included: the sweep carry is tiny
+    per chain, and per-pulsar kernels reslice it locally).  On a mesh
+    without a chain axis this degrades to full replication, so callers
+    can apply it unconditionally."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    if "chain" not in mesh.axis_names:
+        return replicated_sharding(mesh)
+    return NamedSharding(mesh, P("chain", *([None] * (ndim - 1))))
+
+
+def validate_chains(mesh, nchains: int):
+    """Raise unless ``nchains`` splits evenly over the mesh's chain
+    axis — an uneven split would give GSPMD a ragged chain shard and
+    every ``(C, ...)`` carry a padded ghost chain whose rows never
+    reach the chain files.  Actionable by construction: says which
+    knob to turn."""
+    nc = chain_submesh_size(mesh)
+    if nc > 1 and int(nchains) % nc:
+        raise ValueError(
+            f"nchains={int(nchains)} does not divide over the mesh's "
+            f"chain axis ({nc} devices, mesh "
+            f"{tuple(mesh.devices.shape)}); pass nchains as a multiple "
+            f"of {nc} (e.g. nchains={-(-int(nchains) // nc) * nc}) or "
+            f"shrink the chain axis with make_mesh((n_chain, n_pulsar))")
+
+
+def shard_carry(mesh, tree, nchains: int):
+    """Place a sweep-carry pytree on the mesh's chain axis.
+
+    Every array leaf whose leading axis equals ``nchains`` (the chain
+    carries: x, b, record slabs, adaptation state, obs sketch) is
+    committed with :func:`chain_sharding`; other array leaves are
+    replicated.  A None mesh or a mesh without a chain axis returns the
+    tree untouched — the 1-d pulsar layout keeps its existing placement
+    (carries replicated, GSPMD decides)."""
+    if mesh is None or "chain" not in mesh.axis_names:
+        return tree
+    import jax
+
+    repl = replicated_sharding(mesh)
+
+    def _place(leaf):
+        nd = getattr(leaf, "ndim", None)
+        if nd is None:
+            return leaf
+        if nd >= 1 and leaf.shape[0] == int(nchains):
+            return jax.device_put(leaf, chain_sharding(mesh, nd))
+        return jax.device_put(leaf, repl)
+
+    return jax.tree_util.tree_map(_place, tree)
 
 
 def replicated_sharding(mesh):
@@ -105,10 +202,17 @@ def shard_compiled(cm: CompiledPTA, mesh) -> CompiledPTA:
     placement."""
     import jax
 
-    n = mesh.devices.size
+    n = pulsar_submesh_size(mesh)
     if cm.P % n:
+        # suggest padding for the PULSAR submesh, not the total device
+        # count: on a (chain, pulsar) mesh only the last axis splits
+        # the pulsar arrays
+        total = int(mesh.devices.size)
+        where = (f"the pulsar submesh ({n} of {total} devices, mesh "
+                 f"{tuple(mesh.devices.shape)})" if total != n
+                 else f"the mesh ({n} devices)")
         raise ValueError(
-            f"pulsar axis ({cm.P}) does not divide the mesh ({n} devices); "
+            f"pulsar axis ({cm.P}) does not divide {where}; "
             f"compile with pad_pulsars={-(-cm.P // n) * n}")
     repl = replicated_sharding(mesh)
     updates = {}

@@ -158,12 +158,18 @@ def reshard_restore(outdir, pta, devices=None, **gibbs_kwargs):
     and the logical fold indices are unchanged, only the physical
     placement of the same arrays moves.
 
-    ``devices=None`` resumes unsharded (single default device); ``1``
-    likewise skips the mesh.  The ``device_count_change_on_resume``
-    fault, when armed, overrides ``devices`` — the chaos suite's
-    stand-in for the pool handing the next incarnation a different
-    slice.  Returns the facade; call ``.sample(x0, outdir=outdir,
-    resume=True, ...)`` on it.
+    ``devices`` is an int — the classic 1-d pulsar mesh — or a 2-tuple
+    ``(n_chain_devs, n_pulsar_devs)`` for the 2-d chain-sharded mesh:
+    the pulsar size must divide the recorded padded width and the
+    chain size the recorded chain count, and any 2-d layout resumes
+    bitwise per LOGICAL chain from any other (chains are independent
+    processes keyed by logical index; placement never touches a
+    stream).  ``devices=None`` resumes unsharded (single default
+    device); ``1`` / ``(1, 1)`` likewise skip the mesh.  The
+    ``device_count_change_on_resume`` fault, when armed, overrides
+    ``devices`` — the chaos suite's stand-in for the pool handing the
+    next incarnation a different slice.  Returns the facade; call
+    ``.sample(x0, outdir=outdir, resume=True, ...)`` on it.
     """
     from . import faults
 
@@ -183,19 +189,30 @@ def reshard_restore(outdir, pta, devices=None, **gibbs_kwargs):
             f"logical layout is {want} but this PTA has {got}; the "
             "logical order IS the chain identity and cannot move")
     pad = int(lay.get("pad_pulsars", 0)) or None
+    if isinstance(devices, (tuple, list)):
+        n_chain, n_psr = (int(s) for s in devices)
+    else:
+        n_chain, n_psr = 1, (int(devices) if devices is not None else 1)
     mesh = None
-    if devices is not None and int(devices) > 1:
-        devices = int(devices)
-        if pad is None or pad % devices:
+    if n_chain * n_psr > 1:
+        if n_psr > 1 and (pad is None or pad % n_psr):
             raise CheckpointError(
                 f"{outdir}: checkpoint's padded pulsar width ({pad}) "
-                f"does not divide over {devices} devices; the padded "
+                f"does not divide over {n_psr} devices; the padded "
                 "width is part of the logical layout (PRNG draw shapes) "
-                "and cannot be changed on resume — pick a device count "
-                "that divides it")
+                "and cannot be changed on resume — pick a pulsar-axis "
+                "size that divides it")
+        nch = int(gibbs_kwargs.get("nchains", lay.get("nchains", 1)))
+        if n_chain > 1 and nch % n_chain:
+            raise CheckpointError(
+                f"{outdir}: checkpoint's chain count ({nch}) does not "
+                f"divide over a {n_chain}-device chain axis; the chain "
+                "count is part of the logical layout (per-chain key "
+                "folds) and cannot be changed on resume — pick a chain-"
+                "axis size that divides it")
         from ..parallel.sharding import make_mesh
 
-        mesh = make_mesh(devices)
+        mesh = make_mesh((n_chain, n_psr) if n_chain > 1 else n_psr)
     from ..sampler.gibbs import PTABlockGibbs, PulsarBlockGibbs
 
     cls = {"PulsarBlockGibbs": PulsarBlockGibbs,

@@ -74,38 +74,69 @@ DE_DELAY = 256
 # pure kernels (module-level so __graft_entry__ / parallel can reuse them)
 # ===========================================================================
 
-def tnt_d(cm: CompiledPTA, Nvec):
+def tnt_d(cm: CompiledPTA, Nvec, seg_len=None):
     """``TNT = T^T N^-1 T`` and ``d = T^T N^-1 y`` batched over pulsars
-    (the per-sweep cache of reference ``pulsar_gibbs.py:500-502``).
+    (the per-sweep cache of reference ``pulsar_gibbs.py:500-502``),
+    EXACT accumulation.
 
     Computed as one fused einsum over the augmented basis ``[T | y]``:
     the Gram matrix's last row/column delivers ``d`` (and ``y^T N^-1 y``)
     for free — on TPU's software-emulated f64 a separate matvec einsum
     for ``d`` costs nearly as much as the whole Gram update, so fusing is
     ~2x on this kernel.  Storage-dtype (f32) inputs with compute-dtype
-    (f64) accumulation: the sums are exact and the only error left is the
-    benign f32 rounding of the stored basis (backward error)."""
+    (f64) accumulation: every f32*f32 product is exactly representable
+    in f64, so the only error is the benign f32 rounding of the stored
+    basis (backward error) plus f64 summation rounding.
+
+    SEGMENTED exact path (``settings.gram_seg_len_exact``, env
+    ``PTGIBBS_GRAM_SEG_EXACT``): the TOA axis is split into ``nseg``
+    equal segments carried as an operand batch dimension (``psbc``
+    output order — the ``spbc`` form was THE out-of-memory term of
+    wide-chain compiles), each segment accumulated in f64 by the dot
+    itself, then the per-segment partial Grams are reduced over the
+    segment axis in f64.  This bounds the widening dot_general's
+    contraction length at seg_len, which collapses XLA's segmented
+    operand-copy scratch (ceil(N/seg) tile-padded copies, 15.8 GiB at
+    C=128 — analysis/jaxprcheck/hbm.py) to a single segment and is what
+    breaks the C=128 HBM wall.
+
+    Summation order (documented because it defines the exact oracle's
+    bitstream): TOAs accumulate inside each segment's f64 dot
+    accumulator, then the per-segment partial Grams reduce over the
+    segment axis in f64.  Relative to the monolithic single-dot
+    accumulation this is a pure f64 REASSOCIATION — same exact products,
+    different partial-sum grouping — so the two agree at the f64
+    rounding class: within a few ULP at the Jacobi scale
+    ``sqrt(G_bb G_cc)`` (measured 3e-16 on the bench-geometry state;
+    elements with heavy cancellation differ more in their OWN relative
+    terms, exactly as any reassociated f64 sum does), and bitwise when
+    nseg == 1 (N <= seg_len).  The ``exact`` oracle and the
+    ``exact_every`` Metropolised refresh keep their posteriors
+    (tests/test_jax_backend.py::test_tnt_d_segmented_parity).  Pads:
+    extra zero TOA rows with unit noise contribute exactly zero to
+    every segment."""
     import jax.numpy as jnp
 
+    if seg_len is None:
+        seg_len = settings.gram_seg_len_exact
     Ta = jnp.concatenate([jnp.asarray(cm.T, cm.dtype),
                           jnp.asarray(cm.y, cm.dtype)[:, :, None]], axis=2)
     TNa = Ta / Nvec.astype(cm.dtype)[:, :, None]
-    G = jnp.einsum("pnb,pnc->pbc", TNa, Ta,
+    P, N, B1 = Ta.shape
+    nseg = max(1, -(-N // seg_len))
+    m = -(-N // nseg)
+    if nseg * m != N:
+        pad = nseg * m - N
+        Ta = jnp.pad(Ta, ((0, 0), (0, pad), (0, 0)))
+        TNa = jnp.pad(TNa, ((0, 0), (0, pad), (0, 0)))
+    G = jnp.einsum("psnb,psnc->psbc", TNa.reshape(P, nseg, m, B1),
+                   Ta.reshape(P, nseg, m, B1),
                    preferred_element_type=cm.cdtype)
+    G = jnp.sum(G, axis=1)
     return G[:, :cm.Bmax, :cm.Bmax], G[:, :cm.Bmax, cm.Bmax]
 
 
-#: target TOA-segment length of the segmented Gram (``tnt_d_seg``): f32
-#: MXU accumulation inside segments of ~this many TOAs, f64 reduction
-#: over segments.  Error relative to the Jacobi scale sqrt(G_bb G_cc) is
-#: ~sqrt(seg)*eps_f32 (measured 2.5e-7 on the 45-pulsar bench state,
-#: vs a preconditioned lambda_min of ~4.5e-6), while the einsum runs
-#: ~60x faster than the f64-accumulated Gram (69.8 ms -> 1.3 ms at
-#: C=32 chains on one v5e)
-GRAM_SEG_LEN = 96
-
-
-def tnt_d_seg(cm: CompiledPTA, Nvec, seg_len=GRAM_SEG_LEN):
+def tnt_d_seg(cm: CompiledPTA, Nvec, seg_len=None):
     """Segmented-f32 MXU Gram: same quantities as :func:`tnt_d`, computed
     as per-segment f32 einsums (MXU, ``precision="highest"``) reduced
     over segments in f64.
@@ -125,14 +156,19 @@ def tnt_d_seg(cm: CompiledPTA, Nvec, seg_len=GRAM_SEG_LEN):
     directly, accepting a conditional perturbed at the same backward-
     error class as the already-accepted f32 basis storage (~4x the entry
     rounding) — not exact, documented.  Pads: extra zero TOA rows with
-    unit noise contribute exactly zero to every segment."""
+    unit noise contribute exactly zero to every segment.
+
+    Segment length: ``settings.gram_seg_len`` (env ``PTGIBBS_GRAM_SEG``),
+    with the error-model constants documented on the setting."""
     import jax.numpy as jnp
 
+    if seg_len is None:
+        seg_len = settings.gram_seg_len
     Ta = jnp.concatenate([jnp.asarray(cm.T, cm.dtype),
                           jnp.asarray(cm.y, cm.dtype)[:, :, None]], axis=2)
     TNa = Ta / Nvec.astype(cm.dtype)[:, :, None]
     P, N, B1 = Ta.shape
-    nseg = max(1, -(-N // int(seg_len)))
+    nseg = max(1, -(-N // seg_len))
     m = -(-N // nseg)
     if nseg * m != N:
         pad = nseg * m - N
@@ -2219,6 +2255,13 @@ class JaxGibbsDriver:
         self.C = int(nchains)
         if self.C < 1:
             raise ValueError("nchains must be >= 1")
+        if mesh is not None:
+            # a 2-d (chain, pulsar) mesh splits the vmapped chain axis:
+            # C must divide the chain submesh or every (C, ...) carry
+            # would need a ragged shard (actionable error, satellite 5)
+            from ..parallel.sharding import validate_chains
+
+            validate_chains(mesh, self.C)
         self.key = jr.key(np.random.SeedSequence(seed).generate_state(1)[0])
         #: common_rho asserts the model really has a shared free-spectrum
         #: block (PTABlockGibbs passes True); it is not a switch — the
@@ -3029,11 +3072,24 @@ class JaxGibbsDriver:
                 "the device sweep produced NaN/inf — check priors/initial "
                 "state; chain files up to the previous checkpoint are valid")
 
+    def _place_carry(self, tree):
+        """Commit every ``(C, ...)`` leaf of a carry pytree to the
+        mesh's chain axis (``parallel.sharding.shard_carry``).  A None
+        mesh or a 1-d pulsar mesh returns the tree untouched, so every
+        staging site calls this unconditionally.  Chains are
+        independent Gibbs processes, so placement alone makes the
+        chain axis collective-free — the contracts/crn_2d_mesh.json
+        census pins that."""
+        from ..parallel.sharding import shard_carry
+
+        return shard_carry(self._mesh, tree, self.C)
+
     def run(self, x, chain, bchain, start, niter):
         import jax.numpy as jnp
 
         cm = self.cm
-        x = jnp.asarray(self._x_in(x), dtype=cm.cdtype)   # (C, nx)
+        x = self._place_carry(
+            jnp.asarray(self._x_in(x), dtype=cm.cdtype))   # (C, nx)
         if cm.orf_B is not None:
             # sampled-ORF start state must be positive definite: the MH
             # block rejects non-PD proposals but cannot escape a non-PD
@@ -3078,10 +3134,11 @@ class JaxGibbsDriver:
                 self.key, sub = self._jr.split(self.key)
                 fn = self._warmup_chunk_fn(W)
                 with otrace.span("warmup.chunk", sweeps=W):
-                    x, b, xs, bs, health = fn(x, jnp.asarray(self.b), sub,
-                                              jnp.asarray(0, jnp.int32),
-                                              self._aux(),
-                                              jnp.asarray(W, jnp.int32))
+                    x, b, xs, bs, health = fn(
+                        x, self._place_carry(jnp.asarray(self.b)), sub,
+                        jnp.asarray(0, jnp.int32),
+                        self._place_carry(self._aux()),
+                        jnp.asarray(W, jnp.int32))
                 self.b = b
                 xs_h = self._squeeze(np.asarray(xs, dtype=np.float64))
                 self._check_finite(xs_h, 0, "warmup state")
@@ -3133,7 +3190,7 @@ class JaxGibbsDriver:
         # serializes with the sweep and costs ~40% of wall time).
         # Checkpoint consistency: the state yielded with chunk i's rows is
         # chunk i's own carry (x_end, b_end) — never the in-flight chunk's.
-        b_dev = jnp.asarray(self.b)
+        b_dev = self._place_carry(jnp.asarray(self.b))
         obs_on = self.obs is not None
         pending = None    # (row, m, xs, bs, x_end, b_end, it_end, health, sk)
 
@@ -3210,9 +3267,10 @@ class JaxGibbsDriver:
             with otrace.span("chunk.host_prep", it0=ii):
                 dput = self._jax.device_put
                 args = (x, b_dev, self.key, dput(np.int32(ii)),
-                        self._aux(chain, ii), dput(np.int32(n)))
+                        self._place_carry(self._aux(chain, ii)),
+                        dput(np.int32(n)))
                 if obs_on:
-                    args = args + (self._obs_state,)
+                    args = args + (self._place_carry(self._obs_state),)
 
             def _go(fn=fn, args=args, it0=ii):
                 # the fault seam and the (thread-local!) transfer guard

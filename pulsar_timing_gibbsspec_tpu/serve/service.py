@@ -58,15 +58,43 @@ class SamplerService:
     ``slots`` is the tenant-axis width (compiled once per bucket);
     ``chunk`` the sweeps per dispatch; ``save_every`` the checkpoint
     cadence in chunks; ``quantum`` the fair-share slice in chunks.
+
+    ``mesh`` (optional) places the service on a device mesh: on a 2-d
+    ``(chain, pulsar)`` mesh the tenant axis IS the chain axis —
+    ``slots`` must divide over it, the stacked per-tenant carries are
+    committed with ``parallel.sharding.shard_carry`` (rows are
+    mathematically independent under vmap, so tenant traffic never
+    crosses the chain axis), and :meth:`report` records the layout.
+    Placement never touches a tenant's PRNG stream and mesh-placed
+    runs are deterministic (bitwise across incarnations on the same
+    mesh, so checkpoint replay stays exact); against the UNPLACED
+    service the values agree at the f64 reduction-order class — GSPMD
+    regroups within-sweep reductions for the per-shard program — not
+    bitwise (tests/test_serve.py).
     """
 
     def __init__(self, root, table: BucketTable, *, slots=2, chunk=4,
                  save_every=1, quantum=8, service_seed=0, max_retries=2,
-                 backoff_base=0.0, cache: ProgramCache | None = None):
+                 backoff_base=0.0, cache: ProgramCache | None = None,
+                 mesh=None):
         self.root = Path(root)
         self.root.mkdir(parents=True, exist_ok=True)
         self.table = table
         self.slots = int(slots)
+        self.mesh = mesh
+        if mesh is not None:
+            from ..parallel.sharding import chain_submesh_size
+
+            nc = chain_submesh_size(mesh)
+            if nc > 1 and self.slots % nc:
+                raise ValueError(
+                    f"slots={self.slots} does not divide over the "
+                    f"mesh's chain axis ({nc} devices, mesh "
+                    f"{tuple(mesh.devices.shape)}): the tenant axis is "
+                    "the chain axis on a 2-d serving mesh — pass slots "
+                    f"as a multiple of {nc} (e.g. slots="
+                    f"{-(-self.slots // nc) * nc}) or shrink the chain "
+                    "axis with make_mesh((n_chain, n_pulsar))")
         self.chunk = int(chunk)
         self.save_every = max(1, int(save_every))
         self.quantum = max(1, int(quantum))
@@ -290,6 +318,11 @@ class SamplerService:
         self._X = jnp.asarray(np.stack(X), cdtype)
         self._B = jnp.asarray(np.stack(B), cdtype)
         self._K = jnp.stack(K)
+        if self.mesh is not None:
+            from ..parallel.sharding import shard_carry
+
+            self._X, self._B, self._K = shard_carry(
+                self.mesh, (self._X, self._B, self._K), self.slots)
         self._dirty = False
 
     def _it0(self):
@@ -503,6 +536,8 @@ class SamplerService:
                       "time_to_first_sample_ms":
                           j.time_to_first_sample_ms()}
                 for jid, j in self.jobs.items()}
+        from ..parallel.sharding import mesh_layout
+
         return {
             "jobs": jobs,
             "chunks": int(self.global_chunk),
@@ -510,5 +545,6 @@ class SamplerService:
             "compile_stalls": int(self._compile_stalls),
             "warm_hit_rate": self.cache.warm_hit_rate(),
             "service_retries": int(self._retries),
+            "mesh": mesh_layout(self.mesh),
             "gauges": telemetry.gauges(),
         }

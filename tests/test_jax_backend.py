@@ -145,6 +145,55 @@ def test_lnlike_fullmarg_matches_oracle(pta8):
     assert abs(d_jx - d_np) < 1e-3 * max(1.0, abs(d_np))
 
 
+def test_tnt_d_segmented_parity(synth_hd_pta):
+    """The parity class :func:`jb.tnt_d`'s docstring claims, measured:
+    the segmented exact path is a pure f64 reassociation of the
+    monolithic dot (same exact f32*f32 products, different partial-sum
+    grouping), so (a) bitwise identity whenever nseg == 1, (b)
+    agreement within a few ULP at the Jacobi scale ``sqrt(G_bb G_cc)``
+    when nseg > 1, and (c) bitwise determinism across calls."""
+    cm = compile_pta(synth_hd_pta)
+    x = synth_hd_pta.initial_sample(np.random.default_rng(17))
+    Nv = cm.ndiag(x)
+    ntoa = cm.T.shape[1]
+    eps = np.finfo(np.float64).eps
+
+    # monolithic oracle: one segment spanning every TOA
+    TNT_m, d_m = (np.asarray(a) for a in jb.tnt_d(cm, Nv, seg_len=ntoa))
+    assert TNT_m.dtype == np.dtype(cm.cdtype)
+
+    # (a) any seg_len >= ntoa is the same single-segment program
+    TNT_1, d_1 = (np.asarray(a) for a in
+                  jb.tnt_d(cm, Nv, seg_len=ntoa + 999))
+    np.testing.assert_array_equal(TNT_1, TNT_m)
+    np.testing.assert_array_equal(d_1, d_m)
+
+    # (b) force several segments (72 TOAs / 18 -> nseg = 4) and compare
+    # at the Jacobi scale; elementwise relative error is NOT the claim
+    # (cancellation-heavy near-zero elements move more in their own
+    # terms, as any reassociated f64 sum does)
+    TNT_s, d_s = (np.asarray(a) for a in jb.tnt_d(cm, Nv, seg_len=18))
+    diag = np.sqrt(np.einsum("pbb->pb", TNT_m))
+    scale = np.maximum(diag[:, :, None] * diag[:, None, :],
+                       np.finfo(np.float64).tiny)
+    assert (np.abs(TNT_s - TNT_m) / scale).max() < 50 * eps
+    yNy = np.sum(np.asarray(cm.y, np.float64) ** 2
+                 / np.asarray(Nv, np.float64), axis=1)
+    dscale = np.maximum(diag * np.sqrt(yNy)[:, None],
+                        np.finfo(np.float64).tiny)
+    assert (np.abs(d_s - d_m) / dscale).max() < 50 * eps
+
+    # (c) the segmented program is deterministic, bitwise
+    TNT_s2, d_s2 = (np.asarray(a) for a in jb.tnt_d(cm, Nv, seg_len=18))
+    np.testing.assert_array_equal(TNT_s2, TNT_s)
+    np.testing.assert_array_equal(d_s2, d_s)
+
+    # the default path (settings.gram_seg_len_exact) stays in class
+    TNT_d, d_d = (np.asarray(a) for a in jb.tnt_d(cm, Nv))
+    assert (np.abs(TNT_d - TNT_m) / scale).max() < 50 * eps
+    assert (np.abs(d_d - d_m) / dscale).max() < 50 * eps
+
+
 # ---------------------------------------------------------------------------
 # full-chain statistical equivalence (the BASELINE.json metric)
 # ---------------------------------------------------------------------------

@@ -14,8 +14,9 @@ import pytest
 from pulsar_timing_gibbsspec_tpu.analysis.jaxprcheck.collectives import (
     census_from_hlo, check_gather_budget)
 from pulsar_timing_gibbsspec_tpu.parallel.sharding import (
-    collective_report, make_mesh, pulsar_sharding, replicated_sharding,
-    shard_compiled)
+    chain_sharding, chain_submesh_size, collective_report, make_mesh,
+    mesh_layout, pulsar_sharding, pulsar_submesh_size, replicated_sharding,
+    shard_carry, shard_compiled, validate_chains)
 
 _HLO = """\
 ENTRY main {
@@ -77,6 +78,78 @@ def test_collective_report_gather_budget_raises():
     assert rep["gather_elems"] and max(rep["gather_elems"]) <= 512
     with pytest.raises(RuntimeError, match="budget"):
         collective_report(fn, x, max_gather_elems=1)
+
+
+# ---------------------------------------------------------------------------
+# 2-d (chain, pulsar) mesh
+
+
+def test_make_mesh_2d_axes_and_layout():
+    mesh = make_mesh((2, 4))
+    assert mesh.axis_names == ("chain", "pulsar")
+    assert mesh.devices.shape == (2, 4)
+    assert chain_submesh_size(mesh) == 2
+    assert pulsar_submesh_size(mesh) == 4
+    lay = mesh_layout(mesh)
+    assert lay["devices"] == 8
+    assert lay["axis"] == "pulsar"           # back-compat readers
+    assert lay["axes"] == [["chain", 2], ["pulsar", 4]]
+    # the classic 1-d mesh: no chain axis, size-1 chain submesh
+    m1 = make_mesh(8)
+    assert chain_submesh_size(m1) == 1
+    assert pulsar_submesh_size(m1) == 8
+    assert mesh_layout(m1)["axes"] == [["pulsar", 8]]
+
+
+def test_make_mesh_2d_validation():
+    with pytest.raises(ValueError, match="n_chain_devs"):
+        make_mesh((2, 4, 1))
+    with pytest.raises(ValueError, match="n_chain_devs"):
+        make_mesh((0, 4))
+    with pytest.raises(RuntimeError, match="refusing"):
+        make_mesh((4, 4))                    # 16 > the 8 host devices
+
+
+def test_shard_carry_places_chain_leaves():
+    import jax
+
+    mesh = make_mesh((2, 4))
+    C = 4
+    tree = {"x": np.zeros((C, 7), np.float32),
+            "b": np.zeros((C, 3, 5), np.float32),
+            "scalar": np.float32(1.0),
+            "not_chain": np.zeros((3, C), np.float32)}
+    placed = shard_carry(mesh, jax.device_put(tree), C)
+    assert placed["x"].sharding.is_equivalent_to(chain_sharding(mesh, 2), 2)
+    assert placed["b"].sharding.is_equivalent_to(chain_sharding(mesh, 3), 3)
+    # non-chain-leading arrays replicate
+    assert placed["not_chain"].sharding.is_equivalent_to(
+        replicated_sharding(mesh), 2)
+    # a chain-less mesh is a no-op (GSPMD keeps deciding)
+    same = shard_carry(make_mesh(4), tree, C)
+    assert same is tree
+    assert shard_carry(None, tree, C) is tree
+
+
+def test_validate_chains_actionable_error():
+    mesh = make_mesh((2, 4))
+    validate_chains(mesh, 4)                 # divides: fine
+    validate_chains(make_mesh(8), 3)         # no chain axis: anything goes
+    with pytest.raises(ValueError, match="multiple of 2"):
+        validate_chains(mesh, 3)
+
+
+def test_shard_compiled_2d_pad_suggestion(synth_hd_pta):
+    from pulsar_timing_gibbsspec_tpu.sampler.compiled import compile_pta
+
+    cm = compile_pta(synth_hd_pta)           # P = 3: does not divide 4
+    with pytest.raises(ValueError, match=r"pulsar submesh \(4 of 8"):
+        shard_compiled(cm, make_mesh((2, 4)))
+    with pytest.raises(ValueError, match="pad_pulsars=4"):
+        shard_compiled(cm, make_mesh((2, 4)))
+    # padded compile shards cleanly on the same mesh
+    cm4 = compile_pta(synth_hd_pta, pad_pulsars=4)
+    shard_compiled(cm4, make_mesh((2, 4)))
 
 
 @pytest.mark.slow

@@ -19,8 +19,9 @@ Scenarios (``--scenario``):
   chunk is abandoned as the ``stall`` failure class, and the stall
   retry budget resumes the run bit-identically (jax backend).
 - ``reshard``: a run checkpointed under an 8-device mesh resumes under
-  ``--devices`` (default 2) via ``integrity.reshard_restore`` and the
-  extended chain is bitwise-identical to the uninterrupted 8-device
+  ``--devices`` (default 2; a 2-d shape like ``2x4`` runs the 4-chain
+  (chain, pulsar)-mesh variant) via ``integrity.reshard_restore`` and
+  the extended chain is bitwise-identical to the uninterrupted
   baseline — the elasticity contract (jax backend, forces 8 virtual
   host devices).
 - ``tenant_evict``: the serving drill — three heterogeneous jobs
@@ -99,6 +100,14 @@ def _fresh(base: Path) -> Path:
     if base.exists():
         shutil.rmtree(base)
     return base
+
+
+def _parse_devices(s):
+    """``--devices`` value: an int, or ``CxP`` -> a 2-tuple of ints."""
+    if "x" in s.lower():
+        c, p = s.lower().split("x")
+        return (int(c), int(p))
+    return int(s)
 
 
 def scenario_fault(args, base):
@@ -225,24 +234,37 @@ def scenario_stall(args, base):
 
 
 def scenario_reshard(args, base):
-    """8-device checkpoint resumed on --devices, bitwise vs baseline."""
+    """8-device checkpoint resumed on --devices, bitwise vs baseline.
+
+    A 2-d ``--devices CxP`` (e.g. ``2x4``) flips the drill to the
+    4-chain variant: the baseline and the partial run execute on a
+    (2, 4) chains x pulsars mesh (padded width 4) and the checkpoint
+    resumes on the requested axis shape — any ``C`` dividing the 4
+    chains and ``P`` dividing the padded width of 4."""
     from pulsar_timing_gibbsspec_tpu.parallel.sharding import make_mesh
     from pulsar_timing_gibbsspec_tpu.runtime import integrity, telemetry
     from pulsar_timing_gibbsspec_tpu.sampler.gibbs import PTABlockGibbs
 
     pta = build_pta()
     x0 = pta.initial_sample(np.random.default_rng(0))
+    two_d = isinstance(args.devices, tuple)
     kw = dict(backend="jax", seed=7, progress=False, warmup_sweeps=2,
-              chunk_size=4, pad_pulsars=8)
+              chunk_size=4)
+    if two_d:
+        kw.update(nchains=4, pad_pulsars=4)
+        src_shape = (2, 4)
+    else:
+        kw.update(pad_pulsars=8)
+        src_shape = 8
     part = max(args.save_every, (args.niter // 2) // args.save_every
                * args.save_every)
 
     telemetry.reset()
-    ref = PTABlockGibbs(pta, mesh=make_mesh(8), **kw).sample(
+    ref = PTABlockGibbs(pta, mesh=make_mesh(src_shape), **kw).sample(
         x0, outdir=base / "baseline", niter=args.niter,
         save_every=args.save_every)
     src = base / "resharded"
-    PTABlockGibbs(pta, mesh=make_mesh(8), **kw).sample(
+    PTABlockGibbs(pta, mesh=make_mesh(src_shape), **kw).sample(
         x0, outdir=src, niter=part, save_every=args.save_every)
 
     g = integrity.reshard_restore(src, pta, devices=args.devices,
@@ -255,8 +277,8 @@ def scenario_reshard(args, base):
     return bitwise, {
         "bitwise_recovery": bitwise,
         "checkpointed_rows": part,
-        "devices_from": 8,
-        "devices_to": args.devices,
+        "devices_from": list(src_shape) if two_d else src_shape,
+        "devices_to": list(args.devices) if two_d else args.devices,
         "layout": info["layout"],
         "shard_map": info["shard_map"],
     }
@@ -355,9 +377,11 @@ def main():
     ap.add_argument("--at-row", type=int, default=None,
                     help="inject at the first seam with row >= AT_ROW "
                     "(default: niter // 2 rounded into the steady loop)")
-    ap.add_argument("--devices", type=int, default=2,
-                    help="resume device count (scenario 'reshard'); "
-                    "must divide the padded width of 8")
+    ap.add_argument("--devices", type=_parse_devices, default=2,
+                    help="resume device count (scenario 'reshard'): an "
+                    "int for the 1-d pulsar mesh (must divide the padded "
+                    "width of 8), or CHAINSxPULSARS (e.g. 2x4) for the "
+                    "2-d 4-chain drill (C | 4 chains, P | padded width 4)")
     ap.add_argument("--outdir", default="/tmp/chaos_probe")
     args = ap.parse_args()
     dflt = _JAX_DEFAULTS.get(args.scenario, (60, 20))
