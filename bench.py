@@ -156,7 +156,8 @@ def _parse_mesh(s):
 
 
 def bench_jax(pta, x0, niter, adapt_iters, nchains, profile=False,
-              record="f32", record_every=1, mesh_shape=None):
+              record="f32", record_every=1, mesh_shape=None,
+              ensemble=False, pt_ladder=1):
     from pulsar_timing_gibbsspec_tpu.sampler.jax_backend import JaxGibbsDriver
 
     # >= ~8 post-compile chunk marks so the five windows are real
@@ -185,6 +186,7 @@ def bench_jax(pta, x0, niter, adapt_iters, nchains, profile=False,
                          white_adapt_iters=adapt_iters, chunk_size=chunk,
                          nchains=nchains, record_precision=record,
                          record_every=record_every, obs={"lags": 256},
+                         ensemble=ensemble, pt_ladder=pt_ladder,
                          **mesh_kw)
     C = drv.C
     cshape, bshape = drv.chain_shapes(niter)
@@ -310,7 +312,8 @@ def _mesh_axes(mesh_shape):
 
 
 def bench_config(orf, n_psr, niter, np_iters, adapt, nchains, profile,
-                 record="f32", record_every=1, mesh_shape=None):
+                 record="f32", record_every=1, mesh_shape=None,
+                 ensemble=False, pt_ladder=1):
     from pulsar_timing_gibbsspec_tpu import profiling
     from pulsar_timing_gibbsspec_tpu.sampler.blocks import BlockIndex
     from pulsar_timing_gibbsspec_tpu.sampler.numpy_pta import NumpyPTAGibbs
@@ -325,7 +328,8 @@ def bench_config(orf, n_psr, niter, np_iters, adapt, nchains, profile,
         _retry_transport(
         lambda: bench_jax(pta, x0, niter, adapt, nchains, profile=profile,
                           record=record, record_every=record_every,
-                          mesh_shape=mesh_shape))
+                          mesh_shape=mesh_shape, ensemble=ensemble,
+                          pt_ladder=pt_ladder))
     g = NumpyPTAGibbs(pta, seed=2, white_adapt_iters=adapt)
     np_rate, np_windows, np_raw, np_chain = bench_numpy(
         g, np.asarray(x0, np.float64), np_iters,
@@ -404,37 +408,68 @@ def bench_config(orf, n_psr, niter, np_iters, adapt, nchains, profile,
     # per sweep than the sequential device sweep, docs/HD_MIXING.md —
     # a throughput-only ratio overstates the win by that factor).
     burn = min(len(chain) // 4, 200)
-    act_med = _rho_act(chain, idx.rho, burn)
+    # with a tempering ladder only every T-th chain samples at beta=1;
+    # mixing and the ESS rate are measured on those chains alone
+    T = max(1, int(pt_ladder))
+    cold = chain if T == 1 else np.asarray(chain)[:, ::T]
+    C_eff = C // T
+    act_rows = _rho_act(cold, idx.rho, burn)
+    # ACT is reported in SWEEP units everywhere (row-ACT x record_every)
+    # so the headline and thinned legs are directly comparable; the ESS
+    # rate C x sweeps/s / ACT_sweeps == C x rows/s / ACT_rows is the
+    # same thinning-invariant number in equivalent form
+    act_med = act_rows * record_every
     out["rho_act_median"] = round(act_med, 2)
-    row_rate = jax_rate / record_every
-    out["ess_per_sec"] = round(C * row_rate / max(act_med, 1.0), 1)
+    out["ess_per_sec"] = round(C_eff * jax_rate / max(act_med, 1.0), 1)
     oracle_act = _rho_act(np_chain, idx.rho, min(len(np_chain) // 4, 200))
     out["oracle_rho_act"] = round(oracle_act, 2)
     oracle_ess = np_rate / max(oracle_act, 1.0)
     out["oracle_ess_per_sec"] = round(oracle_ess, 2)
     out["vs_oracle_ess"] = round(out["ess_per_sec"] / oracle_ess, 2)
+    if ensemble:
+        # the mixing-engine config rides the artifact next to the rates
+        # it is claimed to explain (stretch/ASIS acceptance, ladder)
+        ens_sum = drv.ensemble_summary()
+        if ens_sum is not None:
+            out["ensemble"] = ens_sum
     # device-side mixing from the streaming sketch (obs/): rho-ACT in
     # SWEEP units straight off the bounded summary slab — no chain
     # transfer involved — plus a parity ratio against the host Sokal on
-    # this run's own thinned chains (row-ACT x record_every converts to
-    # sweep units; the obs acceptance band is 10%, i.e. parity in
-    # [0.9, 1.1] modulo the host burn window)
+    # this run's own thinned chains (both sides are sweep units now;
+    # the obs acceptance band is 10%, i.e. parity in [0.9, 1.1] modulo
+    # the host burn window)
     if obs_sum is not None:
         act_dev = float(obs_sum["act_rho_med"])
         out["rho_act_device"] = round(act_dev, 2)
         out["ess_per_sec_device"] = round(
-            C * jax_rate / max(act_dev, 1.0), 1)
-        host_sweeps = act_med * record_every
+            C_eff * jax_rate / max(act_dev, 1.0), 1)
         out["act_parity_device_vs_host"] = (
-            round(act_dev / host_sweeps, 4) if host_sweeps > 0 else None)
+            round(act_dev / act_med, 4) if act_med > 0 else None)
         if obs_sum.get("rhat_max") is not None:
             out["rhat_max_device"] = round(float(obs_sum["rhat_max"]), 4)
         if obs_sum.get("window_saturated"):
             out["obs_window_saturated"] = True
+        # units-parity gate: host and device ESS rates are the SAME
+        # quantity (chains x sweeps/s / ACT_sweeps) measured two ways,
+        # so a relapse of the row-vs-sweep units bug shows up as a
+        # multiple-of-record_every split between them.  Sokal-window
+        # noise on short thinned chains is real, hence the loose band;
+        # skipped when the sketch window saturated (its ACT is a floor,
+        # not a measurement) or the run is too short to estimate
+        if (T == 1 and not obs_sum.get("window_saturated")
+                and len(cold) - burn >= 200):
+            ratio = out["ess_per_sec"] / max(out["ess_per_sec_device"],
+                                             1e-9)
+            assert 1.0 / 3.0 <= ratio <= 3.0, (
+                f"ess_per_sec {out['ess_per_sec']} vs "
+                f"ess_per_sec_device {out['ess_per_sec_device']} "
+                f"disagree by {ratio:.2f}x — row/sweep ACT units have "
+                "diverged between the host and device estimators")
     return out
 
 
-def thinned_probe(orf, n_psr, niter, adapt, nchains, record, k=4):
+def thinned_probe(orf, n_psr, niter, adapt, nchains, record, k=4,
+                  ensemble=False):
     """Jax-only measurement of a thinned-record run (no oracle rerun):
     steady sweep rate + this run's own mixing-adjusted ess_per_sec."""
     from pulsar_timing_gibbsspec_tpu.sampler.blocks import BlockIndex
@@ -446,15 +481,21 @@ def thinned_probe(orf, n_psr, niter, adapt, nchains, record, k=4):
         x0[idx.orf] = 0.0
     rate, windows, C, drv, _, raw, chain, _, obs_sum = bench_jax(
         pta, x0, niter, adapt, nchains, profile=False, record=record,
-        record_every=k)
-    act = _rho_act(chain, idx.rho, min(len(chain) // 4, 200))
+        record_every=k, ensemble=ensemble)
+    act_rows = _rho_act(chain, idx.rho, min(len(chain) // 4, 200))
+    # row-ACT x k converts to SWEEP units — the r5 artifact reported the
+    # thinned leg's ACT in raw row units (10.33 rows next to the
+    # headline's 45 sweeps), which read as a 4x mixing win that was
+    # pure thinning; ess_per_sec = C x sweeps/s / ACT_sweeps is the
+    # identical number either way, the ACT label is what changed
+    act = act_rows * k
     out = {
         "record_every": k,
         "sweeps_per_sec": round(rate, 2),
         "rate_windows": [round(w, 2) for w in windows],
         "nchains": C,
         "rho_act_median": round(act, 2),
-        "ess_per_sec": round(C * (rate / k) / max(act, 1.0), 1),
+        "ess_per_sec": round(C * rate / max(act, 1.0), 1),
         "raw": raw,
     }
     # the thinned leg is where the device sketch earns its keep: the
@@ -693,6 +734,21 @@ def main(argv=None):
                     "run (default 1 = reference parity: every sweep "
                     "recorded).  The k=4 CRN rate is always measured as "
                     "the thinned_k4 sub-object when this is 1")
+    ap.add_argument("--ensemble", dest="ensemble", action="store_true",
+                    default=True,
+                    help="ensemble mixing engine for the CRN leg: ASIS "
+                    "rho interweaving + interchain stretch moves on the "
+                    "common-spectrum block (sampler/ensemble.py).  ON "
+                    "by default — the headline ess_per_sec is an "
+                    "ensemble-on number; --no-ensemble reverts to the "
+                    "plain per-chain sweep (bitwise r5 behavior)")
+    ap.add_argument("--no-ensemble", dest="ensemble", action="store_false",
+                    help="disable the ensemble mixing engine")
+    ap.add_argument("--pt-ladder", type=int, default=1,
+                    help="parallel-tempering ladder depth for the CRN "
+                    "leg (requires --ensemble; default 1 = no "
+                    "tempering).  nchains must be a multiple; only the "
+                    "beta=1 chains count toward ess_per_sec")
     ap.add_argument("--mesh", type=_parse_mesh, default=None,
                     help="device mesh for the headline run: 'CxP' places "
                     "chains over C devices and pulsars over P (e.g. 2x4), "
@@ -773,7 +829,8 @@ def main(argv=None):
         crn = bench_config("crn", n_psr, niter, np_iters, adapt, nchains,
                            profile, record=args.record,
                            record_every=args.record_every,
-                           mesh_shape=args.mesh)
+                           mesh_shape=args.mesh, ensemble=args.ensemble,
+                           pt_ladder=args.pt_ladder)
         if not args.quick and args.record_every == 1:
             # the record-transfer-bound demonstration (r4 weak #3): the
             # same config with the every-sweep record thinned on device to
@@ -783,7 +840,8 @@ def main(argv=None):
             # (rows/s / ACT-on-rows)
             crn["thinned_k4"] = _retry_transport(
                 lambda: thinned_probe("crn", n_psr, niter, adapt, nchains,
-                                      args.record, k=4))
+                                      args.record, k=4,
+                                      ensemble=args.ensemble))
     if args.orf == "hd":
         # the sequential cross-pulsar conditional sweep is heavier per
         # sweep; fewer iterations and chains keep the wall-clock (and the
@@ -867,7 +925,11 @@ def main(argv=None):
                                 # chains, with the host-Sokal parity ratio
                                 "rho_act_device", "ess_per_sec_device",
                                 "act_parity_device_vs_host",
-                                "rhat_max_device") if k in head},
+                                "rhat_max_device",
+                                # the mixing-engine config (r6): which
+                                # ensemble moves produced the headline
+                                # ACT, their acceptance, and the ladder
+                                "ensemble") if k in head},
     }
     if head.get("thinned_k4") is not None:
         out["thinned_k4"] = head["thinned_k4"]
@@ -879,9 +941,16 @@ def main(argv=None):
     if hd is not None:
         out["hd"] = hd
     print(json.dumps(out))
+    # ess_per_sec is a headline gating quantity (r6: the ensemble
+    # mixing engine's acceptance bar is >= 2x the r5 ~90 ESS/s CRN
+    # baseline), so the human-readable gate line carries it too
+    ess = head.get("ess_per_sec")
     print(f"# jax: {head['sweeps_per_sec']:.2f} sweeps/s x "
           f"{head['nchains']} chains (windows {head['rate_windows']}); "
-          f"numpy oracle: {head['numpy_sweeps_per_sec']:.2f} it/s "
+          + (f"ess_per_sec {ess:.1f} "
+             f"(rho-ACT {head.get('rho_act_median')}); "
+             if ess is not None else "")
+          + f"numpy oracle: {head['numpy_sweeps_per_sec']:.2f} it/s "
           f"(single CPU, f64); target >= 20x", file=sys.stderr)
 
 

@@ -82,21 +82,10 @@ _COLLECTIVE_OPS = ("all-reduce", "all-gather", "reduce-scatter",
                    "collective-broadcast")
 
 
-def collective_groups(hlo: str) -> list:
-    """``[(op, groups)]`` for every collective defining line, with
-    ``groups`` as lists of global device ids.
-
-    Three HLO spellings are decoded: explicit
-    ``replica_groups={{0,1},{2,3}}`` lists, the iota form
-    ``replica_groups=[G,S]<=[dims](T(perm))?`` (arange over ``dims``,
-    optionally transposed, reshaped to G groups of S), and
-    ``collective-permute``'s ``source_target_pairs`` (each pair is a
-    2-device group).  A collective whose groups cannot be decoded —
-    including the bare ``replica_groups={}`` meaning *all devices* —
-    yields one group spanning every mentioned partition id, so an
-    unrecognized spelling fails an isolation check loudly instead of
-    slipping past it.
-    """
+def _collective_lines(hlo: str) -> list:
+    """``[(op, groups, line)]`` for every collective defining line —
+    the decoding core of :func:`collective_groups`, with the raw HLO
+    line kept so callers can size the result operand."""
     out = []
     op_re = "|".join(re.escape(o) for o in _COLLECTIVE_OPS)
     for m in re.finditer(r"\b(%s)(?:-start)?\(" % op_re, hlo):
@@ -127,11 +116,40 @@ def collective_groups(hlo: str) -> list:
                 groups = [[int(x) for x in g.split(",") if x.strip()]
                           for g in re.findall(r"\{([0-9, ]*)\}",
                                               pm.group(1))]
-        out.append((op, groups))
+        out.append((op, groups, line))
     return out
 
 
-def check_axis_isolation(hlo: str, mesh_shape, axis=0) -> list:
+def collective_groups(hlo: str) -> list:
+    """``[(op, groups)]`` for every collective defining line, with
+    ``groups`` as lists of global device ids.
+
+    Three HLO spellings are decoded: explicit
+    ``replica_groups={{0,1},{2,3}}`` lists, the iota form
+    ``replica_groups=[G,S]<=[dims](T(perm))?`` (arange over ``dims``,
+    optionally transposed, reshaped to G groups of S), and
+    ``collective-permute``'s ``source_target_pairs`` (each pair is a
+    2-device group).  A collective whose groups cannot be decoded —
+    including the bare ``replica_groups={}`` meaning *all devices* —
+    yields one group spanning every mentioned partition id, so an
+    unrecognized spelling fails an isolation check loudly instead of
+    slipping past it.
+    """
+    return [(op, groups) for op, groups, _ in _collective_lines(hlo)]
+
+
+def _result_elems(line: str):
+    """Element count of the defining line's (first) result shape —
+    ``%x = f32[6,17]{...} all-gather(...)`` -> 102; None when no shape
+    is found (e.g. tuple-result spellings this parser doesn't size)."""
+    sm = re.search(r"\[([0-9,]*)\]", line)
+    if not sm:
+        return None
+    dims = [int(v) for v in sm.group(1).split(",") if v]
+    return int(np.prod(dims)) if dims else 1
+
+
+def check_axis_isolation(hlo: str, mesh_shape, axis=0, allow=None) -> list:
     """Messages for collectives whose replica groups cross ``axis`` of
     a row-major device mesh of ``mesh_shape`` — the static proof that
     an "embarrassingly parallel" mesh axis really carries zero
@@ -144,21 +162,41 @@ def check_axis_isolation(hlo: str, mesh_shape, axis=0) -> list:
     that axis.  Undecodable group spellings are treated as
     all-device groups (see :func:`collective_groups`) and therefore
     fail here rather than pass silently.
+
+    ``allow`` (the ensemble-stage escape hatch,
+    ``contracts/crn_ensemble.json``) is a list of
+    ``{"op": name, "max_elems": n}`` entries: a crossing collective is
+    tolerated only when its op matches an entry, its result operand
+    sizes to at most ``max_elems`` elements, AND its replica groups
+    were positively decoded — an undecodable spelling or an oversized
+    payload (a b-slab or design matrix crossing chain blocks) still
+    fails.  The allowlist is for small (rho, hyper) payloads only.
     """
     shape = tuple(int(s) for s in mesh_shape)
     n_dev = int(np.prod(shape))
+    allow = allow or []
     msgs = []
-    for op, groups in collective_groups(hlo):
+    for op, groups, line in _collective_lines(hlo):
+        decoded = bool(groups)
         if not groups:
             groups = [list(range(n_dev))]
         for g in groups:
             coords = {int(np.unravel_index(int(d), shape)[axis])
                       for d in g}
             if len(coords) > 1:
+                elems = _result_elems(line)
+                ok = decoded and elems is not None and any(
+                    a.get("op") == op
+                    and elems <= int(a.get("max_elems", 0))
+                    for a in allow)
+                if ok:
+                    break
                 msgs.append(
                     f"{op} replica group {g} spans coordinates "
                     f"{sorted(coords)} of mesh axis {axis} (shape "
                     f"{shape}) — this axis is contracted to carry "
-                    "zero collective traffic")
+                    "zero collective traffic"
+                    + (f" (result {elems} elems, not allowlisted)"
+                       if allow else ""))
                 break
     return msgs

@@ -15,12 +15,25 @@ import re
 
 
 def aliased_outputs(hlo_text: str) -> list:
-    """Output indices aliased to donated inputs, parsed from the
-    ``tf.aliasing_output = N : i32`` argument attributes of the lowered
-    module (StableHLO or HLO text)."""
-    return sorted(
-        int(m.group(1))
-        for m in re.finditer(r"tf\.aliasing_output\s*=\s*(\d+)", hlo_text))
+    """Indices of donation markers XLA accepted, parsed from the lowered
+    module's argument attributes.  Two spellings exist: single-device
+    lowerings alias each donated input to an output statically
+    (``tf.aliasing_output = N : i32`` — N is the output index); SPMD
+    lowerings defer the pairing to buffer assignment and mark the
+    donated INPUT ``jax.buffer_donor = true`` instead (the meshed
+    ensemble-chunk entry, ``contracts/crn_ensemble.json``).  Both count
+    as donation-that-took; a lowering uses one spelling or the other,
+    so the union is unambiguous for :func:`check_aliasing`'s floor."""
+    out = {int(m.group(1))
+           for m in re.finditer(r"tf\.aliasing_output\s*=\s*(\d+)",
+                                hlo_text)}
+    args = [(m.start(), int(m.group(1)))
+            for m in re.finditer(r"%arg(\d+)", hlo_text)]
+    for m in re.finditer(r"jax\.buffer_donor\s*=\s*true", hlo_text):
+        prev = [a for a in args if a[0] < m.start()]
+        if prev:                        # nearest preceding %argN
+            out.add(prev[-1][1])
+    return sorted(out)
 
 
 def audit_donation(fn, example_args, donate_argnums):

@@ -247,10 +247,30 @@ def _serve_mux_entry(spec):
     return mux_body(spec.get("chunk", 2)), (stack, x, b, tkeys, it0), {}
 
 
+def _ensemble_chunk_entry(spec):
+    """The ensemble-mixing steady chunk (``crn_ensemble``): same
+    synthetic CRN model as ``chunk``, driver built with ``ensemble=True``
+    (+ a tempering ladder), optionally staged on a 2-d (chain, pulsar)
+    mesh so the chain-axis collective allowlist is audited against the
+    production placement."""
+    from ...sampler import jax_backend as jb
+
+    psrs = synthetic_pulsars(spec.get("n_psr", 3), spec.get("ntoa", 40),
+                             tm_cols=spec.get("tm_cols", 3),
+                             seed=spec.get("seed", 0))
+    pta = build_model(psrs, spec.get("nmodes", 3))
+    fn, args, drv = jb.ensemble_sweep_chunk_entry(
+        pta, spec.get("nchains", 4), chunk=spec.get("chunk", 2),
+        pad_pulsars=spec.get("pad_pulsars"), seed=spec.get("seed", 0),
+        pt_ladder=spec.get("pt_ladder", 1), mesh=spec.get("mesh"))
+    return fn, args, {"driver": drv}
+
+
 _ENTRIES = {"gram": _gram_entry, "chunk": _chunk_entry,
             "obs_chunk": _obs_chunk_entry,
             "sharded_step": _sharded_step_entry,
             "sharded_2d": _sharded_2d_entry,
+            "ensemble_chunk": _ensemble_chunk_entry,
             "serve_mux": _serve_mux_entry}
 
 

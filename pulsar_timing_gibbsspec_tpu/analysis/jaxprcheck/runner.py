@@ -170,10 +170,16 @@ def _check_collectives(chk, closed, fn, args):
         out.append(msg)
     iso = chk.get("isolate_axis")
     if iso is not None:
-        msgs = check_axis_isolation(hlo, iso["mesh"], iso.get("axis", 0))
+        allow = iso.get("allow")
+        msgs = check_axis_isolation(hlo, iso["mesh"], iso.get("axis", 0),
+                                    allow=allow)
         facts["isolate_axis"] = {"mesh": [int(s) for s in iso["mesh"]],
                                  "axis": int(iso.get("axis", 0)),
                                  "clean": not msgs}
+        if allow is not None:
+            # the allowlist is part of the committed facts so a widened
+            # escape hatch shows up in review, not just in the lowering
+            facts["isolate_axis"]["allow"] = allow
         out.extend(msgs)
     return out, facts
 

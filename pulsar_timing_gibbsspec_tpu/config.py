@@ -95,6 +95,20 @@ class Settings:
     compile_cache: str = os.environ.get("PTGIBBS_CACHE",
                                         os.path.expanduser("~/.cache/ptgibbs_xla"))
 
+    #: ensemble mixing stage (sampler/ensemble.py): interchain
+    #: Goodman-Weare stretch moves on the common-spectrum rho block plus
+    #: an ASIS ancillary grid redraw, appended to each steady sweep.
+    #: Off (the default) traces exactly the pre-ensemble chunk program —
+    #: the stage is Python-gated, not lax.cond-gated, so off means the
+    #: ops never enter the jaxpr (contracts/crn_quick.json pins this).
+    ensemble: bool = os.environ.get("PTGIBBS_ENSEMBLE", "0") != "0"
+
+    #: parallel-tempering ladder depth T over a temperature sub-axis of
+    #: the chain batch (chain c runs at inverse temperature
+    #: betas[c % T]; only the beta=1 chains c % T == 0 are posterior
+    #: samples).  1 disables tempering; requires ``ensemble`` on.
+    pt_ladder: int = int(os.environ.get("PTGIBBS_PT_LADDER", "1"))
+
     def apply(self):
         """Push precision into the JAX config.  Called once at model-compile
         entry (not from dtype accessors — enabling x64 is a process-wide,

@@ -1,0 +1,502 @@
+"""Ensemble mixing stage: interchain stretch moves, ASIS interweaving,
+and parallel tempering on the chain axis.
+
+The driver's 64 vmapped chains are one device array — an *ensemble* the
+blocked Gibbs sweep never exploited.  This module is the compiled
+per-sweep stage that does, attacking the rho <-> b funnel that pins CRN
+rho-ACT at ~45 sweeps against the f64 oracle's ~27 blocking floor
+(ROADMAP item 2, the r5 collapse experiment's diagnosis):
+
+- :func:`stretch_rho_move` — Goodman & Weare (2010) affine-invariant
+  stretch proposals on the common-spectrum ln-rho block, paired across
+  complementary chain half-ensembles.  Given b, rho's conditional is
+  the pure prior term ``prod_pk phi^{-n/2} exp(-tau/phi)`` (the white
+  likelihood doesn't see rho once b is fixed), so the accept ratio is a
+  (P, K) reduction — no residual work.  Proposals slide the whole
+  ensemble along the funnel ridge at the ensemble's own scale.
+- :func:`asis_rho_redraw` — ancillarity-sufficiency interweaving (Yu &
+  Meng 2011) generalizing the shipped ``rho_scale_moves`` random-walk:
+  with the prior diagonal, the exact ancillary coordinates are
+  ``b~ = b / sqrt(phi)``; holding b~ fixed, rho_k's conditional over
+  the log-uniform grid is a per-pulsar two-scalar (A_p, B_p) white
+  likelihood profile, drawn exactly by Gumbel-max (the same grid error
+  class as ``rho_update``).  The sweep body's ``rho_update`` is the
+  sufficient draw; this is the ancillary one — one interweave per
+  sweep.
+- :func:`pt_swap` — parallel tempering over a temperature sub-axis of
+  the chain batch: chain ``c`` runs at inverse temperature
+  ``betas[c % n_temps]`` (a geometric ladder adapted toward ~23% swap
+  acceptance by stochastic approximation with decaying gain), with
+  even/odd deck swaps of the full (x, b, u) state between adjacent
+  rungs.  Only the likelihood is tempered (``pi_beta ~ L^beta * prior``)
+  so every phi-only grid conditional in the sweep body is untouched;
+  beta enters the white/ECORR MH log-likelihoods, the b-draw system
+  (N -> N / beta), the scale move's residual delta, and the swap
+  energy.  Only beta = 1 chains (``c % n_temps == 0``) are posterior
+  samples.
+
+Mesh discipline (contracts/crn_ensemble.json): chain c = w * n_temps + t
+keeps each temperature block contiguous, so on a ``(chain, pulsar)``
+mesh with ``n_temps`` dividing the per-device chain block, tempering
+swaps permute a device-LOCAL axis (zero collectives), and only the
+stretch move's small (C, K) ln-rho payload crosses chain blocks — the
+explicit chain-axis collective allowlist, never b or design matrices.
+
+Everything here is a pure function of the ``(x, b, u)`` carry, the
+small ``ens_state`` pytree, and one folded key — the stage rides the
+chunk scan, snapshots per-sweep for bitwise resume, and is Python-gated
+(off means the ops never enter the jaxpr).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+import numpy as np
+
+from ..config import settings
+
+
+@dataclasses.dataclass(frozen=True)
+class EnsembleSpec:
+    """Static configuration of the ensemble stage (hashable — part of
+    the chunk-function cache key via driver identity)."""
+
+    n_temps: int = 1
+    stretch: bool = True
+    asis: bool = True
+    #: Goodman-Weare stretch scale: z ~ g(z) ~ 1/sqrt(z) on [1/a, a]
+    stretch_a: float = 2.0
+    #: PT swap-acceptance target of the stochastic-approximation ladder
+    #: (the classic ~23% optimal-scaling figure)
+    swap_target: float = 0.23
+    #: SA gain schedule gain_m = sa_gain / (1 + m / sa_t0)^0.6
+    sa_gain: float = 0.5
+    sa_t0: float = 50.0
+    #: initial geometric ladder ratio beta_{t+1} / beta_t
+    beta_ratio: float = 0.55
+
+
+def ensemble_applies(cm) -> bool:
+    """Static predicate: same applicability class as
+    ``jax_backend._rho_scale_applies`` — CRN free-spectrum common blocks
+    with diagonal N (the stretch/ASIS targets are the shared rho block;
+    the cheap likelihood deltas assume diagonal N)."""
+    return (cm.orf_name == "crn" and cm.gw_kind == "free_spectrum"
+            and bool(cm.K) and len(cm.rho_ix_x) > 0 and not cm.has_ke)
+
+
+def validate_ensemble(spec: EnsembleSpec, nchains: int, mesh=None):
+    """Raise unless the chain batch factors into the (walker, temp)
+    layout the stage assumes; actionable by construction."""
+    T = int(spec.n_temps)
+    if T < 1:
+        raise ValueError(f"pt_ladder={T} must be >= 1")
+    if nchains % T:
+        raise ValueError(
+            f"nchains={nchains} is not a multiple of the tempering "
+            f"ladder depth {T} — chain c runs at betas[c % {T}], so the "
+            "ladder must tile the chain batch exactly")
+    W = nchains // T
+    if spec.stretch and (W < 2 or W % 2):
+        raise ValueError(
+            f"stretch moves need an even number >= 2 of walkers per "
+            f"temperature (half-ensemble pairing); got {W} "
+            f"(nchains={nchains}, pt_ladder={T})")
+    if mesh is not None and T > 1:
+        from ..parallel.sharding import chain_submesh_size
+
+        nc = chain_submesh_size(mesh)
+        if nc > 1 and (nchains // nc) % T:
+            raise ValueError(
+                f"per-device chain block {nchains // nc} is not a "
+                f"multiple of pt_ladder={T}: tempering swaps must stay "
+                "within the device-local chain block "
+                "(contracts/crn_ensemble.json)")
+
+
+def init_ens_state(spec: EnsembleSpec, dtype) -> dict:
+    """The small per-run ensemble state pytree: the adaptive ladder
+    log-spacings plus the per-temperature swap/stretch counters the obs
+    summary reports.  Rides the chunk scan carry, the per-writeback
+    staging args, and ``adapt_state`` (``ens_*`` keys)."""
+    import jax.numpy as jnp
+
+    T = int(spec.n_temps)
+    lsp0 = float(np.log(np.log(1.0 / spec.beta_ratio)))
+    return {
+        "lsp": jnp.full((max(T - 1, 0),), lsp0, dtype),
+        "m": jnp.zeros((), dtype),
+        "swap_acc": jnp.zeros((max(T - 1, 0),), dtype),
+        "swap_try": jnp.zeros((max(T - 1, 0),), dtype),
+        "stretch_acc": jnp.zeros((T,), dtype),
+        "stretch_try": jnp.zeros((), dtype),
+    }
+
+
+def betas_from_lsp(lsp):
+    """Inverse-temperature ladder from log-spacings:
+    ``beta_t = exp(-sum_{s<t} exp(lsp_s))`` — beta_0 = 1 always, each
+    spacing positive by construction, so adaptation can never reorder
+    or collapse the ladder."""
+    import jax.numpy as jnp
+
+    return jnp.concatenate([jnp.ones((1,), lsp.dtype),
+                            jnp.exp(-jnp.cumsum(jnp.exp(lsp)))])
+
+
+def chain_betas(spec: EnsembleSpec, es: dict, nchains: int):
+    """Per-chain inverse temperatures under the c = w * T + t layout."""
+    import jax.numpy as jnp
+
+    return jnp.tile(betas_from_lsp(es["lsp"]), nchains // spec.n_temps)
+
+
+# ---------------------------------------------------------------------------
+# stretch move
+
+def stretch_halves(logpdf, coords, key, a=2.0):
+    """One Goodman-Weare stretch sweep over an ensemble: two sequential
+    complementary-half updates (each walker's partner drawn from the
+    *other* half, so the move is a valid Metropolis kernel conditioned
+    on the fixed half).
+
+    ``coords`` is ``(W, G, d)`` — walkers x independent groups (the
+    temperature rungs; pairing never crosses groups) x dimension.
+    ``logpdf(c, lo)`` maps proposal coords ``(m, G, d)`` plus the
+    STATIC walker offset ``lo`` (a Python int: the proposals are for
+    walkers ``lo..lo+m``) to log densities ``(m, G)`` — the static
+    offset lets per-walker parameters outside the moved block be read
+    with static slices, which a 2-d mesh partitions without chain-axis
+    gathers (only the partner COORDS, the small ``(m, G, d)`` payload,
+    cross chain blocks).  Accepts with the affine-invariance Jacobian
+    ``z^(d-1)``.
+
+    Returns ``(coords, n_accept)`` with ``n_accept`` summed per group.
+    """
+    import jax
+    import jax.numpy as jnp
+    import jax.random as jr
+
+    W, G, d = coords.shape
+    h = W // 2
+
+    def half(coords, lo, co, kh):
+        kp, kz, ka = jr.split(kh, 3)
+        # static half slice (lo is a Python int): partitions as a local
+        # slice on a chain-sharded walker axis, no dynamic-start gather
+        cs = jax.lax.slice_in_dim(coords, lo, lo + h, axis=0)
+        # complementary-half pairing: partner indices are a pure
+        # function of the folded stage key (no PRNG reuse with the
+        # z / accept draws — three split streams)
+        j = co + jr.randint(kp, (h, G), 0, W - h)
+        cp = jnp.take_along_axis(coords, j[..., None], axis=0)
+        zu = jr.uniform(kz, (h, G), dtype=coords.dtype)
+        z = ((a - 1.0) * zu + 1.0) ** 2 / a
+        prop = cp + z[..., None] * (cs - cp)
+        logr = ((d - 1.0) * jnp.log(z)
+                + logpdf(prop, lo) - logpdf(cs, lo))
+        acc = jnp.log(jr.uniform(ka, (h, G), dtype=coords.dtype)) < logr
+        coords = jax.lax.dynamic_update_slice_in_dim(
+            coords, jnp.where(acc[..., None], prop, cs),
+            jnp.asarray(lo, jnp.int32), axis=0)
+        return coords, jnp.sum(acc, axis=0).astype(coords.dtype)
+
+    k1, k2 = jr.split(key)
+    coords, a0 = half(coords, 0, h, k1)
+    coords, a1 = half(coords, h, 0, k2)
+    return coords, a0 + a1
+
+
+def _gw_coeff_counts(cm):
+    """Static (P, K) count of live GW coefficients per (pulsar, freq) —
+    the ``n`` of the rho-conditional ``phi^{-n/2} exp(-tau/phi)``."""
+    B = cm.Bmax
+    gsin = np.asarray(cm.gw_sin_ix)
+    gcos = np.asarray(cm.gw_cos_ix)
+    live = np.asarray(cm.psr_mask)[:, None]
+    return (((gsin >= 0) & (gsin < B)).astype(np.float64) * live
+            + ((gcos >= 0) & (gcos < B)).astype(np.float64) * live)
+
+
+def stretch_rho_move(cm, spec: EnsembleSpec, x, b, key):
+    """Interchain stretch move on the common ln-rho block over the full
+    ``(C, nx)`` chain batch.  Target per chain (given that chain's b):
+    ``sum_pk -tau/phi - n/2 log phi`` with ``phi = rho + red`` — exact,
+    cheap, and beta-independent (the rho | b conditional is untempered
+    for every rung, see module docstring), so all temperature groups
+    share one logpdf.  Pairing stays within a temperature group.
+
+    Returns ``(x, n_accept_per_temp)``."""
+    import jax
+    import jax.numpy as jnp
+
+    cdt = cm.cdtype
+    C = x.shape[0]
+    T, K, P = spec.n_temps, cm.K, cm.P
+    Wn = C // T
+    rix = jnp.asarray(cm.rho_ix_x, jnp.int32)
+    ln10x2 = 2.0 * np.log(10.0)
+    lnlo = np.log(cm.rhomin)
+    lnhi = np.log(cm.rhomax)
+    nv = jnp.asarray(_gw_coeff_counts(cm), cdt)                 # (P, K)
+    lvec = (ln10x2 * x[:, rix].astype(cdt)).reshape(Wn, T, K)
+    tau = jax.vmap(cm.gw_tau)(b).astype(cdt).reshape(Wn, T, P, K)
+    redv = jax.vmap(cm.red_phi)(x).astype(cdt).reshape(Wn, T, P, K)
+
+    def logpdf(c, lo):                     # (m, T, K), static offset lo
+        m = c.shape[0]
+        rv = jax.lax.slice_in_dim(redv, lo, lo + m, axis=0)
+        tv = jax.lax.slice_in_dim(tau, lo, lo + m, axis=0)
+        phi = jnp.exp(c)[:, :, None, :] + rv
+        val = -tv / phi - 0.5 * nv * jnp.log(phi)
+        lp = jnp.sum(jnp.where(nv > 0, val, jnp.zeros((), cdt)),
+                     axis=(-2, -1))
+        inb = jnp.all((c > lnlo) & (c < lnhi), axis=-1)
+        return jnp.where(inb, lp, -jnp.inf)
+
+    lnew, nacc = stretch_halves(logpdf, lvec, key, a=spec.stretch_a)
+    x = x.at[:, rix].set(
+        (0.5 / np.log(10.0) * lnew.reshape(C, K)).astype(x.dtype))
+    return x, nacc
+
+
+# ---------------------------------------------------------------------------
+# ASIS ancillary redraw
+
+def asis_rho_redraw(cm, x, b, u, key, beta=None):
+    """Exact ancillary-parameterization redraw of the common rho block
+    for ONE chain (the stage vmaps it): per frequency k, substitute
+    ``b~ = b / sqrt(phi)`` on the shared GW columns — under the
+    diagonal prior this is the exact ASIS ancillary coordinate, its
+    density a rho-independent N(0, I) with unit Jacobian — and draw
+    ``ln rho_k | b~`` over the same log-uniform grid as ``rho_update``.
+    Holding b~ fixed, moving the grid scales the columns by
+    ``s_p(rho') = sqrt((rho' + red_p) / (rho + red_p))``, so the only
+    rho-dependent term is the white likelihood of the shifted residual:
+    ``beta * sum_p [ delta_p A_p - delta_p^2 B_p / 2 ]`` with
+    ``delta_p = s_p - 1``, ``A_p = sum r t / N``, ``B_p = sum t^2 / N``
+    and ``t`` the per-pulsar two-column matvec — the same structure as
+    ``rho_scale_moves`` but profiled over the whole grid and drawn by
+    Gumbel-max instead of random-walked.  b, u, and x[rho] are updated
+    consistently (u by the rank-1 column shift, no full matvec).
+
+    ``beta`` (tempering) scales the likelihood profile only; None
+    traces the exact untempered program.
+    """
+    import jax
+    import jax.numpy as jnp
+    import jax.random as jr
+
+    cdt = cm.cdtype
+    fdt = cm.dtype
+    B, P, K = cm.Bmax, cm.P, cm.K
+    gsin = jnp.asarray(cm.gw_sin_ix, jnp.int32)
+    gcos = jnp.asarray(cm.gw_cos_ix, jnp.int32)
+    live = jnp.asarray(cm.psr_mask, cdt)
+    redv = cm.red_phi(x)                                  # (P, K) aligned
+    N = cm.ndiag_fast(x)
+    toam = jnp.asarray(cm.toa_mask, fdt)
+    invN = toam / N.astype(fdt)
+    y = jnp.asarray(cm.y, cm.dtype)
+    grid = 10.0 ** jnp.linspace(math.log10(cm.rhomin),
+                                math.log10(cm.rhomax),
+                                settings.rho_grid_size, dtype=fdt)
+    pr_ar = jnp.arange(P)
+
+    def step(carry, args):
+        x, b, u = carry
+        k, key = args
+        kg, = jr.split(key, 1)
+        sk = jnp.clip(jnp.take(gsin, k, axis=1), 0, B - 1)    # (P,)
+        ck = jnp.clip(jnp.take(gcos, k, axis=1), 0, B - 1)
+        vs = ((jnp.take(gsin, k, axis=1) >= 0)
+              & (jnp.take(gsin, k, axis=1) < B)).astype(cdt) * live
+        vc = ((jnp.take(gcos, k, axis=1) >= 0)
+              & (jnp.take(gcos, k, axis=1) < B)).astype(cdt) * live
+        bs = b[pr_ar, sk] * vs
+        bc = b[pr_ar, ck] * vc
+        Ts = jnp.take_along_axis(
+            jnp.asarray(cm.T, cm.dtype), sk[:, None, None], axis=2)[:, :, 0]
+        Tc = jnp.take_along_axis(
+            jnp.asarray(cm.T, cm.dtype), ck[:, None, None], axis=2)[:, :, 0]
+        t = (Ts * bs.astype(fdt)[:, None] + Tc * bc.astype(fdt)[:, None])
+        r = y - u
+        A = jnp.sum(r * t * invN, axis=1)                     # (P,)
+        Bq = jnp.sum(t * t * invN, axis=1)                    # (P,)
+        rix = jnp.asarray(cm.rho_ix_x, jnp.int32)[k]
+        lrho = 2.0 * np.log(10.0) * jnp.asarray(x, cdt)[rix]
+        red_k = redv[:, jnp.minimum(k, K - 1)]                # (P,)
+        phi0 = jnp.exp(lrho) + red_k
+        nv = vs + vc
+        s = jnp.sqrt((grid[None, :].astype(cdt) + red_k[:, None])
+                     / phi0[:, None])                         # (P, G)
+        dl = (s - 1.0).astype(fdt)
+        lg = jnp.sum(jnp.where(
+            (nv > 0)[:, None],
+            dl * A[:, None] - 0.5 * dl * dl * Bq[:, None],
+            jnp.zeros((), fdt)), axis=0)                      # (G,)
+        if beta is not None:
+            lg = lg * beta.astype(fdt)
+        gum = jr.gumbel(kg, lg.shape, dtype=fdt)
+        rnew = grid[jnp.argmax(lg + gum)]
+        snew = jnp.sqrt((rnew.astype(cdt) + red_k) / phi0)    # (P,)
+        dnew = (snew - 1.0).astype(fdt)
+        b = b.at[pr_ar, sk].set(jnp.where(vs > 0, b[pr_ar, sk] * snew,
+                                          b[pr_ar, sk]))
+        b = b.at[pr_ar, ck].set(jnp.where(vc > 0, b[pr_ar, ck] * snew,
+                                          b[pr_ar, ck]))
+        u = u + dnew[:, None] * t
+        x = x.at[rix].set((0.5 * jnp.log10(rnew)).astype(x.dtype))
+        return (x, b, u), None
+
+    keys = jr.split(key, K)
+    (x, b, u), _ = jax.lax.scan(step, (x, b, u), (jnp.arange(K), keys))
+    return x, b, u
+
+
+# ---------------------------------------------------------------------------
+# parallel tempering
+
+def _partner_table(T, parity):
+    """Static adjacent-rung pairing: rung r <-> r+1 for r = parity (mod
+    2); unpaired rungs map to themselves."""
+    out = np.arange(T)
+    for r in range(parity, T - 1, 2):
+        out[r], out[r + 1] = r + 1, r
+    return out
+
+
+def pt_swap(cm, spec: EnsembleSpec, x, b, u, es, key, t):
+    """Even/odd deck swaps of the full (x, b, u) chain state between
+    adjacent temperature rungs, plus the stochastic-approximation
+    ladder update.
+
+    Swap energy is the data log-likelihood
+    ``-0.5 sum (r^2 / N + log N)`` per chain (everything the swap's
+    ``beta``-weight multiplies; priors are untempered and cancel), and
+    the accept for pair (r, r+1) is the standard
+    ``(beta_r - beta_{r+1})(E_{r+1} - E_r)`` with ONE shared uniform
+    per pair.  Chain c = w * T + t keeps the rung axis device-local
+    (reshape, not collective) on a 2-D mesh.  The log-spacing SA update
+    ``lsp_r += gain_m * (abar_r - target)`` uses the expected accept
+    probability of the rungs active this sweep; the decaying gain makes
+    it a diminishing-adaptation scheme (vanishing kernel drift — the
+    same class PTMCMCSampler ships)."""
+    import jax.numpy as jnp
+    import jax.random as jr
+
+    import jax
+
+    cdt = cm.cdtype
+    fdt = cm.dtype
+    T = spec.n_temps
+    C = x.shape[0]
+    Wn = C // T
+    betas = betas_from_lsp(es["lsp"])                         # (T,)
+    toam = jnp.asarray(cm.toa_mask, fdt)
+    Nf = jnp.where(toam > 0, jax.vmap(cm.ndiag_fast)(x).astype(fdt), 1.0)
+    r = jnp.asarray(cm.y, fdt)[None] - u
+    ll = (-0.5 * jnp.sum(jnp.where(toam > 0, r * r / Nf + jnp.log(Nf),
+                                   jnp.zeros((), fdt)),
+                         axis=(1, 2))).astype(cdt)            # (C,)
+    lw = ll.reshape(Wn, T)
+    ar = jnp.arange(T)
+    partner = jnp.where((t % 2) == 0,
+                        jnp.asarray(_partner_table(T, 0), jnp.int32),
+                        jnp.asarray(_partner_table(T, 1), jnp.int32))
+    la = (betas - betas[partner])[None, :] * (lw[:, partner] - lw)
+    ku, = jr.split(key, 1)    # draws come from split subkeys (key policy)
+    un = jr.uniform(ku, (Wn, T), dtype=cdt)
+    ush = un[:, jnp.minimum(ar, partner)]     # one uniform per pair
+    acc = (jnp.log(ush) < la) & (partner != ar)[None, :]      # (Wn, T)
+
+    def sw(a):
+        aw = a.reshape((Wn, T) + a.shape[1:])
+        ap = jnp.take(aw, partner, axis=1)
+        m = acc.reshape(acc.shape + (1,) * (aw.ndim - 2))
+        return jnp.where(m, ap, aw).reshape(a.shape)
+
+    x, b, u = sw(x), sw(b), sw(u)
+    # SA ladder update on the rungs whose pair was active this parity
+    active = (partner[:-1] == ar[:-1] + 1)                    # (T-1,)
+    pbar = jnp.mean(jnp.minimum(jnp.exp(la[:, :-1]), 1.0), axis=0)
+    m = es["m"] + 1.0
+    gain = spec.sa_gain / (1.0 + m / spec.sa_t0) ** 0.6
+    lsp = es["lsp"] + gain * jnp.where(active,
+                                       pbar - spec.swap_target, 0.0)
+    # keep spacings in a sane band so a transient can't freeze or
+    # explode the ladder (betas stay ordered by construction)
+    lsp = jnp.clip(lsp, np.log(0.01), np.log(5.0))
+    es = {**es, "lsp": lsp, "m": m,
+          "swap_acc": es["swap_acc"] + jnp.sum(acc[:, :-1], axis=0)
+          .astype(cdt),
+          "swap_try": es["swap_try"] + jnp.where(active, float(Wn), 0.0)
+          .astype(cdt)}
+    return x, b, u, es
+
+
+# ---------------------------------------------------------------------------
+# the per-sweep stage
+
+def ensemble_stage(cm, spec: EnsembleSpec, carry, es, kt, t):
+    """Append the ensemble moves to one steady sweep: ASIS interweave
+    (per chain), interchain stretch, then tempering swaps.  ``kt`` is
+    the sweep-level ``fold_in(base_key, t)`` key; stage streams use
+    tags >= C so they can never collide with the per-chain sweep
+    streams ``fold_in(kt, c)``, c < C."""
+    import jax
+    import jax.numpy as jnp
+    import jax.random as jr
+
+    x, b, u = carry
+    C = x.shape[0]
+    if spec.asis:
+        ka = jr.fold_in(kt, C + 1)
+        keys = jax.vmap(lambda c: jr.fold_in(ka, c))(jnp.arange(C))
+        if spec.n_temps > 1:
+            bet = chain_betas(spec, es, C).astype(cm.cdtype)
+            x, b, u = jax.vmap(
+                lambda xx, bb, uu, kk, be:
+                asis_rho_redraw(cm, xx, bb, uu, kk, beta=be)
+            )(x, b, u, keys, bet)
+        else:
+            x, b, u = jax.vmap(
+                lambda xx, bb, uu, kk: asis_rho_redraw(cm, xx, bb, uu, kk)
+            )(x, b, u, keys)
+    if spec.stretch:
+        x, nacc = stretch_rho_move(cm, spec, x, b,
+                                   jr.fold_in(kt, C + 2))
+        es = {**es,
+              "stretch_acc": es["stretch_acc"] + nacc.astype(
+                  es["stretch_acc"].dtype),
+              "stretch_try": es["stretch_try"] + float(C // spec.n_temps)}
+    if spec.n_temps > 1:
+        x, b, u, es = pt_swap(cm, spec, x, b, u, es,
+                              jr.fold_in(kt, C + 3), t)
+    return (x, b, u), es
+
+
+def ensemble_summary(spec: EnsembleSpec, es) -> dict:
+    """Host-side roll-up of the ensemble counters for obs_summary /
+    bench: per-rung swap rates, per-temperature stretch acceptance,
+    and the current ladder."""
+    lsp = np.asarray(es["lsp"], np.float64)
+    betas = np.concatenate([[1.0], np.exp(-np.cumsum(np.exp(lsp)))])
+    st = float(np.asarray(es["stretch_try"]))
+    sacc = np.asarray(es["swap_acc"], np.float64)
+    stry = np.asarray(es["swap_try"], np.float64)
+    return {
+        "n_temps": int(spec.n_temps),
+        "stretch": bool(spec.stretch),
+        "asis": bool(spec.asis),
+        "stretch_a": float(spec.stretch_a),
+        "betas": [float(v) for v in betas],
+        "swap_rate": [float(a / max(n, 1.0))
+                      for a, n in zip(sacc, stry)],
+        "stretch_accept": [
+            float(a / max(st, 1.0))
+            for a in np.asarray(es["stretch_acc"], np.float64)],
+        "sa_steps": float(np.asarray(es["m"])),
+    }

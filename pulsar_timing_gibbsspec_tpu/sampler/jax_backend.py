@@ -1593,7 +1593,7 @@ def _rho_scale_applies(cm: CompiledPTA) -> bool:
             and bool(cm.K) and len(cm.rho_ix_x) > 0 and not cm.has_ke)
 
 
-def rho_scale_moves(cm: CompiledPTA, x, b, u, key):
+def rho_scale_moves(cm: CompiledPTA, x, b, u, key, beta=None):
     """Interweaving (ancillary) scale moves along the rho <-> b funnel:
     per frequency k, jointly propose ``rho_k -> e^z rho_k`` and
     ``b_{pk} -> e^{z/2} b_{pk}`` on the shared GW columns, Metropolis-
@@ -1663,6 +1663,10 @@ def rho_scale_moves(cm: CompiledPTA, x, b, u, key):
         r = y - u
         dll = (delta * jnp.sum(r * t * invN)
                - 0.5 * delta * delta * jnp.sum(t * t * invN))
+        if beta is not None:
+            # tempered likelihood delta; the prior/Jacobian terms below
+            # are untempered (pi_beta ~ L^beta * prior)
+            dll = dll * beta.astype(dll.dtype)
         # prior delta: tau' = e^z tau against phi' = e^z rho + red
         rix = jnp.asarray(cm.rho_ix_x, jnp.int32)[k]
         lrho = 2.0 * np.log(10.0) * jnp.asarray(x, cdt)[rix]  # ln rho
@@ -1962,17 +1966,24 @@ def b_matvec(cm: CompiledPTA, b):
                       precision="highest")
 
 
-def _logpi_b_per(cm: CompiledPTA, x, b, u):
+def _logpi_b_per(cm: CompiledPTA, x, b, u, beta=None):
     """Per-pulsar log pi(b | x) up to b-independent constants, from the
     cached matvec ``u = T b``: ``-0.5 u^2/N + (y/N) u - 0.5 b^2/phi``.
     f32 elementwise with f64 accumulation: the absolute error is ~1e-5 on
-    an O(100) log-ratio — far below what an accept/reject step can see."""
+    an O(100) log-ratio — far below what an accept/reject step can see.
+
+    ``beta`` (parallel tempering, sampler/ensemble.py) scales the
+    LIKELIHOOD term only — the b-prior stays untempered, matching
+    ``pi_beta ~ L^beta * prior``.  None (the default) traces the exact
+    pre-tempering program."""
     import jax.numpy as jnp
 
     fdt = cm.dtype
     N = cm.ndiag_fast(x)
     t1 = ((-0.5 * u + jnp.asarray(cm.y, cm.dtype)) * (u / N)
           * jnp.asarray(cm.toa_mask, fdt))
+    if beta is not None:
+        t1 = t1 * beta.astype(fdt)
     phi32 = cm.phi(x, dtype=fdt)
     bb = b.astype(fdt)
     t2 = -0.5 * bb * bb / phi32
@@ -1980,7 +1991,7 @@ def _logpi_b_per(cm: CompiledPTA, x, b, u):
             + jnp.sum(t2.astype(cm.cdtype), axis=1))
 
 
-def draw_b_mh(cm: CompiledPTA, x, b, u, key):
+def draw_b_mh(cm: CompiledPTA, x, b, u, key, beta=None):
     """Metropolised b-draw: propose from the f32-factored conditional,
     accept per pulsar with the exact Hastings ratio.
 
@@ -2009,6 +2020,10 @@ def draw_b_mh(cm: CompiledPTA, x, b, u, key):
     # proposal mean/covariance and only lower acceptance, but the 3-pass
     # f32 MXU path is still essentially free next to the f64 work
     N = cm.ndiag_fast(x)
+    if beta is not None:
+        # tempered conditional: L^beta is Gaussian with N -> N / beta,
+        # which scales TNT and d below in one place (prior untempered)
+        N = N / beta.astype(N.dtype)
     TN = cm.T / N[:, :, None]
     TNT = jnp.einsum("pnb,pnc->pbc", TN, cm.T,
                      preferred_element_type=fdt, precision="highest")
@@ -2030,8 +2045,8 @@ def draw_b_mh(cm: CompiledPTA, x, b, u, key):
     bp = bp32.astype(cm.cdtype)
     up = b_matvec(cm, bp)
     # ---- exact log-density ratio + proposal correction --------------------
-    lpi_new = _logpi_b_per(cm, x, bp, up)
-    lpi_old = _logpi_b_per(cm, x, b, u)
+    lpi_new = _logpi_b_per(cm, x, bp, up, beta=beta)
+    lpi_old = _logpi_b_per(cm, x, b, u, beta=beta)
     # logq(v) = -0.5 || L^T ((v - mean)/dj) ||^2 (+ const that cancels);
     # for the fresh proposal that quadratic form is exactly ||z||^2 —
     # which is why w_old needs full-f32 precision: it enters the ratio
@@ -2049,7 +2064,7 @@ def draw_b_mh(cm: CompiledPTA, x, b, u, key):
     return b_new, u_new, acc
 
 
-def draw_b_refresh(cm: CompiledPTA, x, b, u, key):
+def draw_b_refresh(cm: CompiledPTA, x, b, u, key, beta=None):
     """Near-exact Metropolised b-refresh: propose from the segmented-Gram
     conditional factored in f64, accept with the exact Hastings ratio.
 
@@ -2079,6 +2094,9 @@ def draw_b_refresh(cm: CompiledPTA, x, b, u, key):
     cdt = cm.cdtype
     k1, k2 = jr.split(key)
     N = cm.ndiag_fast(x)
+    if beta is not None:
+        # tempered conditional (see draw_b_mh): N -> N / beta
+        N = N / beta.astype(N.dtype)
     TNT, d = tnt_d_seg(cm, N)
     phi = cm.phi(x)
     Sig = TNT + _batched_diag(1.0 / phi)
@@ -2090,8 +2108,8 @@ def draw_b_refresh(cm: CompiledPTA, x, b, u, key):
     z = jr.normal(k1, (cm.P, cm.Bmax), cdt)
     bp = mean + dj * jnp.einsum("...ji,...j->...i", Li, z)
     up = b_matvec(cm, bp)
-    lpi_new = _logpi_b_per(cm, x, bp, up)
-    lpi_old = _logpi_b_per(cm, x, b, u)
+    lpi_new = _logpi_b_per(cm, x, bp, up, beta=beta)
+    lpi_old = _logpi_b_per(cm, x, b, u, beta=beta)
     w_old = jnp.einsum("pji,pj->pi", L, (b - mean) / dj)
     logq_old = -0.5 * jnp.sum(w_old * w_old, axis=1)
     logq_new = -0.5 * jnp.sum(z * z, axis=1)
@@ -2139,7 +2157,8 @@ class JaxGibbsDriver:
                  warmup_white_steps=16, white_steps_max=64, nchains=1,
                  exact_every=EXACT_EVERY, record_precision=None,
                  record_every=1, transfer_guard=False, sentinels=True,
-                 joint_mixed=None, watchdog=None, obs=None):
+                 joint_mixed=None, watchdog=None, obs=None,
+                 ensemble=None, pt_ladder=None):
         settings.apply()
         import jax
         import jax.random as jr
@@ -2361,6 +2380,36 @@ class JaxGibbsDriver:
             self.obs = make_sketch_spec(
                 cm, **(obs if isinstance(obs, dict) else {}))
             self._obs_state = init_state(self.obs, self.C)
+
+        #: ensemble mixing stage (sampler/ensemble.py): interchain
+        #: stretch moves on the common rho block, an ASIS ancillary grid
+        #: redraw, and (pt_ladder > 1) likelihood-tempered chains with
+        #: even/odd deck swaps.  None defers to settings.ensemble /
+        #: settings.pt_ladder (PTGIBBS_ENSEMBLE / PTGIBBS_PT_LADDER).
+        #: Off is Python-gated: the steady chunk traces exactly the
+        #: pre-ensemble program, so default behavior is bitwise HEAD
+        #: (tests/test_ensemble.py::test_ensemble_off_bitwise_identical).
+        from . import ensemble as _ens_mod
+
+        ens_on = settings.ensemble if ensemble is None else bool(ensemble)
+        n_temps = int(settings.pt_ladder if pt_ladder is None
+                      else pt_ladder)
+        self._ens = None
+        self._ens_state = None
+        if ens_on:
+            if not _ens_mod.ensemble_applies(cm):
+                raise ValueError(
+                    "ensemble=True requires a CRN free-spectrum model "
+                    "with a shared rho block and diagonal N (no kernel "
+                    "ECORR); build with common_psd='spectrum'")
+            spec = _ens_mod.EnsembleSpec(n_temps=n_temps)
+            _ens_mod.validate_ensemble(spec, self.C, mesh)
+            self._ens = spec
+            self._ens_state = _ens_mod.init_ens_state(spec, cm.cdtype)
+        elif n_temps > 1:
+            raise ValueError(
+                "pt_ladder > 1 requires ensemble=True (tempered chains "
+                "only exist inside the ensemble stage)")
 
         # b passed through so large correlated-ORF models can take the
         # sequential conditional path (a no-op for the others)
@@ -2680,7 +2729,7 @@ class JaxGibbsDriver:
         nw = self.aclength_white or 0
         ne = self.aclength_ecorr or 0
 
-        def body(carry, key, aux, t):
+        def body(carry, key, aux, t, beta=None):
             x, b, u = carry
             (chol_w, mode_w, asq_w, chol_e, mode_e, asq_e,
              red_U, red_S, hist_a, hist_b, de_sw) = aux
@@ -2690,18 +2739,30 @@ class JaxGibbsDriver:
                         else jnp.where(t < de_sw, hist_a, hist_b))
             out = (x, b)
             k = jr.split(key, 9)
+
+            # per-chain inverse temperature (parallel tempering,
+            # sampler/ensemble.py): ONLY likelihood-touching blocks see
+            # beta — the rho/red/tprocess grid conditionals depend on b
+            # solely through the untempered prior and stay exact at
+            # every rung.  beta=None (the default) leaves every call
+            # identical to the pre-ensemble program.
+            def _tll(ll):
+                if beta is None:
+                    return ll
+                return lambda q: ll(q) * beta
+
             # the cached u = T b makes the white residual free
             r = jnp.asarray(cm.y, cm.dtype) - u
             if len(cm.idx.white) and nw:
                 x, _ = parallel_cov_mh_scan(
-                    cm, x, k[0], white_block_ll(cm, x, r, r * r),
+                    cm, x, k[0], _tll(white_block_ll(cm, x, r, r * r)),
                     cm.white_par_ix,
                     cm.white_nper, chol_w, nw, record=False,
                     mode=mode_w, asqrt=asq_w)
             if len(cm.idx.ecorr) and ne and (cm.ec_cols.shape[1]
                                              or cm.has_ke):
                 x, _ = parallel_cov_mh_scan(
-                    cm, x, k[1], ecorr_block_ll(cm, x, b, r),
+                    cm, x, k[1], _tll(ecorr_block_ll(cm, x, b, r)),
                     cm.ecorr_par_ix,
                     cm.ecorr_nper, chol_e, ne, record=False,
                     mode=mode_e, asqrt=asq_e)
@@ -2737,7 +2798,7 @@ class JaxGibbsDriver:
                 x = rho_update(cm, x, b, k[3])
             if _rho_scale_applies(cm):
                 # interweaving scale moves along the rho <-> b funnel
-                x, b, u = rho_scale_moves(cm, x, b, u, k[8])
+                x, b, u = rho_scale_moves(cm, x, b, u, k[8], beta=beta)
             if self.do_orf_mh:
                 x, _ = mh_scan(cm, x, k[7], lnlike_orf_fn(cm, b),
                                cm.idx.orf, self.red_steps)
@@ -2749,14 +2810,14 @@ class JaxGibbsDriver:
                               factors=factors)
                 u = b_matvec(cm, b)
             elif bdraw == "mh":
-                b, u, _ = draw_b_mh(cm, x, b, u, k[4])
+                b, u, _ = draw_b_mh(cm, x, b, u, k[4], beta=beta)
             elif cm.has_ke:
                 # kernel ECORR: the Metropolised refresh's accept density
                 # assumes diagonal N; only the f64 exact draw runs
                 b = draw_b_fn(cm, x, k[4])
                 u = b_matvec(cm, b)
             else:
-                b, u, _ = draw_b_refresh(cm, x, b, u, k[4])
+                b, u, _ = draw_b_refresh(cm, x, b, u, k[4], beta=beta)
             return (x, b, u), out
 
         return body
@@ -2846,7 +2907,7 @@ class JaxGibbsDriver:
 
         return body
 
-    def _make_chunk(self, body, n, rec_off=0, obs=False):
+    def _make_chunk(self, body, n, rec_off=0, obs=False, ensemble=False):
         """Jitted scan of ``n`` sweeps, the single-chain ``body`` vmapped
         over the chains axis.
 
@@ -2883,8 +2944,21 @@ class JaxGibbsDriver:
         vbody = jax.vmap(body_main, in_axes=(0, 0, 0, None))
         vexact = (None if body_exact is None
                   else jax.vmap(body_exact, in_axes=(0, 0, 0, None)))
+        # ensemble stage (Python-gated: off means these ops never enter
+        # the jaxpr, and the plain chunk program is byte-identical to
+        # the pre-ensemble one — contracts/crn_quick.json pins it)
+        ens = self._ens if ensemble else None
+        temper = ens is not None and ens.n_temps > 1
+        if temper:
+            # tempered bodies take a per-chain beta as a 5th argument;
+            # beta is derived each sweep from the CARRIED ladder state,
+            # so resume from any chunk grid replays identical sweeps
+            vbody_t = jax.vmap(body_main, in_axes=(0, 0, 0, None, 0))
+            vexact_t = (None if body_exact is None
+                        else jax.vmap(body_exact,
+                                      in_axes=(0, 0, 0, None, 0)))
 
-        def _core(x, b, base_key, it0, aux, n_keep):
+        def _core(x, b, base_key, it0, aux, n_keep, ens_state=None):
             u = jax.vmap(lambda b1: b_matvec(cm, b1))(b)
 
             def step(carry, t):
@@ -2902,8 +2976,42 @@ class JaxGibbsDriver:
                     lambda c: vbody(c, keys, aux, t),
                     carry)
 
-            (x, b, u), (xs, bs) = jax.lax.scan(step, (x, b, u),
-                                               it0 + jnp.arange(n))
+            def ens_step(carry, t):
+                from . import ensemble as ens_mod
+
+                xbu, es = carry
+                kt = jr.fold_in(base_key, t)
+                keys = jax.vmap(lambda c: jr.fold_in(kt, c))(chains)
+                if temper:
+                    bchain = ens_mod.chain_betas(ens, es, self.C).astype(
+                        cm.cdtype)
+                    run_m = lambda c: vbody_t(c, keys, aux, t, bchain)
+                    run_e = (None if vexact_t is None else
+                             (lambda c: vexact_t(c, keys, aux, t, bchain)))
+                else:
+                    run_m = lambda c: vbody(c, keys, aux, t)
+                    run_e = (None if vexact is None else
+                             (lambda c: vexact(c, keys, aux, t)))
+                if run_e is None:
+                    xbu, out = run_m(xbu)
+                else:
+                    xbu, out = jax.lax.cond(
+                        t % self.exact_every == 0, run_e, run_m, xbu)
+                xbu, es_new = ens_mod.ensemble_stage(cm, ens, xbu, es,
+                                                     kt, t)
+                # ys carry the PRE-sweep ensemble state next to the
+                # pre-sweep (x, b) rows, so the n_keep carry selection
+                # below restores the exact mid-chunk resume point
+                return (xbu, es_new), out + (es,)
+
+            if ens is not None:
+                (((x, b, u), es_end),
+                 (xs, bs, ess)) = jax.lax.scan(
+                    ens_step, ((x, b, u), ens_state),
+                    it0 + jnp.arange(n))
+            else:
+                (x, b, u), (xs, bs) = jax.lax.scan(step, (x, b, u),
+                                                   it0 + jnp.arange(n))
             # full-precision carry at row n_keep (rows record PRE-sweep
             # states; n_keep == n means the final carry).  Branch instead
             # of concatenating a carry row onto the stacks: the b record
@@ -2917,6 +3025,14 @@ class JaxGibbsDriver:
                 n_keep >= n,
                 lambda: (x, b),
                 lambda: (row(xs), row(bs)))
+            if ens is not None:
+                # ladder/counter state at the SAME resume point: the
+                # pre-sweep snapshot of sweep n_keep (== the final carry
+                # when the whole chunk is kept)
+                es_sel = jax.lax.cond(
+                    n_keep >= n,
+                    lambda: es_end,
+                    lambda: jax.tree_util.tree_map(row, ess))
             # on-device record thinning: the transfer ships rows for
             # iterations it0 + rec_off + j*record_every only.  run() picks
             # rec_off so the recorded iterations satisfy it ≡ it_base
@@ -2947,6 +3063,9 @@ class JaxGibbsDriver:
             # device, so divergence/stuck-chain detection costs no extra
             # transfer (runtime.sentinels, docs/RESILIENCE.md)
             health = chunk_health(xs_rec, bs_rec)
+            if ens is not None:
+                return (x_end, b_end, xs_rec.astype(self.rdtype), bs_flat,
+                        health, es_sel, xs)
             return (x_end, b_end, xs_rec.astype(self.rdtype), bs_flat,
                     health, xs)
 
@@ -2954,8 +3073,12 @@ class JaxGibbsDriver:
         # instrumented variant can fold it into the sketch; the plain
         # variant drops it, and jit DCE restores the exact pre-obs
         # program (contracts/crn_quick.json stays byte-identical)
-        def run_chunk(x, b, base_key, it0, aux, n_keep):
-            return _core(x, b, base_key, it0, aux, n_keep)[:5]
+        if ens is not None:
+            def run_chunk(x, b, base_key, it0, aux, n_keep, ens_state):
+                return _core(x, b, base_key, it0, aux, n_keep, ens_state)[:6]
+        else:
+            def run_chunk(x, b, base_key, it0, aux, n_keep):
+                return _core(x, b, base_key, it0, aux, n_keep)[:5]
 
         if not obs:
             return jax.jit(run_chunk)
@@ -2963,15 +3086,22 @@ class JaxGibbsDriver:
         from ..obs import sketch as obs_sketch
         spec = self.obs
 
-        def run_chunk_obs(x, b, base_key, it0, aux, n_keep, sk):
-            out = _core(x, b, base_key, it0, aux, n_keep)
-            # sketch the FULL pre-thinning stack: diagnostics see every
-            # sweep in f64 (ACT in sweep units) no matter how hard the
-            # record transfer is thinned — the point of the device half.
-            # No keys consumed, no carry touched: sampling outputs are
-            # bitwise those of run_chunk.
-            sk = obs_sketch.update(spec, sk, x, out[5])
-            return out[:5] + (sk,)
+        if ens is not None:
+            def run_chunk_obs(x, b, base_key, it0, aux, n_keep, ens_state,
+                              sk):
+                out = _core(x, b, base_key, it0, aux, n_keep, ens_state)
+                sk = obs_sketch.update(spec, sk, x, out[6])
+                return out[:6] + (sk,)
+        else:
+            def run_chunk_obs(x, b, base_key, it0, aux, n_keep, sk):
+                out = _core(x, b, base_key, it0, aux, n_keep)
+                # sketch the FULL pre-thinning stack: diagnostics see
+                # every sweep in f64 (ACT in sweep units) no matter how
+                # hard the record transfer is thinned — the point of the
+                # device half.  No keys consumed, no carry touched:
+                # sampling outputs are bitwise those of run_chunk.
+                sk = obs_sketch.update(spec, sk, x, out[5])
+                return out[:5] + (sk,)
 
         return jax.jit(run_chunk_obs)
 
@@ -2995,7 +3125,8 @@ class JaxGibbsDriver:
                 # the CRN refresh; docs/EXACT_EVERY.md)
                 bodies = (self._sweep_body("mh"), self._sweep_body("exact"))
             self._sweep_fns[(n, rec_off)] = self._make_chunk(
-                bodies, n, rec_off, obs=self.obs is not None)
+                bodies, n, rec_off, obs=self.obs is not None,
+                ensemble=self._ens is not None)
         return self._sweep_fns[(n, rec_off)]
 
     # ---- facade protocol ----------------------------------------------------
@@ -3192,10 +3323,18 @@ class JaxGibbsDriver:
         # chunk i's own carry (x_end, b_end) — never the in-flight chunk's.
         b_dev = self._place_carry(jnp.asarray(self.b))
         obs_on = self.obs is not None
-        pending = None    # (row, m, xs, bs, x_end, b_end, it_end, health, sk)
+        ens_on = self._ens is not None
+        # device-resident ensemble (ladder/counter) carry: advanced at
+        # dispatch like x/b; self._ens_state is only updated at WRITEBACK
+        # so the checkpointed adapt state stays consistent with the rows
+        # it is yielded with (same contract as x_cur below)
+        es_dev = (self._place_carry(self._jax.tree_util.tree_map(
+            jnp.asarray, self._ens_state)) if ens_on else None)
+        pending = None    # (row, m, xs, bs, x_end, b_end, it_end, health,
+                          #  sk, es)
 
         def _writeback(row, m, xs, bs, x_end, b_end, it_end, health,
-                       sk=None):
+                       sk=None, es=None):
             # a trailing short chunk records extra rows (the compiled
             # chunk always runs full length); truncate HOST-side — an
             # eager device xs[:m] would dispatch with a host scalar
@@ -3219,6 +3358,8 @@ class JaxGibbsDriver:
                 self.x_cur = np.asarray(x_end, dtype=np.float64)
                 self.b = b_end
                 self._it_cur = it_end
+                if es is not None:
+                    self._ens_state = es
                 if sk is not None:
                     # cumulative moment snapshot off THIS chunk's sketch
                     # state (already computed — no wait on the in-flight
@@ -3269,6 +3410,8 @@ class JaxGibbsDriver:
                 args = (x, b_dev, self.key, dput(np.int32(ii)),
                         self._place_carry(self._aux(chain, ii)),
                         dput(np.int32(n)))
+                if ens_on:
+                    args = args + (es_dev,)
                 if obs_on:
                     args = args + (self._place_carry(self._obs_state),)
 
@@ -3296,8 +3439,12 @@ class JaxGibbsDriver:
                 else:
                     outs = _go()
             x, b_dev, xs, bs, health = outs[:5]
+            k_out = 5
+            if ens_on:
+                es_dev = outs[5]
+                k_out = 6
             if obs_on:
-                self._obs_state = outs[5]
+                self._obs_state = outs[k_out]
             m = max(0, -(-(n - off) // self.record_every))
             if pending is not None:
                 # start both host copies in flight together before the
@@ -3329,7 +3476,8 @@ class JaxGibbsDriver:
                 if wd is not None:
                     wd.observe(dt)
             pending = (rowc, m, xs, bs, x, b_dev, ii + n, health,
-                       self._obs_state if obs_on else None)
+                       self._obs_state if obs_on else None,
+                       es_dev if ens_on else None)
             ii += n
             rowc += m
         if pending is not None:
@@ -3365,7 +3513,24 @@ class JaxGibbsDriver:
         rhat = moment_split_rhat(self._obs_snaps, state_h)
         out["split_rhat_moment"] = rhat
         out["rhat_max"] = float(np.max(rhat)) if rhat is not None else None
+        if self._ens is not None:
+            # per-rung swap rates / stretch acceptance off the carried
+            # ensemble counters (the sketch slab itself is untouched —
+            # contracts/obs_quick.json stays byte-identical)
+            out["ensemble"] = self.ensemble_summary()
         return out
+
+    def ensemble_summary(self):
+        """Host roll-up of the ensemble stage's carried counters (swap
+        rates per rung, stretch acceptance per temperature, the current
+        ladder); None when the stage is off."""
+        if self._ens is None:
+            return None
+        from .ensemble import ensemble_summary
+
+        return ensemble_summary(
+            self._ens,
+            {k: np.asarray(v) for k, v in self._ens_state.items()})
 
     def _observe_health(self, health, it_end):
         """Fold a chunk's on-device health reductions into the monitor
@@ -3438,6 +3603,13 @@ class JaxGibbsDriver:
             val = getattr(self, key)
             if val is not None:
                 out[key] = np.asarray(val)
+        if self._ens is not None:
+            # ensemble carry (adaptive ladder + counters): part of the
+            # sampled process when tempering is on, so resume must
+            # restore it exactly for the bitwise contract
+            out["ens_pt_ladder"] = np.int64(self._ens.n_temps)
+            for k, v in self._ens_state.items():
+                out["ens_" + k] = np.asarray(v)
         return out
 
     def load_adapt_state(self, state):
@@ -3496,6 +3668,34 @@ class JaxGibbsDriver:
             if key in state:
                 val = np.asarray(state[key])
                 setattr(self, key, int(val) if val.ndim == 0 else val)
+        got_t = state.pop("ens_pt_ladder", None)
+        if self._ens is not None:
+            if got_t is None:
+                raise RuntimeError(
+                    "resume checkpoint was written with the ensemble "
+                    "stage off but this sampler has ensemble=True; they "
+                    "must match (the stage changes the sampled process)")
+            if int(got_t) != self._ens.n_temps:
+                raise RuntimeError(
+                    f"resume checkpoint was written with pt_ladder="
+                    f"{int(got_t)} but this sampler has pt_ladder="
+                    f"{self._ens.n_temps}; they must match")
+            es = {}
+            for k, v in self._ens_state.items():
+                ck = "ens_" + k
+                if ck not in state:
+                    raise RuntimeError(
+                        f"resume checkpoint lacks ensemble state {ck!r}; "
+                        "it was written by an incompatible version")
+                ref = np.asarray(v)
+                es[k] = np.asarray(state[ck]).astype(
+                    ref.dtype).reshape(ref.shape)
+            self._ens_state = es
+        elif got_t is not None:
+            raise RuntimeError(
+                "resume checkpoint was written with the ensemble stage "
+                "on (pt_ladder={}) but this sampler has ensemble=False; "
+                "they must match".format(int(got_t)))
         if self.cov_red is not None:
             self._set_red_eigs()
         if self.do_red_mh and self.cov_red is not None \
@@ -3643,6 +3843,63 @@ def obs_sweep_chunk_entry(pta, nchains, *, chunk=2, pad_pulsars=None,
         drv._aux(),
         jnp.asarray(chunk, jnp.int32),
         drv._obs_state,
+    )
+    return fn, args, drv
+
+
+def ensemble_sweep_chunk_entry(pta, nchains, *, chunk=2, pad_pulsars=None,
+                               seed=0, pt_ladder=1, mesh=None):
+    """The ENSEMBLE steady chunk — :func:`sweep_chunk_entry` with the
+    mixing stage on (``contracts/crn_ensemble.json``): ASIS interweave +
+    interchain stretch (+ tempering swaps at ``pt_ladder > 1``), the
+    small ``ens_state`` pytree threaded as an extra argument/output.
+
+    With ``mesh=(chains, pulsars)`` the entry stages the carries with
+    the production 2-d placement (concrete, device_put — argument
+    shardings are what the partitioner sees), so the contract's
+    ``isolate_axis`` check audits the REAL lowering: tempering swaps
+    stay device-local on the chain axis, and only the stretch move's
+    small ln-rho payload may cross chain blocks (the explicit
+    allowlist — never b or design matrices)."""
+    import jax
+    import jax.numpy as jnp
+    import jax.random as jr
+
+    mesh_obj = None
+    if mesh is not None:
+        from ..parallel.sharding import make_mesh
+
+        mesh_obj = make_mesh(tuple(int(s) for s in mesh))
+    drv = JaxGibbsDriver(pta, nchains=int(nchains), seed=seed,
+                         pad_pulsars=pad_pulsars, chunk_size=int(chunk),
+                         mesh=mesh_obj, ensemble=True,
+                         pt_ladder=int(pt_ladder))
+    cm = drv.cm
+    C = drv.C
+    if len(cm.idx.white):
+        W = int(np.asarray(cm.white_par_ix).shape[1])
+        eye = np.tile(np.eye(W, dtype=np.float64), (C, cm.P, 1, 1))
+        drv.aclength_white = 2
+        drv.chol_white = eye
+        drv.asqrt_white = eye.copy()
+        drv.mode_white = np.zeros((C, cm.P, W), np.float64)
+    if len(cm.idx.ecorr) and (cm.ec_cols.shape[1] or cm.has_ke):
+        E = int(np.asarray(cm.ecorr_par_ix).shape[1])
+        eye = np.tile(np.eye(E, dtype=np.float64), (C, cm.P, 1, 1))
+        drv.aclength_ecorr = 2
+        drv.chol_ecorr = eye
+        drv.asqrt_ecorr = eye.copy()
+        drv.mode_ecorr = np.zeros((C, cm.P, E), np.float64)
+    fn = drv._chunk_fn(int(chunk), 0)
+    x0 = drv._place_carry(jnp.zeros((C, cm.nx), cm.cdtype))
+    b0 = drv._place_carry(jnp.zeros((C, cm.P, cm.Bmax), cm.cdtype))
+    args = (
+        x0, b0,
+        jr.key(seed),
+        jnp.asarray(0, jnp.int32),
+        drv._place_carry(drv._aux()),
+        jnp.asarray(chunk, jnp.int32),
+        drv._place_carry(drv._ens_state),
     )
     return fn, args, drv
 

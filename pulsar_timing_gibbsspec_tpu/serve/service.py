@@ -76,7 +76,19 @@ class SamplerService:
     def __init__(self, root, table: BucketTable, *, slots=2, chunk=4,
                  save_every=1, quantum=8, service_seed=0, max_retries=2,
                  backoff_base=0.0, cache: ProgramCache | None = None,
-                 mesh=None):
+                 mesh=None, ensemble=False, pt_ladder=1):
+        # the multiplexed chunk is vmap(sharded_sweep_step) over the
+        # TENANT axis — rows are unrelated analyses, so any cross-chain
+        # ensemble stage (stretch pairing, tempering swaps) would couple
+        # tenants.  The kwargs exist only to reject the request loudly
+        # at the service boundary instead of silently ignoring it.
+        if ensemble or int(pt_ladder) > 1:
+            raise ValueError(
+                "ensemble moves / parallel tempering are not available "
+                "in the multiplexed service: tenant rows share the "
+                "chain axis and interchain moves would mix unrelated "
+                "analyses.  Run ensemble sampling through the "
+                "single-tenant driver (JaxGibbsDriver(ensemble=True))")
         self.root = Path(root)
         self.root.mkdir(parents=True, exist_ok=True)
         self.table = table

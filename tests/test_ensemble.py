@@ -1,0 +1,296 @@
+"""Ensemble mixing engine (sampler/ensemble.py): correctness gates.
+
+Tier-1 (fast) coverage: the stretch kernel's detailed balance on a
+known 2-d Gaussian, the applicability/validation gates (HD models,
+ladder/walker factorization, the multiplexed service boundary), and
+the ensemble-off bitwise-identity contract — the default driver and an
+explicit ``ensemble=False`` driver must produce byte-identical chains
+(the stage is gated in Python, so the off program is HEAD's program;
+contracts/crn_2d_mesh.json pins the same claim at the lowering level).
+
+Slow-marked coverage (``-m slow``): KS/law parity of the ensemble-on
+posterior against the plain sweep on the single-pulsar and 3-pulsar
+CRN fixtures, tempering-ladder adaptation toward the ~23% swap target,
+and bitwise resume with the ensemble carry on the 1-d and (2, 4)
+meshes via ``runtime.integrity.reshard_restore``.
+"""
+
+import shutil
+
+import numpy as np
+import pytest
+from scipy import stats
+
+from pulsar_timing_gibbsspec_tpu.sampler.blocks import BlockIndex
+from pulsar_timing_gibbsspec_tpu.sampler.ensemble import (
+    EnsembleSpec, ensemble_applies, stretch_halves, validate_ensemble)
+from pulsar_timing_gibbsspec_tpu.sampler.jax_backend import JaxGibbsDriver
+
+NITER = 18
+
+
+def _crn_pta(n_psr=3, ntoa=40, nmodes=3):
+    from pulsar_timing_gibbsspec_tpu.analysis.jaxprcheck.entries import (
+        build_model, synthetic_pulsars)
+
+    return build_model(synthetic_pulsars(n_psr, ntoa, tm_cols=3, seed=0),
+                       nmodes)
+
+
+def _run(pta, x0, niter=NITER, seed=7, nchains=2, chunk_size=6, **kw):
+    drv = JaxGibbsDriver(pta, seed=seed, common_rho=True, nchains=nchains,
+                         chunk_size=chunk_size, warmup_sweeps=4,
+                         white_adapt_iters=4, **kw)
+    cshape, bshape = drv.chain_shapes(niter)
+    chain = np.zeros(cshape)
+    bchain = np.zeros(bshape)
+    for _ in drv.run(x0, chain, bchain, 0, niter):
+        pass
+    return chain, drv
+
+
+# ---------------------------------------------------------------------------
+# stretch kernel: detailed balance on a known target
+# ---------------------------------------------------------------------------
+
+def test_stretch_detailed_balance_gaussian():
+    """The Goodman-Weare stretch sweep must leave a 2-d standard
+    Gaussian invariant: correct affine-invariance Jacobian z^(d-1),
+    complementary-half pairing, and no PRNG reuse between the partner /
+    z / accept draws.  The bug class this guards (a bounds or Jacobian
+    error) collapses acceptance to ~0 or skews the variance far outside
+    these bands."""
+    import jax
+    import jax.numpy as jnp
+    import jax.random as jr
+
+    def logpdf(c, lo):
+        return -0.5 * jnp.sum(c * c, axis=-1)
+
+    W, G, d = 8, 2, 2
+    key = jr.key(3)
+    coords = jr.normal(jr.fold_in(key, 999), (W, G, d))
+
+    @jax.jit
+    def sweep(coords, k):
+        return stretch_halves(logpdf, coords, k, a=2.0)
+
+    nsweep, burn = 600, 100
+    samples, acc = [], 0.0
+    for t in range(nsweep):
+        coords, na = sweep(coords, jr.fold_in(key, t))
+        acc += float(jnp.sum(na))
+        if t >= burn:
+            samples.append(np.asarray(coords))
+    rate = acc / (nsweep * W * G)
+    s = np.concatenate(samples, 0).reshape(-1, d)
+    assert 0.45 < rate < 0.90, rate
+    assert np.all(np.abs(s.mean(0)) < 0.25), s.mean(0)
+    assert np.all((s.var(0) > 0.70) & (s.var(0) < 1.30)), s.var(0)
+    assert abs(np.cov(s.T)[0, 1]) < 0.30
+
+
+# ---------------------------------------------------------------------------
+# applicability / validation / service gates
+# ---------------------------------------------------------------------------
+
+def test_ensemble_gates_and_validation(synth_hd_pta):
+    # factorization: ladder must tile the chain batch, walkers per rung
+    # even >= 2
+    validate_ensemble(EnsembleSpec(n_temps=2), 8)
+    with pytest.raises(ValueError, match="not a multiple"):
+        validate_ensemble(EnsembleSpec(n_temps=3), 8)
+    with pytest.raises(ValueError, match="even number"):
+        validate_ensemble(EnsembleSpec(n_temps=2), 6)
+    with pytest.raises(ValueError, match="pt_ladder"):
+        validate_ensemble(EnsembleSpec(n_temps=0), 8)
+
+    # HD (correlated phi) is outside the engine's applicability class;
+    # the driver must refuse rather than silently sample the wrong law
+    with pytest.raises(ValueError, match="ensemble"):
+        JaxGibbsDriver(synth_hd_pta, common_rho=True, nchains=4,
+                       ensemble=True)
+
+    # pt_ladder > 1 is an ensemble-stage feature
+    with pytest.raises(ValueError, match="pt_ladder"):
+        JaxGibbsDriver(_crn_pta(n_psr=1, ntoa=24), common_rho=True,
+                       nchains=4, ensemble=False, pt_ladder=2)
+
+
+def test_service_rejects_ensemble(tmp_path):
+    """The multiplexed service vmaps the sweep over the TENANT axis —
+    interchain moves would couple unrelated analyses, so the service
+    boundary rejects the kwargs loudly."""
+    from pulsar_timing_gibbsspec_tpu.serve import SamplerService
+    from pulsar_timing_gibbsspec_tpu.serve.buckets import (
+        BucketSpec, BucketTable)
+
+    table = BucketTable([BucketSpec(2, 40, 24, 3)])
+    with pytest.raises(ValueError, match="multiplexed"):
+        SamplerService(tmp_path / "srv", table, ensemble=True)
+    with pytest.raises(ValueError, match="multiplexed"):
+        SamplerService(tmp_path / "srv", table, pt_ladder=2)
+
+
+# ---------------------------------------------------------------------------
+# ensemble-off: bitwise-identical to the plain sweep
+# ---------------------------------------------------------------------------
+
+def test_ensemble_off_bitwise_identical(synth_pta):
+    """Python-level gating: a driver built with the default settings and
+    one with ``ensemble=False`` must run the SAME compiled program —
+    byte-identical chains — while ``ensemble=True`` on the same seed
+    must actually change the process (the toggle is live, not DCE'd
+    along with the stage)."""
+    x0 = synth_pta.initial_sample(np.random.default_rng(0))
+    c_default, d_default = _run(synth_pta, x0)
+    c_off, _ = _run(synth_pta, x0, ensemble=False)
+    c_on, d_on = _run(synth_pta, x0, ensemble=True)
+
+    assert np.all(np.isfinite(c_default))
+    assert c_default.tobytes() == c_off.tobytes()
+    assert c_default.tobytes() != c_on.tobytes()
+
+    # off: no ensemble carry in checkpoints, no summary channel
+    assert not [k for k in d_default.adapt_state() if k.startswith("ens_")]
+    assert d_default.ensemble_summary() is None
+
+    # on: counters carried and live
+    es = d_on.ensemble_summary()
+    assert es["stretch"] and es["stretch_accept"][0] > 0
+    st = d_on.adapt_state()
+    assert int(st["ens_pt_ladder"]) == 1 and "ens_lsp" in st
+
+
+# ---------------------------------------------------------------------------
+# slow: statistical parity, ladder adaptation, bitwise resume
+# ---------------------------------------------------------------------------
+
+def _assert_same_law(a, b, cols, zmax=5.0):
+    """ESS-aware two-run equivalence on (niter, C, npar) chain stacks
+    (thresholds as test_jax_backend's _assert_same_law, adapted to
+    multi-chain pooling): z-test on the marginal mean with per-chain-
+    ACT effective sample sizes; for columns whose chains mix
+    (ACT < 10), a KS test on pooled samples thinned along ITERATIONS
+    before pooling — thinning the interleaved pooled series instead
+    hides each chain's autocorrelation and makes the KS
+    anti-conservative."""
+    from pulsar_timing_gibbsspec_tpu.ops.acf import integrated_act
+
+    for k in cols:
+        xa, xb = a[:, :, k], b[:, :, k]
+        acts = [max(max(float(integrated_act(np.ascontiguousarray(
+                    x[:, c]))) for c in range(x.shape[1])), 1.0)
+                for x in (xa, xb)]
+        ess = [x.size / t for x, t in zip((xa, xb), acts)]
+        se = np.sqrt(xa.var() / ess[0] + xb.var() / ess[1])
+        z = abs(xa.mean() - xb.mean()) / max(se, 1e-12)
+        assert z < zmax, (k, z, acts)
+        if max(acts) < 10:
+            t = int(np.ceil(max(acts)))
+            p = stats.ks_2samp(xa[::t].ravel(), xb[::t].ravel()).pvalue
+            assert p > 1e-4, (k, p)
+
+
+@pytest.mark.slow
+def test_ks_parity_and_ladder_single_pulsar(synth_pta):
+    """Ensemble-on (stretch + ASIS + pt_ladder=2) must sample the SAME
+    rho posterior as the plain sweep — only the beta=1 rungs are
+    samples — and the SA ladder must adapt the swap rate toward the
+    ~23% target from its beta_ratio=0.55 start."""
+    x0 = synth_pta.initial_sample(np.random.default_rng(0))
+    niter, burn = 400, 100
+    cp, _ = _run(synth_pta, x0, niter=niter, seed=5, nchains=8)
+    ce, drv = _run(synth_pta, x0, niter=niter, seed=6, nchains=8,
+                   ensemble=True, pt_ladder=2)
+    assert np.all(np.isfinite(ce))
+
+    idx = BlockIndex.build(synth_pta.param_names)
+    cold = ce[:, ::2]                     # beta=1 chains only
+    _assert_same_law(cp[burn:], cold[burn:], idx.rho)
+
+    es = drv.ensemble_summary()
+    assert 0.15 < es["swap_rate"][0] < 0.38, es
+    betas = es["betas"]
+    assert betas[0] == 1.0 and 0.0 < betas[1] < 0.45, betas
+    assert all(x > 0 for x in es["stretch_accept"]), es
+    assert es["sa_steps"] > niter // 2
+
+
+@pytest.mark.slow
+def test_ks_parity_crn(tmp_path):
+    """Same-law check on the multi-pulsar CRN class the engine targets
+    (the bench configuration's structure, scaled down)."""
+    pta = _crn_pta()
+    x0 = pta.initial_sample(np.random.default_rng(0))
+    niter, burn = 300, 80
+    cp, _ = _run(pta, x0, niter=niter, seed=3, nchains=8, chunk_size=10)
+    ce, drv = _run(pta, x0, niter=niter, seed=4, nchains=8, chunk_size=10,
+                   ensemble=True, pt_ladder=2)
+    assert np.all(np.isfinite(ce))
+    idx = BlockIndex.build(pta.param_names)
+    _assert_same_law(cp[burn:], ce[burn:, ::2], idx.rho)
+    es = drv.ensemble_summary()
+    assert all(x > 0 for x in es["stretch_accept"]), es
+
+
+@pytest.mark.slow
+def test_ensemble_resume_bitwise_1d(synth_pta, tmp_path):
+    """Bitwise resume with the ensemble carry (adaptive ladder +
+    counters ride adapt_state as ens_* keys): split/resumed == full."""
+    from pulsar_timing_gibbsspec_tpu.sampler.gibbs import PTABlockGibbs
+
+    x0 = synth_pta.initial_sample(np.random.default_rng(0))
+    kw = dict(backend="jax", seed=9, progress=False, nchains=4,
+              white_adapt_iters=4, chunk_size=4, warmup_sweeps=2,
+              ensemble=True, pt_ladder=2)
+    full = PTABlockGibbs(synth_pta, **kw).sample(
+        x0, outdir=str(tmp_path / "full"), niter=16, save_every=4)
+    PTABlockGibbs(synth_pta, **kw).sample(
+        x0, outdir=str(tmp_path / "split"), niter=8, save_every=4)
+    resumed = PTABlockGibbs(synth_pta, **kw).sample(
+        x0, outdir=str(tmp_path / "split"), niter=16, resume=True,
+        save_every=4)
+    assert np.all(np.isfinite(full))
+    np.testing.assert_array_equal(resumed, full)
+
+    # ladder mismatch on resume is a hard error, not silent drift
+    with pytest.raises(RuntimeError, match="pt_ladder"):
+        PTABlockGibbs(synth_pta, **{**kw, "pt_ladder": 1,
+                                    "nchains": 4}).sample(
+            x0, outdir=str(tmp_path / "split"), niter=16, resume=True)
+
+
+@pytest.mark.slow
+def test_ensemble_resume_bitwise_mesh_2x4(synth_pta, tmp_path):
+    """Bitwise resume of an ensemble run checkpointed under the 2-d
+    (chains x pulsars) mesh, restored through reshard_restore on the
+    same (2, 4) layout — tempering swaps and stretch pairing stay
+    within device-local chain blocks, and the carried ens_state round-
+    trips exactly."""
+    from pulsar_timing_gibbsspec_tpu.parallel import make_mesh
+    from pulsar_timing_gibbsspec_tpu.runtime import integrity
+    from pulsar_timing_gibbsspec_tpu.sampler.gibbs import PTABlockGibbs
+
+    x0 = synth_pta.initial_sample(np.random.default_rng(0))
+    kw = dict(backend="jax", seed=3, progress=False, nchains=8,
+              white_adapt_iters=4, chunk_size=4, warmup_sweeps=2,
+              pad_pulsars=4, ensemble=True, pt_ladder=2)
+    base = PTABlockGibbs(synth_pta, mesh=make_mesh((2, 4)), **kw)
+    full = base.sample(x0, outdir=str(tmp_path / "full"), niter=16,
+                       save_every=4)
+
+    src = tmp_path / "src"
+    PTABlockGibbs(synth_pta, mesh=make_mesh((2, 4)), **kw).sample(
+        x0, outdir=str(src), niter=8, save_every=4)
+    dst = tmp_path / "dst"
+    shutil.copytree(src, dst)
+    # reshard_restore pins backend/pad/mesh from the manifest + devices
+    rkw = {k: v for k, v in kw.items()
+           if k not in ("backend", "pad_pulsars")}
+    g = integrity.reshard_restore(str(dst), synth_pta, devices=(2, 4),
+                                  **rkw)
+    resumed = g.sample(x0, outdir=str(dst), niter=16, resume=True,
+                       save_every=4)
+    assert np.all(np.isfinite(full))
+    np.testing.assert_array_equal(resumed, full)

@@ -1,0 +1,173 @@
+"""Ensemble-mixing probe: plain vs ensemble-on, same synthetic CRN.
+
+Runs the chunked CRN sampler twice on one synthetic dataset — once as
+the plain per-chain Gibbs sweep, once with the ensemble mixing engine
+(ASIS interweaving + interchain stretch moves, and a tempering ladder
+when ``--pt-ladder > 1``; sampler/ensemble.py) — and prints a small
+table of the quantities the engine is supposed to move:
+
+- median Sokal rho-ACT (sweep units) and the mixing-adjusted ESS/s of
+  each leg, plus their ratio (the ISSUE-10 acceptance is >= 2x on the
+  bench config);
+- stretch acceptance per temperature rung and the adjacent-rung swap
+  rates / final betas when tempering is on.
+
+Exit is nonzero when the engine violates its contracts dynamically or
+statically:
+
+- any UNPLANNED retrace in either steady loop (both programs must be
+  the one compiled chunk, ensemble stage included);
+- a non-allowlisted chain-axis collective: the committed fast
+  contracts are re-audited in a subprocess (``tools/jaxprcheck.py
+  --fast`` covers ``crn_ensemble``'s isolate_axis allowlist — small
+  (rho, hyper) payloads only — and ``crn_2d_mesh``'s ensemble-off
+  clean-axis pin);
+- non-finite chains or a zero stretch-acceptance leg (the
+  detailed-balance guard that caught the bounds-shadowing bug).
+
+Usage: python tools/ensemble_probe.py [--niter N] [--nchains C]
+       [--chunk N] [--n-psr P] [--nmodes K] [--pt-ladder T] [--skip-audit]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+if __name__ == "__main__":   # script bootstrap; no import side effects
+    sys.path.insert(0, ".")
+
+
+def _run_leg(pta, args, ensemble, pt_ladder):
+    """One measured leg: (sweeps/s, rho-ACT sweeps, ESS/s, retraces,
+    ensemble summary or None)."""
+    from pulsar_timing_gibbsspec_tpu.ops.acf import integrated_act
+    from pulsar_timing_gibbsspec_tpu.profiling import recompile_counter
+    from pulsar_timing_gibbsspec_tpu.sampler.blocks import BlockIndex
+    from pulsar_timing_gibbsspec_tpu.sampler.jax_backend import (
+        JaxGibbsDriver)
+
+    drv = JaxGibbsDriver(pta, seed=1, common_rho=True,
+                         white_adapt_iters=20, chunk_size=args.chunk,
+                         nchains=args.nchains, warmup_sweeps=20,
+                         ensemble=ensemble, pt_ladder=pt_ladder)
+    cshape, bshape = drv.chain_shapes(args.niter)
+    chain = np.zeros(cshape)
+    bchain = np.zeros(bshape)
+    x0 = pta.initial_sample(np.random.default_rng(0))
+    with recompile_counter() as rc:
+        rc.phase("warmup")
+        it = drv.run(x0, chain, bchain, 0, args.niter)
+        done = next(it)                  # warmup + first compiles
+        rc.phase("steady")
+        t0, r0 = time.time(), done
+        for done in it:
+            pass
+        wall = time.time() - t0
+    retraces = rc.unplanned("steady")
+    rate = (done - r0) / max(wall, 1e-9)
+    idx = BlockIndex.build(pta.param_names)
+    T = max(1, int(pt_ladder)) if ensemble else 1
+    cold = chain[:, ::T]                 # only beta=1 chains are samples
+    burn = len(chain) // 4
+    acts = [integrated_act(np.ascontiguousarray(cold[burn:, c, k]))
+            for k in idx.rho for c in range(cold.shape[1])]
+    act = float(np.median(acts)) if acts else 1.0
+    ess = cold.shape[1] * rate / max(act, 1.0)
+    finite = bool(np.isfinite(chain).all())
+    return {"sweeps_per_sec": rate, "rho_act": act, "ess_per_sec": ess,
+            "retraces": retraces, "finite": finite,
+            "ensemble": drv.ensemble_summary() if ensemble else None}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--niter", type=int, default=240,
+                    help="recorded iterations per leg (short by design)")
+    ap.add_argument("--nchains", type=int, default=8)
+    ap.add_argument("--chunk", type=int, default=10)
+    ap.add_argument("--n-psr", type=int, default=3)
+    ap.add_argument("--nmodes", type=int, default=3)
+    ap.add_argument("--pt-ladder", type=int, default=1,
+                    help="tempering ladder depth of the ensemble leg")
+    ap.add_argument("--skip-audit", action="store_true",
+                    help="skip the static fast-contract re-audit")
+    args = ap.parse_args()
+
+    from pulsar_timing_gibbsspec_tpu.analysis.jaxprcheck.entries import (
+        build_model, synthetic_pulsars)
+
+    pta = build_model(
+        synthetic_pulsars(args.n_psr, 40, tm_cols=3, seed=0), args.nmodes)
+    failures = []
+    plain = _run_leg(pta, args, ensemble=False, pt_ladder=1)
+    ens = _run_leg(pta, args, ensemble=True, pt_ladder=args.pt_ladder)
+
+    for name, leg in (("plain", plain), ("ensemble", ens)):
+        if leg["retraces"]:
+            failures.append(f"{leg['retraces']} unplanned steady "
+                            f"retrace(s) in the {name} leg")
+        if not leg["finite"]:
+            failures.append(f"non-finite chain values in the {name} leg")
+    es = ens["ensemble"] or {}
+    if es.get("stretch") and not any(
+            a > 0 for a in es.get("stretch_accept", [])):
+        failures.append("stretch move accepted nothing — detailed "
+                        "balance or bounds are broken")
+
+    # static chain-axis audit: the committed fast contracts include the
+    # crn_ensemble allowlist (small (rho, hyper) payloads only) and the
+    # ensemble-off clean-axis pin; a subprocess so the auditor's CPU
+    # host-device bootstrap cannot disturb this process's backend
+    audit_rc = None
+    if not args.skip_audit:
+        here = os.path.dirname(os.path.abspath(__file__))
+        res = subprocess.run(
+            [sys.executable, os.path.join(here, "jaxprcheck.py"),
+             "--fast"], capture_output=True, text=True, timeout=1800)
+        audit_rc = res.returncode
+        if audit_rc != 0:
+            failures.append(
+                "fast contract audit failed (non-allowlisted chain-axis "
+                "collective or drift): "
+                + (res.stdout + res.stderr).strip()[-400:])
+
+    rows = [("leg", "sweeps/s", "rho-ACT", "ESS/s"),
+            ("plain", f"{plain['sweeps_per_sec']:.2f}",
+             f"{plain['rho_act']:.2f}", f"{plain['ess_per_sec']:.1f}"),
+            ("ensemble", f"{ens['sweeps_per_sec']:.2f}",
+             f"{ens['rho_act']:.2f}", f"{ens['ess_per_sec']:.1f}")]
+    for r in rows:
+        print(f"{r[0]:>9} {r[1]:>9} {r[2]:>8} {r[3]:>8}", file=sys.stderr)
+    if es:
+        print(f"stretch_accept {es.get('stretch_accept')} "
+              f"swap_rate {es.get('swap_rate')} "
+              f"betas {es.get('betas')}", file=sys.stderr)
+
+    report = {
+        "niter": args.niter, "nchains": args.nchains,
+        "pt_ladder": args.pt_ladder,
+        "plain": {k: (round(v, 3) if isinstance(v, float) else v)
+                  for k, v in plain.items() if k != "ensemble"},
+        "ensemble": {k: (round(v, 3) if isinstance(v, float) else v)
+                     for k, v in ens.items() if k != "ensemble"},
+        "ensemble_config": es,
+        "ess_ratio": round(ens["ess_per_sec"]
+                           / max(plain["ess_per_sec"], 1e-9), 3),
+        "fast_audit_rc": audit_rc,
+        "failures": failures,
+    }
+    print(json.dumps(report, indent=2))
+    if failures:
+        print("FAIL: " + "; ".join(failures), file=sys.stderr)
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()
