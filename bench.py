@@ -371,6 +371,13 @@ def bench_config(orf, n_psr, niter, np_iters, adapt, nchains, profile,
             out["dispatch_breakdown_ms"] = {
                 k: round(v, 3)
                 for k, v in prof["dispatch_breakdown_ms"].items()}
+        # static roofline attribution (profiling.block_cost_model joined
+        # with the measured per-block times): per-block FLOPs/HBM bytes,
+        # arithmetic intensity, MFU and bound class — the artifact form
+        # of the format_report roofline table, so "which block to fuse
+        # next" is answerable from the committed JSON alone
+        if prof.get("roofline"):
+            out["roofline"] = prof["roofline"]
     # resilience counters (runtime.telemetry): retries/rollbacks/refolds
     # accumulated during this process plus the driver's last on-device
     # health reductions — a long bench that silently retried or rolled
@@ -705,6 +712,26 @@ def run_scaling(out_path, counts=(1, 2, 4, 8)):
     return out
 
 
+def _ledger_append(headline, args, kind="bench"):
+    """Append this run's condensed record to the perf ledger (obs.perf)
+    unless --no-ledger.  Best-effort: a ledger-write failure must never
+    turn a finished bench into a nonzero exit."""
+    if getattr(args, "no_ledger", False):
+        return
+    try:
+        from pulsar_timing_gibbsspec_tpu.obs import perf as operf
+
+        path = args.ledger or operf.ledger_path()
+        rec = operf.make_ledger_record(headline, source="bench.py",
+                                       kind=kind)
+        operf.ledger_append(rec, path)
+        print(f"# ledger: appended {rec.get('metric') or kind} to {path}",
+              file=sys.stderr)
+    except Exception as e:                            # noqa: BLE001
+        print(f"# ledger: append failed ({type(e).__name__}: {e})",
+              file=sys.stderr)
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true",
@@ -766,6 +793,14 @@ def main(argv=None):
                     help="artifact path for --scaling")
     ap.add_argument("--scaling-probe", default=None, metavar="AXIS:N",
                     help=argparse.SUPPRESS)  # internal: one scaling point
+    ap.add_argument("--no-ledger", action="store_true",
+                    help="skip the PERF_LEDGER.jsonl append (the ledger "
+                    "is append-only history gated by tools/perfwatch.py; "
+                    "use this for throwaway experiments that should not "
+                    "become a baseline)")
+    ap.add_argument("--ledger", default=None, metavar="PATH",
+                    help="append the run's ledger record to PATH instead "
+                    "of the repo PERF_LEDGER.jsonl")
     args = ap.parse_args(argv)
 
     if args.scaling_probe:
@@ -792,12 +827,14 @@ def main(argv=None):
             "value": serving["aggregate_samples_per_s"],
             "unit": "samples/s",
             "device_kind": jax.devices()[0].device_kind,
+            "backend": jax.default_backend(),
             "serving": serving,
             "resilience": {"counters": telemetry.snapshot(),
                            "gauges": telemetry.gauges(),
                            "serving": serving},
         }
         print(json.dumps(out))
+        _ledger_append(out, args, kind="serve")
         print(f"# serve: {serving['aggregate_samples_per_s']:.2f} "
               f"multiplexed samples/s ({serving['slots']} slots), "
               f"warm start {serving['warm_start_latency_ms']:.0f} ms, "
@@ -907,6 +944,10 @@ def main(argv=None):
         "unit": "samples/s",
         "vs_baseline": head["vs_oracle"],
         "device_kind": jax.devices()[0].device_kind,
+        # the backend name disambiguates ledger groups (perfwatch bands
+        # compare within (metric, device_kind, backend) only): a CPU
+        # smoke run must never gate against a TPU baseline
+        "backend": jax.default_backend(),
         "record_precision": args.record,
         **{k: head[k] for k in ("sweeps_per_sec", "rate_windows", "nchains",
                                 "mesh_axes",
@@ -935,7 +976,8 @@ def main(argv=None):
         out["thinned_k4"] = head["thinned_k4"]
     if crn is not None and "per_block_ms" in crn:
         for k in ("per_block_ms", "per_block_in_sweep", "sum_blocks_ms",
-                  "full_sweep_ms", "dispatch_ms", "dispatch_breakdown_ms"):
+                  "full_sweep_ms", "dispatch_ms", "dispatch_breakdown_ms",
+                  "roofline"):
             if k in crn:
                 out[k] = crn[k]
     if hd is not None:
@@ -952,6 +994,7 @@ def main(argv=None):
              if ess is not None else "")
           + f"numpy oracle: {head['numpy_sweeps_per_sec']:.2f} it/s "
           f"(single CPU, f64); target >= 20x", file=sys.stderr)
+    _ledger_append(out, args)
 
 
 if __name__ == "__main__":

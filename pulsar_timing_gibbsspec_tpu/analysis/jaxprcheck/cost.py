@@ -1,0 +1,224 @@
+"""C6: static per-program FLOP + HBM-byte cost model.
+
+Walks a jaxpr with per-primitive arithmetic rules (``dot_general`` from
+its dimension numbers, ``cholesky`` n^3/3, solves n^2 m, elementwise
+and reductions by element count) and charges HBM traffic as the
+tile-padded bytes of every leaf equation's operands and results —
+the same size model the C1 HBM check calibrated against the measured
+exact-Gram scratch.  ``scan`` bodies multiply by ``length``; ``cond``
+takes the widest branch; ``while`` counts one trip and records a note
+(static analysis cannot bound the trip count).
+
+The outputs feed the roofline attribution layer
+(``profiling.block_cost_model``): FLOPs / time = achieved compute,
+FLOPs / bytes = arithmetic intensity, compared against the device
+ridge point to classify each Gibbs block compute- vs bandwidth-bound.
+Byte counts are an upper bound — a fused program re-reads nothing,
+while this model charges every equation's operands — so intensities
+are conservative (a block the model already calls compute-bound truly
+is).
+
+Everything here is host-side tracing; nothing executes on a device.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+from .walk import aval_bytes, subjaxprs, trace_jaxpr
+
+#: primitives costing ~1 flop per output element
+_ELEMENTWISE = {
+    "add", "sub", "mul", "div", "rem", "max", "min", "neg", "abs",
+    "sign", "floor", "ceil", "round", "and", "or", "xor", "not",
+    "shift_left", "shift_right_logical", "shift_right_arithmetic",
+    "gt", "lt", "ge", "le", "eq", "ne", "select_n", "clamp",
+    "gt_to", "lt_to", "ge_to", "le_to",     # total-order comparisons
+    "add_any", "nextafter", "square",
+    # transcendentals lower to short polynomial kernels; charging one
+    # flop/element keeps the model dot-dominated and predictable
+    "exp", "exp2", "log", "log1p", "expm1", "tanh", "sin", "cos",
+    "tan", "asin", "acos", "atan", "atan2", "sinh", "cosh", "erf",
+    "erfc", "erf_inv", "logistic", "sqrt", "rsqrt", "cbrt", "pow",
+    "integer_pow", "digamma", "lgamma", "is_finite",
+}
+
+#: reductions cost ~1 flop per *input* element
+_REDUCTIONS = {
+    "reduce_sum", "reduce_max", "reduce_min", "reduce_prod",
+    "reduce_and", "reduce_or", "reduce_xor", "argmax", "argmin",
+    "cumsum", "cumprod", "cummax", "cummin", "reduce_precision",
+}
+
+#: counter-based PRNG kernels: ~this many integer ops per output word
+RNG_FLOPS_PER_ELEM = 16
+_RNG = {"threefry2x32", "random_bits", "random_seed", "random_fold_in",
+        "random_split", "random_wrap", "random_unwrap", "random_gamma"}
+
+#: data movement — zero flops, bytes only
+_MOVEMENT = {
+    "reshape", "transpose", "broadcast_in_dim", "convert_element_type",
+    "slice", "dynamic_slice", "dynamic_update_slice", "gather",
+    "scatter", "scatter-add", "concatenate", "pad", "iota", "copy",
+    "squeeze", "rev", "device_put", "stop_gradient", "split",
+    "bitcast_convert_type",
+    "sharding_constraint", "all_gather", "all_to_all", "ppermute",
+    "psum", "pbroadcast",
+}
+
+
+@dataclasses.dataclass
+class CostReport:
+    """Static cost facts for one (sub)program."""
+
+    flops: float = 0.0        # all arithmetic, dot + non-dot
+    dot_flops: float = 0.0    # dot_general multiply-adds only (2mnk)
+    hbm_bytes: float = 0.0    # tile-padded operand+result traffic
+    by_prim: dict = dataclasses.field(default_factory=dict)
+    notes: list = dataclasses.field(default_factory=list)
+
+    def _add(self, prim: str, flops: float, by: float, scale: float,
+             is_dot: bool = False) -> None:
+        self.flops += flops * scale
+        self.hbm_bytes += by * scale
+        if is_dot:
+            self.dot_flops += flops * scale
+        if flops:
+            self.by_prim[prim] = self.by_prim.get(prim, 0.0) + flops * scale
+
+    def _merge(self, sub: "CostReport", scale: float) -> None:
+        self.flops += sub.flops * scale
+        self.dot_flops += sub.dot_flops * scale
+        self.hbm_bytes += sub.hbm_bytes * scale
+        for k, v in sub.by_prim.items():
+            self.by_prim[k] = self.by_prim.get(k, 0.0) + v * scale
+        for n in sub.notes:
+            if n not in self.notes:
+                self.notes.append(n)
+
+    def as_dict(self) -> dict:
+        d = {"flops": self.flops, "dot_flops": self.dot_flops,
+             "hbm_bytes": self.hbm_bytes,
+             "intensity": (self.flops / self.hbm_bytes
+                           if self.hbm_bytes else 0.0)}
+        if self.notes:
+            d["notes"] = list(self.notes)
+        return d
+
+
+def _shape(var):
+    aval = getattr(var, "aval", None)
+    return tuple(getattr(aval, "shape", ()) or ())
+
+
+def _nelems(var) -> int:
+    n = 1
+    for s in _shape(var):
+        n *= int(s)
+    return n
+
+
+def _dot_general_flops(eqn) -> float:
+    """2 * batch * M * N * K from the dimension numbers."""
+    (lc, rc), (lb, rb) = eqn.params["dimension_numbers"]
+    ls, rs = _shape(eqn.invars[0]), _shape(eqn.invars[1])
+    k = math.prod(ls[i] for i in lc) or 1
+    b = math.prod(ls[i] for i in lb) or 1
+    m = math.prod(s for i, s in enumerate(ls) if i not in (*lc, *lb)) or 1
+    n = math.prod(s for i, s in enumerate(rs) if i not in (*rc, *rb)) or 1
+    return 2.0 * b * m * n * k
+
+
+def _linalg_flops(name: str, eqn) -> float:
+    a = _shape(eqn.invars[0])
+    if len(a) < 2:
+        return float(_nelems(eqn.invars[0]))
+    n = int(a[-1])
+    batch = math.prod(a[:-2]) or 1
+    if name == "cholesky":
+        return batch * n ** 3 / 3.0
+    if name == "triangular_solve":
+        # b is (..., n, m) (or transposed): n flops per rhs element
+        bv = eqn.invars[1]
+        return float(_nelems(bv)) * n
+    if name in ("lu", "qr", "eigh", "svd", "getrf"):
+        return batch * 2.0 * n ** 3
+    return float(_nelems(eqn.invars[0]))
+
+
+def _leaf_bytes(eqn) -> float:
+    by = 0.0
+    for v in (*eqn.invars, *eqn.outvars):
+        aval = getattr(v, "aval", None)
+        if aval is not None:
+            by += aval_bytes(aval)
+    return by
+
+
+_LINALG = {"cholesky", "triangular_solve", "lu", "qr", "eigh", "svd",
+           "getrf"}
+
+
+def jaxpr_cost(jaxpr, _scale: float = 1.0) -> CostReport:
+    """Cost a (Closed)Jaxpr.  Control flow: ``scan`` multiplies its body
+    by ``length``; ``cond`` takes the most expensive branch; ``while``
+    counts one body trip and notes the unbounded count."""
+    inner = getattr(jaxpr, "jaxpr", jaxpr)   # ClosedJaxpr -> Jaxpr
+    rep = CostReport()
+    for eqn in inner.eqns:
+        name = eqn.primitive.name
+        if name == "scan":
+            length = float(eqn.params.get("length", 1))
+            sub = jaxpr_cost(eqn.params["jaxpr"])
+            rep._merge(sub, length)
+        elif name == "while":
+            rep._merge(jaxpr_cost(eqn.params["body_jaxpr"]), 1.0)
+            rep._merge(jaxpr_cost(eqn.params["cond_jaxpr"]), 1.0)
+            if "while:trip_count_unknown" not in rep.notes:
+                rep.notes.append("while:trip_count_unknown")
+        elif name == "cond":
+            branches = [jaxpr_cost(b) for b in eqn.params["branches"]]
+            if branches:
+                rep._merge(max(branches, key=lambda r: r.flops), 1.0)
+        else:
+            subs = subjaxprs(eqn)
+            if subs:                      # pjit / custom_* / remat …
+                for sub in subs:
+                    rep._merge(jaxpr_cost(sub), 1.0)
+                continue
+            by = _leaf_bytes(eqn)
+            if name == "dot_general":
+                rep._add(name, _dot_general_flops(eqn), by, 1.0,
+                         is_dot=True)
+            elif name in _LINALG:
+                rep._add(name, _linalg_flops(name, eqn), by, 1.0)
+            elif name in _ELEMENTWISE:
+                out_elems = sum(_nelems(v) for v in eqn.outvars)
+                rep._add(name, float(out_elems), by, 1.0)
+            elif name in _REDUCTIONS:
+                in_elems = sum(_nelems(v) for v in eqn.invars)
+                rep._add(name, float(in_elems), by, 1.0)
+            elif name in _RNG:
+                out_elems = sum(_nelems(v) for v in eqn.outvars)
+                rep._add(name, float(out_elems) * RNG_FLOPS_PER_ELEM,
+                         by, 1.0)
+            elif name in _MOVEMENT:
+                rep._add(name, 0.0, by, 1.0)
+            else:
+                # unknown primitive: bytes only, flagged once
+                rep._add(name, 0.0, by, 1.0)
+                note = f"unmodeled:{name}"
+                if note not in rep.notes:
+                    rep.notes.append(note)
+    if _scale != 1.0:
+        scaled = CostReport()
+        scaled._merge(rep, _scale)
+        return scaled
+    return rep
+
+
+def cost_of(fn, example_args) -> CostReport:
+    """Trace ``fn`` on example args (abstract — nothing runs) and cost
+    the resulting program."""
+    return jaxpr_cost(trace_jaxpr(fn, example_args))

@@ -244,9 +244,40 @@ def _check_outputs(chk, closed, fn, args):
     return out, facts
 
 
+def _check_cost(chk, closed, fn, args):
+    """C6: the static FLOP/HBM-byte cost model.  Pins
+    ``pin_flops``/``pin_dot_flops``/``pin_hbm_bytes`` within
+    ``tolerance_rel`` so the entry's arithmetic cannot silently grow
+    (or the model silently drift); ``max_flops`` caps growth without a
+    pin."""
+    from .cost import jaxpr_cost
+
+    rep = jaxpr_cost(closed)
+    facts = rep.as_dict()
+    out = []
+    for field in ("flops", "dot_flops", "hbm_bytes"):
+        pin = chk.get(f"pin_{field}")
+        if pin is None:
+            continue
+        got = getattr(rep, field)
+        tol = float(chk.get("tolerance_rel", 0.05))
+        if abs(got - pin) > tol * pin:
+            out.append(
+                f"cost-model drift on {field}: modeled {got:.6g}, "
+                f"contract pins {pin:.6g} (±{tol:.0%}) — either the "
+                "entry's arithmetic changed (re-pin deliberately) or a "
+                "cost rule regressed")
+    max_flops = chk.get("max_flops")
+    if max_flops is not None and rep.flops > float(max_flops):
+        out.append(f"entry FLOPs grew: {rep.flops:.6g} exceeds the "
+                   f"contract's {float(max_flops):.6g} cap")
+    return out, facts
+
+
 _CHECKS = {"hbm": _check_hbm, "collectives": _check_collectives,
            "dtypes": _check_dtypes, "keys": _check_keys,
-           "donation": _check_donation, "outputs": _check_outputs}
+           "donation": _check_donation, "outputs": _check_outputs,
+           "cost": _check_cost}
 
 
 def run_contract(contract: dict):

@@ -8,12 +8,14 @@ Two halves (docs/OBSERVABILITY.md):
   ``ops/acf.py``), and per-block move-rate sums, carried through the
   scanned chunk so ESS/ACT/R-hat ship as a tiny summary slab instead of
   raw chains.
-- **Host half** (:mod:`.trace`, :mod:`.metrics`, :mod:`.convergence`):
+- **Host half** (:mod:`.trace`, :mod:`.metrics`, :mod:`.convergence`,
+  :mod:`.perf`):
   nested monotonic trace spans around the dispatch pipeline (Perfetto/
   Chrome ``trace.json`` + ``metrics.jsonl`` events), a dependency-free
   Prometheus text exposition writer over the labeled telemetry
-  registry, and exact rank-normalized split-R-hat for host-side
-  record slabs.
+  registry, exact rank-normalized split-R-hat for host-side record
+  slabs, and the performance observatory (streaming stage gauges,
+  anomaly-triggered profiler capture, the append-only perf ledger).
 
 This ``__init__`` stays import-light: :mod:`.trace` is stdlib-only and
 eagerly available (the driver hot path touches it every chunk); the
@@ -27,6 +29,7 @@ _LAZY = {
     "summary": ".summary",
     "metrics": ".metrics",
     "convergence": ".convergence",
+    "perf": ".perf",
 }
 
 

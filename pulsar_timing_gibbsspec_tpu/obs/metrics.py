@@ -9,6 +9,7 @@ caller; ``tools/obs_probe.py`` writes the same body to disk.
 
 from __future__ import annotations
 
+import math
 import re
 
 _NAME_OK = re.compile(r"[^a-zA-Z0-9_:]")
@@ -21,14 +22,35 @@ def sanitize(name: str) -> str:
     return "_" + name if name[:1].isdigit() else name
 
 
+_UNESC = re.compile(r"\\(.)")
+
+
+def _unescape(v: str) -> str:
+    return _UNESC.sub(lambda m: "\n" if m.group(1) == "n" else m.group(1),
+                      v)
+
+
 def split_key(key: str):
     """``'name{a="b"}'`` -> ``('name', {'a': 'b'})``; plain names pass
-    through with empty labels."""
+    through with empty labels.  Inverse of ``telemetry.labeled``: label
+    values are unescaped here (the renderer re-escapes on the way out)."""
     m = _KEY_RE.match(key)
     if not m:
         return key, {}
-    labels = dict(_LABEL_RE.findall(m.group(2))) if m.group(2) else {}
+    labels = ({k: _unescape(v) for k, v in _LABEL_RE.findall(m.group(2))}
+              if m.group(2) else {})
     return m.group(1), labels
+
+
+def _fmt(v: float) -> str:
+    """Prometheus 0.0.4 sample-value spelling: non-finite floats must be
+    ``NaN``/``+Inf``/``-Inf`` (Python's ``nan``/``inf`` are invalid)."""
+    v = float(v)
+    if math.isnan(v):
+        return "NaN"
+    if math.isinf(v):
+        return "+Inf" if v > 0 else "-Inf"
+    return repr(v)
 
 
 def _escape(v: str) -> str:
@@ -61,7 +83,7 @@ def render(counts=None, gauges=None, prefix: str = "ptgibbs") -> str:
                        "counter", prefix)
     for key, v in sorted((gauges or {}).items()):
         name, labels = split_key(key)
-        _render_family(out, seen, name, labels, float(v), "gauge", prefix)
+        _render_family(out, seen, name, labels, _fmt(v), "gauge", prefix)
     return "\n".join(out) + ("\n" if out else "")
 
 

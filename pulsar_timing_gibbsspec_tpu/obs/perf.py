@@ -1,0 +1,499 @@
+"""The performance observatory: streaming stage telemetry, anomaly
+capture, and the durable perf ledger.
+
+Three cooperating pieces, all riding the PR 7 span seams instead of
+adding new instrumentation to the hot loop:
+
+- :class:`StageAggregator` — a trace *observer* that folds every
+  per-chunk pipeline-stage span (``chunk.host_prep`` /
+  ``chunk.dispatch`` / ``chunk.d2h`` / ``chunk.writeback`` and their
+  ``serve.*`` twins) into bounded ring-buffer time series and exports
+  EMA/percentile gauges through :mod:`runtime.telemetry` labels —
+  ``dispatch_ms{stage="device",stat="p90"[,job=...]}`` — so
+  ``SamplerService.prometheus()`` scrapes the live dispatch breakdown
+  without any one-shot probe.  Observers run outside the traced
+  program: sampling outputs stay bitwise identical (the PR 7 proof in
+  tests/test_obs.py extends over this layer), and with no observer
+  installed the span seams remain the shared nullcontext — zero cost.
+
+- :class:`FlightRecorder` — anomaly-triggered capture.  When the
+  dispatch-EMA watchdog soft-warns (``watchdog.soft`` instant) or a
+  stage gauge breaches its band (``perf.band_breach`` from the
+  aggregator), it opens a bounded ``jax.profiler`` trace window and,
+  after the next few chunks, merges the XLA trace with the obs span
+  timeline into one Perfetto file — the stall arrives with the
+  device-level evidence attached.
+
+- the **perf ledger** — an append-only ``PERF_LEDGER.jsonl`` of bench
+  headline records (rates, ess/s, dispatch percentiles, per-block
+  roofline, device/backend/mesh, contract hashes, git sha) written by
+  ``bench.py`` and checked by ``tools/perfwatch.py --check`` under
+  explicit noise bands (:func:`check_ledger`), so the perf trajectory
+  is machine-gated like the jaxlint/jaxprcheck ratchets.
+
+Schema/glossary: docs/OBSERVABILITY.md; reading the roofline:
+docs/PERFORMANCE.md.
+"""
+
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import math
+import os
+import subprocess
+import time
+from pathlib import Path
+
+import numpy as np
+
+from ..runtime import telemetry
+from . import trace as otrace
+
+_REPO_ROOT = Path(__file__).resolve().parents[2]
+
+# ---------------------------------------------------------------------------
+# streaming stage telemetry
+
+
+class RingSeries:
+    """A bounded numeric time series: O(1) append into a fixed ring,
+    EMA maintained online, percentiles over the retained window."""
+
+    __slots__ = ("_buf", "_n", "_i", "ema", "_alpha", "count")
+
+    def __init__(self, cap: int = 512, ema_alpha: float = 0.3):
+        self._buf = np.empty(int(cap), np.float64)
+        self._n = 0          # filled entries (<= cap)
+        self._i = 0          # next write slot
+        self.ema = None
+        self._alpha = float(ema_alpha)
+        self.count = 0       # total ever appended
+
+    def append(self, v: float) -> None:
+        v = float(v)
+        self._buf[self._i] = v
+        self._i = (self._i + 1) % len(self._buf)
+        self._n = min(self._n + 1, len(self._buf))
+        self.ema = v if self.ema is None else (
+            self._alpha * v + (1.0 - self._alpha) * self.ema)
+        self.count += 1
+
+    def last(self) -> float | None:
+        if not self._n:
+            return None
+        return float(self._buf[(self._i - 1) % len(self._buf)])
+
+    def values(self) -> np.ndarray:
+        return self._buf[: self._n].copy()
+
+    def percentile(self, q) -> float:
+        return float(np.percentile(self._buf[: self._n], q))
+
+    def __len__(self) -> int:
+        return self._n
+
+
+#: span name -> pipeline stage.  ``chunk.dispatch`` is the *enqueue*
+#: (async backends return once the program is in flight), ``chunk.d2h``
+#: the wait for device results — the same reading as
+#: ``profiling.dispatch_breakdown``.  ``chunk.compile_dispatch`` is
+#: deliberately absent: a compile wall is not a steady-state stage.
+SPAN_STAGES = {
+    "chunk.host_prep": "host_prep",
+    "chunk.dispatch": "enqueue",
+    "chunk.d2h": "device",
+    "chunk.writeback": "writeback",
+    "serve.prepare": "host_prep",
+    "serve.dispatch": "enqueue",
+    "serve.d2h": "device",
+    "serve.writeback": "writeback",
+}
+
+#: gauge stats exported per stage
+_STATS = ("last", "ema", "p50", "p90", "p99")
+
+
+class StageAggregator:
+    """Trace observer folding pipeline-stage spans into per-stage
+    :class:`RingSeries` and ``dispatch_ms{stage=...,stat=...}`` gauges.
+
+    ``band_k``, when set, arms the breach detector: a stage sample
+    exceeding ``band_k``x its prior EMA (after ``warm_n`` samples)
+    emits a ``perf.band_breach`` instant, bumps the
+    ``stage_band_breaches`` counter, and pokes ``recorder.trigger()``
+    when a :class:`FlightRecorder` is attached.
+    """
+
+    def __init__(self, cap: int = 512, job: str | None = None,
+                 ema_alpha: float = 0.3, band_k: float | None = None,
+                 warm_n: int = 8, recorder=None):
+        self.job = job
+        self.band_k = band_k
+        self.warm_n = int(warm_n)
+        self.recorder = recorder
+        self._series: dict[str, RingSeries] = {}
+        self._cap = int(cap)
+        self._alpha = float(ema_alpha)
+        self._labels = {"job": job} if job is not None else {}
+
+    # -- observer plumbing
+
+    def install(self) -> "StageAggregator":
+        otrace.add_observer(self._on_event)
+        if self.recorder is not None:
+            self.recorder.install()
+        return self
+
+    def uninstall(self) -> None:
+        otrace.remove_observer(self._on_event)
+        if self.recorder is not None:
+            self.recorder.uninstall()
+
+    def _on_event(self, ev: dict) -> None:
+        if ev.get("ph") != "X":
+            return
+        stage = SPAN_STAGES.get(ev.get("name"))
+        if stage is None:
+            return
+        self.observe(stage, ev["dur"] / 1e3)
+
+    # -- the fold
+
+    def observe(self, stage: str, ms: float) -> None:
+        s = self._series.get(stage)
+        if s is None:
+            s = self._series[stage] = RingSeries(self._cap, self._alpha)
+        prior_ema, prior_n = s.ema, s.count
+        s.append(ms)
+        g = telemetry.gauge
+        g("dispatch_ms", ms, stage=stage, stat="last", **self._labels)
+        g("dispatch_ms", s.ema, stage=stage, stat="ema", **self._labels)
+        for q, stat in ((50, "p50"), (90, "p90"), (99, "p99")):
+            g("dispatch_ms", s.percentile(q), stage=stage, stat=stat,
+              **self._labels)
+        if (self.band_k is not None and prior_ema is not None
+                and prior_n >= self.warm_n and ms > self.band_k * prior_ema):
+            telemetry.incr("stage_band_breaches", stage=stage,
+                           **self._labels)
+            otrace.instant("perf.band_breach", stage=stage,
+                           ms=round(ms, 3), ema=round(prior_ema, 3),
+                           k=self.band_k)
+            if self.recorder is not None:
+                self.recorder.trigger(f"band_breach:{stage}")
+
+    # -- export
+
+    def summary(self) -> dict:
+        """``{stage: {n, last, ema, p50, p90, p99}}`` for reports."""
+        out = {}
+        for stage, s in self._series.items():
+            if not len(s):
+                continue
+            out[stage] = {"n": s.count, "last": s.last(), "ema": s.ema,
+                          "p50": s.percentile(50), "p90": s.percentile(90),
+                          "p99": s.percentile(99)}
+        return out
+
+
+# ---------------------------------------------------------------------------
+# anomaly-triggered capture
+
+
+class FlightRecorder:
+    """Bounded anomaly capture: on a trigger (``watchdog.soft`` instant
+    by default, or an explicit :meth:`trigger` from the aggregator's
+    band detector), start a ``jax.profiler`` trace and stop it after
+    the next ``window_chunks`` dispatch spans (or ``max_s`` seconds),
+    merging the XLA trace with the obs span timeline into one Perfetto
+    file under ``outdir``.  At most ``max_captures`` windows per
+    process — a flapping anomaly cannot fill the disk.
+    """
+
+    #: spans that advance the capture window (one per chunk dispatch)
+    _WINDOW_SPANS = ("chunk.dispatch", "chunk.compile_dispatch",
+                     "serve.dispatch", "serve.compile_dispatch")
+
+    def __init__(self, outdir, window_chunks: int = 4,
+                 max_captures: int = 2, max_s: float = 60.0,
+                 profiler: bool = True,
+                 triggers=("watchdog.soft",)):
+        self.outdir = Path(outdir)
+        self.window_chunks = int(window_chunks)
+        self.max_captures = int(max_captures)
+        self.max_s = float(max_s)
+        self.profiler = profiler
+        self.triggers = tuple(triggers)
+        self.captures: list = []     # merged-file paths, one per capture
+        self._armed = False
+        self._left = 0
+        self._t0 = 0.0
+        self._reason = None
+        self._profiling = False
+        self._window_events: list = []
+
+    def install(self) -> "FlightRecorder":
+        otrace.add_observer(self._on_event)
+        return self
+
+    def uninstall(self) -> None:
+        otrace.remove_observer(self._on_event)
+        if self._armed:
+            self._finish()
+
+    def _on_event(self, ev: dict) -> None:
+        if self._armed:
+            if len(self._window_events) < 10_000:
+                self._window_events.append(ev)
+            if (ev.get("ph") == "X"
+                    and ev.get("name") in self._WINDOW_SPANS):
+                self._left -= 1
+            if self._left <= 0 or time.monotonic() - self._t0 > self.max_s:
+                self._finish()
+            return
+        if ev.get("ph") == "i" and ev.get("name") in self.triggers:
+            self.trigger(ev["name"])
+
+    def trigger(self, reason: str) -> bool:
+        """Arm a capture window.  Returns False when already armed or
+        out of capture budget."""
+        if self._armed or len(self.captures) >= self.max_captures:
+            return False
+        self._armed = True
+        self._left = self.window_chunks
+        self._t0 = time.monotonic()
+        self._reason = reason
+        self._window_events = []
+        self.outdir.mkdir(parents=True, exist_ok=True)
+        if self.profiler:
+            try:
+                import jax
+
+                jax.profiler.start_trace(str(self._profile_dir()))
+                self._profiling = True
+            except Exception:
+                self._profiling = False
+        telemetry.incr("anomaly_captures")
+        otrace.instant("perf.capture_start", reason=reason)
+        return True
+
+    def _profile_dir(self) -> Path:
+        return self.outdir / f"xla_{len(self.captures)}"
+
+    def _finish(self) -> None:
+        if self._profiling:
+            try:
+                import jax
+
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+            self._profiling = False
+        out = self.outdir / f"anomaly_{len(self.captures)}.trace.json"
+        # the full buffered timeline when the trace layer records;
+        # otherwise the window this observer buffered itself
+        spans = (otrace.events() if otrace.is_enabled()
+                 else self._window_events)
+        try:
+            merge_perfetto(self._profile_dir(), out,
+                           extra_events=spans,
+                           meta={"reason": self._reason})
+            self.captures.append(str(out))
+        except Exception:
+            self.captures.append(None)
+        self._armed = False
+        otrace.instant("perf.capture_done", path=str(out))
+
+
+def merge_perfetto(profile_dir, out_path, extra_events=None,
+                   meta=None) -> str:
+    """Merge every ``*.trace.json[.gz]`` under ``profile_dir`` (the
+    ``jax.profiler`` output layout) with ``extra_events`` (obs span
+    dicts) into one Chrome/Perfetto trace file.  Tolerates a missing or
+    empty profiler dir — the span timeline alone still lands."""
+    events: list = []
+    profile_dir = os.fspath(profile_dir)
+    paths = sorted(
+        glob.glob(os.path.join(profile_dir, "**", "*.trace.json.gz"),
+                  recursive=True)
+        + glob.glob(os.path.join(profile_dir, "**", "*.trace.json"),
+                    recursive=True))
+    for p in paths:
+        try:
+            op = gzip.open if p.endswith(".gz") else open
+            with op(p, "rt") as fh:
+                doc = json.load(fh)
+            events.extend(doc.get("traceEvents", []))
+        except Exception:
+            continue
+    if extra_events:
+        events.extend(extra_events)
+    doc = {"traceEvents": events, "displayTimeUnit": "ms"}
+    if meta:
+        doc["metadata"] = dict(meta)
+    out_path = os.fspath(out_path)
+    with open(out_path, "w") as fh:
+        json.dump(doc, fh)
+    return out_path
+
+
+# ---------------------------------------------------------------------------
+# the durable perf ledger
+
+#: bumped when a record's field meaning changes (docs/OBSERVABILITY.md)
+LEDGER_SCHEMA = 1
+
+#: headline fields copied verbatim into a ledger record when present
+_HEADLINE_FIELDS = (
+    "metric", "value", "unit", "vs_baseline", "device_kind", "backend",
+    "sweeps_per_sec", "nchains", "mfu", "ess_per_sec",
+    "ess_per_sec_device", "rho_act_median", "mesh_axes", "n_retraces",
+    "dispatch_breakdown_ms", "stage_summary",
+)
+
+
+def ledger_path(root=None) -> Path:
+    return Path(root or _REPO_ROOT) / "PERF_LEDGER.jsonl"
+
+
+def git_sha() -> str | None:
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"], cwd=_REPO_ROOT,
+            capture_output=True, text=True, timeout=10)
+        return out.stdout.strip() or None
+    except Exception:
+        return None
+
+
+def make_ledger_record(headline: dict, *, source: str, kind: str = "bench",
+                       run: str | None = None, ts: float | None = None,
+                       note: str | None = None) -> dict:
+    """One append-only ledger line from a bench headline dict.  Heavy
+    sub-objects are condensed: the roofline keeps per-block MFU/bound
+    only, contract hashes come from the resilience block."""
+    rec = {"schema": LEDGER_SCHEMA, "kind": kind, "source": source,
+           "ts": time.time() if ts is None else ts}
+    if run:
+        rec["run"] = run
+    if note:
+        rec["note"] = note
+    for k in _HEADLINE_FIELDS:
+        if headline.get(k) is not None:
+            rec[k] = headline[k]
+    roof = headline.get("roofline")
+    if roof:
+        rec["roofline"] = {
+            name: {kk: r[kk] for kk in ("mfu", "intensity", "bound")
+                   if kk in r}
+            for name, r in roof.get("blocks", {}).items()}
+    contracts = (headline.get("resilience") or {}).get(
+        "jaxprcheck", {}).get("contracts")
+    if contracts:
+        rec["contract_hashes"] = contracts
+    sha = git_sha()
+    if sha:
+        rec["git_sha"] = sha
+    return rec
+
+
+def ledger_append(rec: dict, path=None) -> str:
+    path = os.fspath(path or ledger_path())
+    with open(path, "a") as fh:
+        fh.write(json.dumps(rec, sort_keys=True) + "\n")
+    return path
+
+
+def ledger_read(path=None) -> list[dict]:
+    """All well-formed records, in file order.  Corrupt lines (torn
+    appends) are skipped, counted in each run's ``check_ledger``."""
+    path = os.fspath(path or ledger_path())
+    out = []
+    if not os.path.exists(path):
+        return out
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except Exception:
+                continue
+            if isinstance(rec, dict):
+                out.append(rec)
+    return out
+
+
+# -- the regression gate
+
+#: rate metrics where bigger is better, with their default noise bands
+#: (allowed fractional drop of HEAD vs the best prior record in the
+#: same group).  Wide on purpose: bench numbers span hosts and load;
+#: the gate exists to catch step regressions, not jitter.
+DEFAULT_BANDS = {
+    "value": 0.35,
+    "sweeps_per_sec": 0.35,
+    "ess_per_sec": 0.40,
+    "ess_per_sec_device": 0.40,
+}
+
+
+def _group_key(rec: dict) -> tuple:
+    """Records compare only within (kind, metric, device, backend) —
+    a CPU smoke run must never gate against the TPU trajectory."""
+    return (rec.get("kind", "bench"), rec.get("metric"),
+            rec.get("device_kind"), rec.get("backend"))
+
+
+def check_ledger(records: list[dict], bands: dict | None = None) -> list:
+    """Noise-banded regression check over a ledger.
+
+    Within each (kind, metric, device_kind, backend) group the newest
+    record's rate fields must not fall more than the band fraction
+    below the best prior value.  New metrics/groups/fields (no prior)
+    pass; ``multichip`` records must carry ``ok: true``.  Returns a
+    list of problem strings — empty means the gate passes."""
+    bands = {**DEFAULT_BANDS, **(bands or {})}
+    problems: list = []
+    groups: dict = {}
+    multichip: list = []
+    for rec in records:
+        if rec.get("schema") is None:
+            problems.append(f"record missing schema: {rec.get('run') or rec}")
+            continue
+        if rec.get("kind") == "multichip":
+            multichip.append(rec)
+            continue
+        if rec.get("metric") is None:
+            continue
+        groups.setdefault(_group_key(rec), []).append(rec)
+    # early failed multichip runs are history, not a regression; only
+    # the trajectory's newest scaling record must be healthy
+    if multichip and multichip[-1].get("ok") is False:
+        problems.append(
+            f"newest multichip run {multichip[-1].get('run')} recorded "
+            "ok=false")
+    for key, recs in groups.items():
+        if len(recs) < 2:
+            continue                      # new group: tolerated
+        newest, prior = recs[-1], recs[:-1]
+        for field, band in bands.items():
+            new_v = newest.get(field)
+            if new_v is None or not isinstance(new_v, (int, float)):
+                continue
+            prev = [r[field] for r in prior
+                    if isinstance(r.get(field), (int, float))
+                    and math.isfinite(r[field])]
+            if not prev:
+                continue                  # new field: tolerated
+            best = max(prev)
+            floor = (1.0 - band) * best
+            if new_v < floor:
+                problems.append(
+                    f"{key[1]} [{key[2]}/{key[3]}] {field}: newest "
+                    f"{new_v:.4g} fell below noise band "
+                    f"(best prior {best:.4g}, floor {floor:.4g}, "
+                    f"band {band:.0%})")
+    return problems

@@ -6,16 +6,25 @@ wired into the dispatch pipeline seams (``JaxGibbsDriver.run``,
 is a shared ``nullcontext`` / early return — the hot loop pays one
 attribute load per span, no allocation, no lock.
 
-Enabled, finished spans/instants land in an in-memory buffer that
-exports to Perfetto/Chrome trace-event JSON (:func:`to_chrome`,
+Enabled, finished spans/instants land in a bounded in-memory ring
+buffer (oldest events drop first; :func:`dropped` counts the loss)
+that exports to Perfetto/Chrome trace-event JSON (:func:`to_chrome`,
 ``chrome://tracing`` / https://ui.perfetto.dev), and optionally stream
 to a ``sink`` callable — the hook ``tools/obs_probe.py`` and the serve
 layer use to append ``metrics.jsonl`` span events next to the
 supervisor's (span taxonomy: docs/OBSERVABILITY.md).
+
+Separate from the buffer, *observers* (:func:`add_observer`) receive
+every finished event live without buffering — the seam
+``obs.perf.StageAggregator`` and ``obs.perf.FlightRecorder`` hang off.
+An installed observer activates the span seams even while the buffer
+is disabled, so streaming telemetry does not require (or pay for)
+whole-run event retention.
 """
 
 from __future__ import annotations
 
+import collections
 import contextlib
 import json
 import os
@@ -24,22 +33,26 @@ import time
 
 _lock = threading.Lock()
 _enabled = False
-_events: list = []
-_t0 = 0.0
-_sink = None
-_tids: dict = {}
-_NULL = contextlib.nullcontext()
 #: cap so a forgotten enable() cannot grow without bound (~100 bytes/ev)
 MAX_EVENTS = 200_000
+_events: collections.deque = collections.deque(maxlen=MAX_EVENTS)
+_dropped = 0
+_t0 = 0.0
+_sink = None
+_observers: list = []
+_tids: dict = {}
+_NULL = contextlib.nullcontext()
 
 
 def enable(sink=None) -> None:
     """Start recording (clears the buffer).  ``sink``, if given, is
     called with a dict per finished span/instant — exceptions from it
     are swallowed (observability must not kill the run)."""
-    global _enabled, _t0, _sink
+    global _enabled, _t0, _sink, _events, _dropped
     with _lock:
-        _events.clear()
+        # recreate so a monkeypatched MAX_EVENTS takes effect per-enable
+        _events = collections.deque(maxlen=MAX_EVENTS)
+        _dropped = 0
         _tids.clear()
         _t0 = time.monotonic()
         _sink = sink
@@ -47,14 +60,47 @@ def enable(sink=None) -> None:
 
 
 def disable() -> None:
+    """Stop recording.  The buffer is kept for late export; the sink,
+    if it exposes ``flush``/``close`` (``jsonl_sink`` does), is flushed
+    and closed.  Observers are managed independently and stay put."""
     global _enabled, _sink
     with _lock:
         _enabled = False
-        _sink = None
+        sink, _sink = _sink, None
+    for meth in ("flush", "close"):
+        fn = getattr(sink, meth, None)
+        if fn is not None:
+            try:
+                fn()
+            except Exception:
+                pass
 
 
 def is_enabled() -> bool:
     return _enabled
+
+
+def add_observer(fn) -> None:
+    """Register a live event observer (called with each finished
+    span/instant dict, outside the buffer lock; exceptions swallowed).
+    Observers keep the span seams active even when buffering is off."""
+    global _t0
+    with _lock:
+        if not _enabled and not _observers:
+            _t0 = time.monotonic()   # give observer-only events a base
+        if fn not in _observers:
+            _observers.append(fn)
+
+
+def remove_observer(fn) -> None:
+    with _lock:
+        if fn in _observers:
+            _observers.remove(fn)
+
+
+def dropped() -> int:
+    """Events lost to the ring-buffer cap since the last ``enable()``."""
+    return _dropped
 
 
 def _tid() -> int:
@@ -66,15 +112,25 @@ def _tid() -> int:
 
 
 def _emit(ev: dict) -> None:
+    global _dropped
     sink = _sink
     with _lock:
-        if len(_events) < MAX_EVENTS:
+        if _enabled:
+            if len(_events) == _events.maxlen:
+                _dropped += 1           # deque evicts the oldest event
             _events.append(ev)
+        observers = list(_observers) if _observers else None
     if sink is not None:
         try:
             sink(ev)
         except Exception:
             pass
+    if observers:
+        for fn in observers:
+            try:
+                fn(ev)
+            except Exception:
+                pass
 
 
 class _Span:
@@ -89,7 +145,7 @@ class _Span:
         return self
 
     def __exit__(self, *exc):
-        if not _enabled:        # disabled mid-span: drop it
+        if not (_enabled or _observers):    # disabled mid-span: drop it
             return False
         end = time.monotonic()
         _emit({"ph": "X", "name": self.name,
@@ -104,14 +160,14 @@ def span(name: str, **args):
     """Context manager timing a pipeline stage.  Nesting is expressed
     by containment of the ``ts``/``dur`` intervals (Chrome 'X' complete
     events), so concurrently open spans on one thread render stacked."""
-    if not _enabled:
+    if not (_enabled or _observers):
         return _NULL
     return _Span(name, args)
 
 
 def instant(name: str, **args) -> None:
     """A zero-duration marker (watchdog soft/stall events etc.)."""
-    if not _enabled:
+    if not (_enabled or _observers):
         return
     _emit({"ph": "i", "name": name, "ts": (time.monotonic() - _t0) * 1e6,
            "pid": os.getpid(), "tid": _tid(), "s": "t", "args": args})
@@ -121,10 +177,17 @@ def events() -> list:
     with _lock:
         return list(_events)
 
-
 def to_chrome() -> dict:
-    """The Chrome/Perfetto trace-event JSON object."""
-    return {"traceEvents": events(), "displayTimeUnit": "ms"}
+    """The Chrome/Perfetto trace-event JSON object.  When the ring
+    buffer overflowed, a leading instant records how many events the
+    timeline is missing."""
+    evs = events()
+    if _dropped:
+        evs.insert(0, {"ph": "i", "name": "trace.ring_dropped",
+                       "ts": 0.0, "pid": os.getpid(), "tid": 0, "s": "g",
+                       "args": {"dropped": _dropped,
+                                "cap": MAX_EVENTS}})
+    return {"traceEvents": evs, "displayTimeUnit": "ms"}
 
 
 def write_chrome(path) -> str:
@@ -136,8 +199,11 @@ def write_chrome(path) -> str:
 
 def jsonl_sink(path):
     """A ``sink`` that appends one metrics.jsonl line per event, in the
-    supervisor's record shape (``runtime.supervisor._log_event``)."""
+    supervisor's record shape (``runtime.supervisor._log_event``).
+    Keeps one file handle open (line-buffered); ``disable()`` calls the
+    attached ``flush``/``close``."""
     path = os.fspath(path)
+    fh = open(path, "a", buffering=1)
 
     def _sink(ev):
         rec = {"ts": round(time.time(), 3), "event": "trace_span"
@@ -145,7 +211,8 @@ def jsonl_sink(path):
                "name": ev["name"], **ev.get("args", {})}
         if ev.get("ph") == "X":
             rec["ms"] = round(ev["dur"] / 1e3, 3)
-        with open(path, "a") as fh:
-            fh.write(json.dumps(rec) + "\n")
+        fh.write(json.dumps(rec) + "\n")
 
+    _sink.flush = fh.flush
+    _sink.close = fh.close
     return _sink

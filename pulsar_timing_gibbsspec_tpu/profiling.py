@@ -37,6 +37,16 @@ _PEAK_FLOPS = {
 }
 
 
+#: advertised HBM (or DRAM) bandwidth per chip, bytes/s — the roofline
+#: denominator.  Same keying/caveats as ``_PEAK_FLOPS``.
+_PEAK_BW = {
+    "v5 lite": 819e9,
+    "v5e": 819e9,
+    "v4": 1228e9,
+    "cpu": 5e10,
+}
+
+
 def device_peak_flops() -> float:
     import jax
 
@@ -45,6 +55,16 @@ def device_peak_flops() -> float:
         if frag in kind:
             return peak
     return _PEAK_FLOPS["cpu"]
+
+
+def device_peak_bw() -> float:
+    import jax
+
+    kind = jax.devices()[0].device_kind.lower()
+    for frag, bw in _PEAK_BW.items():
+        if frag in kind:
+            return bw
+    return _PEAK_BW["cpu"]
 
 
 def _sync(out):
@@ -98,15 +118,30 @@ def _scan_time(body2, x, b, inner, repeats):
     return max(t_inner - t_one, 1e-9) / (inner - 1)
 
 
-def profile_blocks(driver, x, repeats=5, inner=50):
-    """Per-block device times (seconds per sweep) of one post-adaptation
-    Gibbs sweep, at the driver's actual ``nchains`` width (each block is
-    vmapped over the chains axis exactly as the production sweep runs it,
-    so the breakdown sums to the real sweep and matches the MFU line).
-    Each block is timed inside its own ``lax.scan`` of ``inner``
-    iterations so per-dispatch overhead (dominant on remote devices)
-    cancels; ``dispatch`` reports that overhead per call.  Requires the
-    driver to have completed adaptation (``_first_sweep``).
+def _block_state(driver, x):
+    """The (C, ...) device state tuple ``(x, b)`` the block bodies run
+    on, from a host x of either (nx,) or (C, nx) shape."""
+    import jax.numpy as jnp
+
+    cm = driver.cm
+    x = np.asarray(x, np.float64)
+    if x.ndim == 1:
+        x = np.tile(x, (driver.C, 1))
+    return jnp.asarray(x, cm.cdtype), jnp.asarray(driver.b)
+
+
+def _block_bodies(driver, x, b):
+    """The named per-block bodies of one post-adaptation Gibbs sweep,
+    each a ``body(x, b, key) -> (x, b)`` at the driver's actual
+    ``nchains`` width (vmapped over the chains axis exactly as the
+    production sweep runs it).  Returns ``(bodies, full, in_sweep)``
+    where ``full`` is the composed production sweep body and
+    ``in_sweep[name]`` says whether that block runs in the every-sweep
+    budget of THIS config (refresh slots and kernel cores are measured
+    for attribution only).  Shared by the timing path
+    (:func:`profile_blocks`) and the static cost path
+    (:func:`block_cost_model`), so measured milliseconds and counted
+    FLOPs always describe the same program.
     """
     import jax
     import jax.numpy as jnp
@@ -116,11 +151,6 @@ def profile_blocks(driver, x, repeats=5, inner=50):
 
     cm = driver.cm
     C = driver.C
-    x = np.asarray(x, np.float64)
-    if x.ndim == 1:
-        x = np.tile(x, (C, 1))
-    x = jnp.asarray(x, cm.cdtype)                 # (C, nx)
-    b = jnp.asarray(driver.b)                     # (C, P, Bmax)
     out = {}
 
     def vm(single):
@@ -147,7 +177,7 @@ def profile_blocks(driver, x, repeats=5, inner=50):
         def white(x, b, k):
             return jax.vmap(white1)(x, b, jr.split(k, C), *aux_w)
 
-        out[f"white_mh[{nw}]"] = _scan_time(white, x, b, inner, repeats)
+        out[f"white_mh[{nw}]"] = white
 
     if len(cm.idx.ecorr) and driver.aclength_ecorr and (cm.ec_cols.shape[1]
                                                         or cm.has_ke):
@@ -165,12 +195,11 @@ def profile_blocks(driver, x, repeats=5, inner=50):
         def ecorr(x, b, k):
             return jax.vmap(ecorr1)(x, b, jr.split(k, C), *aux_e)
 
-        out[f"ecorr_mh[{ne}]"] = _scan_time(ecorr, x, b, inner, repeats)
+        out[f"ecorr_mh[{ne}]"] = ecorr
 
     if driver.do_red_conditional:
-        out["red_conditional"] = _scan_time(
-            vm(lambda x, b, k: (jb.red_conditional_update(cm, x, b, k), b)),
-            x, b, inner, repeats)
+        out["red_conditional"] = vm(
+            lambda x, b, k: (jb.red_conditional_update(cm, x, b, k), b))
 
     if driver.do_red_mh:
         ns = driver.red_steps
@@ -186,26 +215,22 @@ def profile_blocks(driver, x, repeats=5, inner=50):
         def redmh(x, b, k):
             return jax.vmap(red1)(x, b, jr.split(k, C), U, S, hist)
 
-        out[f"red_mh[{ns}]"] = _scan_time(redmh, x, b, inner, repeats)
+        out[f"red_mh[{ns}]"] = redmh
 
     if cm.K and len(cm.rho_ix_x):
-        out["rho_gumbel"] = _scan_time(
-            vm(lambda x, b, k: (jb.rho_update(cm, x, b, k), b)),
-            x, b, inner, repeats)
+        out["rho_gumbel"] = vm(
+            lambda x, b, k: (jb.rho_update(cm, x, b, k), b))
 
     # the steady-sweep b-draw as the production body runs it: mixed /
     # two-float kernels for the structured joint (non-CRN) path, the f64
     # exact CRN draw otherwise (CRN steady sweeps run b_mh below — its
     # in_sweep flag says so)
-    out["b_draw"] = _scan_time(
-        vm(lambda x, b, k: (x, jb.draw_b_fn(cm, x, k, b))), x, b, inner,
-        repeats)
+    out["b_draw"] = vm(lambda x, b, k: (x, jb.draw_b_fn(cm, x, k, b)))
     if cm.orf_name != "crn":
         # the periodic exact_every refresh slot: the f64 factorization of
         # the same joint system (never in the every-sweep budget)
-        out["b_draw_exact"] = _scan_time(
-            vm(lambda x, b, k: (x, jb.draw_b_fn(cm, x, k, b, exact=True))),
-            x, b, inner, repeats)
+        out["b_draw_exact"] = vm(
+            lambda x, b, k: (x, jb.draw_b_fn(cm, x, k, b, exact=True)))
     if cm.orf_name == "crn" and not cm.has_ke:
         # the production refresh slot (exact_every): Metropolised
         # segmented-Gram draw, cheaper than the f64 exact draw above
@@ -214,7 +239,7 @@ def profile_blocks(driver, x, repeats=5, inner=50):
             bn, _, _ = jb.draw_b_refresh(cm, x1, b1, u1, k1)
             return x1, bn
 
-        out["b_refresh"] = _scan_time(vm(refresh1), x, b, inner, repeats)
+        out["b_refresh"] = vm(refresh1)
 
         # the every-sweep Metropolised draw and its N-axis-heavy core (the
         # f32 Gram einsum): how much of full_sweep rides the padded TOA
@@ -224,7 +249,7 @@ def profile_blocks(driver, x, repeats=5, inner=50):
             bn, _, _ = jb.draw_b_mh(cm, x1, b1, u1, k1)
             return x1, bn
 
-        out["b_mh"] = _scan_time(vm(bmh1), x, b, inner, repeats)
+        out["b_mh"] = vm(bmh1)
 
         def gram1(x1, b1, k1):
             N = cm.ndiag_fast(x1)
@@ -234,17 +259,16 @@ def profile_blocks(driver, x, repeats=5, inner=50):
                              precision="highest")
             return x1, b1 + 0.0 * TNT[:, : b1.shape[1], 0]
 
-        out["gram32"] = _scan_time(vm(gram1), x, b, inner, repeats)
+        out["gram32"] = vm(gram1)
 
         def rsq1(x1, b1, k1):
             r2 = jb.residual_sq(cm, b1)
             return x1 + 0.0 * r2[0, 0], b1
 
-        out["residual_sq"] = _scan_time(vm(rsq1), x, b, inner, repeats)
+        out["residual_sq"] = vm(rsq1)
 
-    # the composed sweep, timed the same way (this is what the chunked
-    # driver actually runs; t=1 exercises the Metropolised-b-draw branch),
-    # plus the per-dispatch overhead for context
+    # the composed sweep (this is what the chunked driver actually runs;
+    # t=1 exercises the Metropolised-b-draw branch)
     body = driver._sweep_body()
     aux = driver._aux()
 
@@ -257,10 +281,6 @@ def profile_blocks(driver, x, repeats=5, inner=50):
         xn, bn = jax.vmap(one, in_axes=(0, 0, 0, 0))(x, b,
                                                      jr.split(k, C), aux)
         return xn, bn
-
-    full_sweep = _scan_time(full, x, b, inner, repeats)
-    dispatch = _timeit(
-        jax.jit(lambda x: x + 1.0), (jnp.zeros(()),), repeats)
 
     # reconciliation layer: per_block_ms entries are only comparable to
     # full_sweep_ms when the block actually runs in the every-sweep body
@@ -278,9 +298,46 @@ def profile_blocks(driver, x, repeats=5, inner=50):
             in_sweep[name] = False
         else:
             in_sweep[name] = True          # white/ecorr/red/rho blocks
+    return out, full, in_sweep
+
+
+def profile_blocks(driver, x, repeats=5, inner=50):
+    """Per-block device times (seconds per sweep) of one post-adaptation
+    Gibbs sweep, at the driver's actual ``nchains`` width (each block is
+    vmapped over the chains axis exactly as the production sweep runs it,
+    so the breakdown sums to the real sweep and matches the MFU line).
+    Each block is timed inside its own ``lax.scan`` of ``inner``
+    iterations so per-dispatch overhead (dominant on remote devices)
+    cancels; ``dispatch`` reports that overhead per call.  Requires the
+    driver to have completed adaptation (``_first_sweep``).
+
+    The report also carries the static cost model's per-block FLOP/byte
+    counts joined with these times as a roofline attribution table
+    (``"roofline"`` key, best-effort: ``None`` when tracing fails).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    x_host = x
+    x, b = _block_state(driver, x)
+    bodies, full, in_sweep = _block_bodies(driver, x, b)
+    out = {name: _scan_time(body, x, b, inner, repeats)
+           for name, body in bodies.items()}
+    full_sweep = _scan_time(full, x, b, inner, repeats)
+    dispatch = _timeit(
+        jax.jit(lambda x: x + 1.0), (jnp.zeros(()),), repeats)
+
     per_block_ms = {k: v * 1e3 for k, v in out.items()}
+    per_block_ms["full_sweep"] = full_sweep * 1e3
+    costs = roof = None
     try:
-        breakdown = dispatch_breakdown(driver, x)
+        costs = block_cost_model(driver, x_host)
+        roof = roofline(costs, per_block_ms)
+    except Exception:     # noqa: BLE001 — attribution is best-effort
+        pass
+    per_block_ms.pop("full_sweep")
+    try:
+        breakdown = dispatch_breakdown(driver, x_host)
     except Exception:     # noqa: BLE001 — the breakdown is best-effort
         breakdown = None
     return {
@@ -291,7 +348,59 @@ def profile_blocks(driver, x, repeats=5, inner=50):
         "full_sweep_ms": full_sweep * 1e3,
         "dispatch_ms": dispatch * 1e3,
         "dispatch_breakdown_ms": breakdown,
+        "block_costs": costs,
+        "roofline": roof,
     }
+
+
+def block_cost_model(driver, x):
+    """Static per-block FLOP + HBM-byte counts of the same bodies
+    :func:`profile_blocks` times, via the jaxprcheck C6 cost walker
+    (host-side tracing only — nothing executes).  Returns
+    ``{block: {"flops", "dot_flops", "hbm_bytes", "intensity"}}``
+    including the composed ``full_sweep``."""
+    import jax.random as jr
+
+    from .analysis.jaxprcheck.cost import jaxpr_cost
+    from .analysis.jaxprcheck.walk import trace_jaxpr
+
+    x, b = _block_state(driver, x)
+    bodies, full, _ = _block_bodies(driver, x, b)
+    key = jr.key(0)
+    costs = {}
+    for name, body in {**bodies, "full_sweep": full}.items():
+        costs[name] = jaxpr_cost(trace_jaxpr(body, (x, b, key))).as_dict()
+    return costs
+
+
+def roofline(costs, per_block_ms=None, peak_flops=None, peak_bw=None):
+    """Join static per-block costs with measured per-block times into a
+    roofline attribution table: arithmetic intensity (FLOP/byte) against
+    the device ridge point classifies each block compute- vs
+    bandwidth-bound; measured times add per-block MFU and
+    bandwidth-utilization fractions.  ``per_block_ms`` is optional —
+    without it the classification is purely static."""
+    peak = peak_flops if peak_flops is not None else device_peak_flops()
+    bw = peak_bw if peak_bw is not None else device_peak_bw()
+    ridge = peak / bw
+    blocks = {}
+    for name, c in costs.items():
+        ai = c["flops"] / c["hbm_bytes"] if c["hbm_bytes"] else 0.0
+        row = {
+            "gflops": c["flops"] / 1e9,
+            "hbm_mib": c["hbm_bytes"] / 2 ** 20,
+            "intensity": ai,
+            "bound": "compute" if ai >= ridge else "bandwidth",
+        }
+        ms = (per_block_ms or {}).get(name)
+        if ms and ms > 0:
+            t = ms / 1e3
+            row["ms"] = ms
+            row["mfu"] = c["flops"] / t / peak
+            row["bw_frac"] = c["hbm_bytes"] / t / bw
+        blocks[name] = row
+    return {"peak_flops": peak, "peak_bytes_per_sec": bw,
+            "ridge_flop_per_byte": ridge, "blocks": blocks}
 
 
 def dispatch_breakdown(driver, x):
@@ -351,23 +460,46 @@ def dispatch_breakdown(driver, x):
 
     staged()              # warm: the chunk fn may still need compiling
     hp, eq, dv, wb = staged()
-    return {"host_prep": hp * 1e3, "enqueue": eq * 1e3,
-            "device": dv * 1e3, "writeback": wb * 1e3}
+    out = {"host_prep": hp * 1e3, "enqueue": eq * 1e3,
+           "device": dv * 1e3, "writeback": wb * 1e3}
+    # the one-shot probe publishes the same dispatch_ms family the
+    # streaming StageAggregator feeds, tagged stat="probe" so the scrape
+    # distinguishes a staged measurement from live EMA/percentiles
+    from .runtime import telemetry
+
+    for stage, ms in out.items():
+        telemetry.gauge("dispatch_ms", ms, stage=stage, stat="probe")
+    return out
+
+
+def flop_counts(cm, nchains=1):
+    """Analytic per-term FLOP counts of the dominant per-sweep kernels —
+    the ground truth the static cost model (C6) is validated against.
+
+    Only the terms that can matter on a TPU are counted, each under its
+    own key so the jaxpr-derived ``dot_general`` counts can be compared
+    term-by-term: the TNT Gram einsum (2 P N B^2), the T b basis matvec
+    (2 P N B), the batched Cholesky (P B^3 / 3) and triangular solves
+    (3 P B^2).  Elementwise work (grids, MH deltas) is bandwidth- not
+    FLOP-bound and is excluded.
+    """
+    P, N, B = cm.P, cm.Nmax, cm.Bmax
+    return {
+        "gram_einsum": 2.0 * P * N * B * B * nchains,
+        "basis_matvec": 2.0 * P * N * B * nchains,
+        "cholesky": P * (B ** 3) / 3.0 * nchains,
+        "tri_solves": 3.0 * P * B * B * nchains,
+    }
 
 
 def sweep_flops(cm, nchains=1):
-    """Analytic FLOP count of the dominant per-sweep kernels.
-
-    Only the terms that can matter on a TPU are counted: the TNT einsum
-    (2 P N B^2), the T b matvec, the batched Cholesky (P B^3 / 3) and
-    triangular solves (3 P B^2).  Elementwise work (grids, MH deltas) is
-    bandwidth- not FLOP-bound and is excluded.
-    """
-    P, N, B = cm.P, cm.Nmax, cm.Bmax
-    ein = 2.0 * P * N * B * B + 2.0 * P * N * B
-    chol = P * (B ** 3) / 3.0 + 3.0 * P * B * B
-    return {"tnt_einsum": ein * nchains, "cholesky": chol * nchains,
-            "total": (ein + chol) * nchains}
+    """The :func:`flop_counts` terms folded into the historical bench
+    shape (``tnt_einsum`` = Gram + matvec, ``cholesky`` = factor +
+    solves, plus ``total``)."""
+    fc = flop_counts(cm, nchains)
+    ein = fc["gram_einsum"] + fc["basis_matvec"]
+    chol = fc["cholesky"] + fc["tri_solves"]
+    return {"tnt_einsum": ein, "cholesky": chol, "total": ein + chol}
 
 
 def format_report(report: dict, flops: dict | None = None,
@@ -391,6 +523,24 @@ def format_report(report: dict, flops: dict | None = None,
     if bd:
         parts = " + ".join(f"{k} {v:.1f}" for k, v in bd.items())
         lines.append(f"  chunk stages: {parts} ms")
+    roof = report.get("roofline")
+    if roof:
+        lines.append(
+            f"roofline attribution (peak {roof['peak_flops']:.3g} FLOP/s, "
+            f"{roof['peak_bytes_per_sec']:.3g} B/s, ridge "
+            f"{roof['ridge_flop_per_byte']:.0f} FLOP/B):")
+        lines.append(f"  {'block':<20s} {'GFLOP':>9s} {'MiB':>9s} "
+                     f"{'AI':>7s} {'MFU%':>7s} {'BW%':>6s}  bound")
+        rows = sorted(roof["blocks"].items(),
+                      key=lambda kv: -kv[1].get("ms", 0.0))
+        for name, r in rows:
+            mfu = (f"{100 * r['mfu']:7.3f}" if "mfu" in r
+                   else f"{'-':>7s}")
+            bwf = (f"{100 * r['bw_frac']:6.2f}" if "bw_frac" in r
+                   else f"{'-':>6s}")
+            lines.append(
+                f"  {name:<20s} {r['gflops']:9.3f} {r['hbm_mib']:9.2f} "
+                f"{r['intensity']:7.1f} {mfu} {bwf}  {r['bound']}")
     if flops and sweeps_per_sec:
         achieved = flops["total"] * sweeps_per_sec
         peak = device_peak_flops()

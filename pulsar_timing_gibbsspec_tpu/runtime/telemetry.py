@@ -24,10 +24,17 @@ closed set):
 - ``watchdog_dumps``      stack dumps at the hard deadline
 - ``watchdog_stalls``     chunk dispatches aborted as stalled
 - ``stall_retries``       supervisor retries under the stall policy
+- ``stage_band_breaches`` stage samples past ``band_k``x their EMA
+                          (labeled ``stage=``; obs.perf.StageAggregator)
+- ``anomaly_captures``    flight-recorder windows opened (obs.perf)
 
 Gauges (:func:`gauge`) carry last-value measurements (floats) next to
 the counters — e.g. ``drain_latency_ms``, the request-to-verified-
-checkpoint time of the most recent preemption drain.
+checkpoint time of the most recent preemption drain; the streaming
+dispatch attribution lives here too: ``dispatch_ms{stage=,stat=}``,
+``chunk_wall_ms``/``chunk_wall_ema_ms`` (driver steady loop) and
+``watchdog_ema_s``/``watchdog_deadline_s`` (glossary:
+docs/OBSERVABILITY.md "Streaming stage gauges").
 
 Serving-layer gauges and their glossary moved to docs/OBSERVABILITY.md
 ("Metric and label glossary") together with the per-job labeled serve
@@ -56,13 +63,20 @@ _counts: dict[str, int] = {}
 _gauges: dict[str, float] = {}
 
 
+def _esc(v) -> str:
+    """Prometheus label-value escaping; keeps composite keys parseable
+    when a value carries quotes/backslashes (e.g. a path label)."""
+    return str(v).replace("\\", "\\\\").replace('"', '\\"').replace(
+        "\n", "\\n")
+
+
 def labeled(name: str, **labels) -> str:
     """The composite registry key of a labeled series (identity for no
     labels).  Matches Prometheus exposition syntax; ``obs.metrics.
-    split_key`` is the inverse."""
+    split_key`` is the inverse (including value unescaping)."""
     if not labels:
         return name
-    lab = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    lab = ",".join(f'{k}="{_esc(v)}"' for k, v in sorted(labels.items()))
     return f"{name}{{{lab}}}"
 
 

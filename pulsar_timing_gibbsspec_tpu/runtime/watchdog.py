@@ -93,6 +93,10 @@ class DispatchWatchdog:
         dt = float(dt)
         self.ema = dt if self.ema is None else (
             self.ema_alpha * dt + (1.0 - self.ema_alpha) * self.ema)
+        # the live deadline model, scrapeable next to the dispatch_ms
+        # stage gauges (perfwatch's stall-margin view)
+        telemetry.gauge("watchdog_ema_s", self.ema)
+        telemetry.gauge("watchdog_deadline_s", self.deadline())
 
     def deadline(self) -> float:
         """Current hard deadline (seconds) for one guarded call."""

@@ -3473,6 +3473,10 @@ class JaxGibbsDriver:
             if not fresh_compile:
                 wall_ema = dt if wall_ema is None else (
                     0.3 * dt + 0.7 * wall_ema)
+                # host-dict writes only — nothing traced, so the
+                # bitwise-inert proof in tests/test_obs.py covers this
+                telemetry.gauge("chunk_wall_ms", dt * 1e3)
+                telemetry.gauge("chunk_wall_ema_ms", wall_ema * 1e3)
                 if wd is not None:
                     wd.observe(dt)
             pending = (rowc, m, xs, bs, x, b_dev, ii + n, health,

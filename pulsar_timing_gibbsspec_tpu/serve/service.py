@@ -76,7 +76,7 @@ class SamplerService:
     def __init__(self, root, table: BucketTable, *, slots=2, chunk=4,
                  save_every=1, quantum=8, service_seed=0, max_retries=2,
                  backoff_base=0.0, cache: ProgramCache | None = None,
-                 mesh=None, ensemble=False, pt_ladder=1):
+                 mesh=None, ensemble=False, pt_ladder=1, perf=False):
         # the multiplexed chunk is vmap(sharded_sweep_step) over the
         # TENANT axis — rows are unrelated analyses, so any cross-chain
         # ensemble stage (stretch pairing, tempering swaps) would couple
@@ -132,6 +132,17 @@ class SamplerService:
         self._compile_stalls = 0
         self._next_tenant = 0
         self._retries = 0
+
+        # perf=True hangs the streaming stage aggregator off the trace
+        # seams: every serve.prepare/dispatch/d2h/writeback span folds
+        # into dispatch_ms{stage=...,job="svc"} gauges that prometheus()
+        # scrapes live — no per-chunk work beyond the span it already
+        # emits, and nothing traced (sampling stays bitwise identical)
+        self._stage_agg = None
+        if perf:
+            from ..obs.perf import StageAggregator
+
+            self._stage_agg = StageAggregator(job="svc").install()
 
     # -- request intake -----------------------------------------------------
 
@@ -550,7 +561,7 @@ class SamplerService:
                 for jid, j in self.jobs.items()}
         from ..parallel.sharding import mesh_layout
 
-        return {
+        out = {
             "jobs": jobs,
             "chunks": int(self.global_chunk),
             "evictions": int(self._evictions),
@@ -560,3 +571,13 @@ class SamplerService:
             "mesh": mesh_layout(self.mesh),
             "gauges": telemetry.gauges(),
         }
+        if self._stage_agg is not None:
+            out["stage_summary"] = self._stage_agg.summary()
+        return out
+
+    def close(self) -> None:
+        """Detach the service's trace observers (perf aggregator); the
+        program cache and checkpoints stay for a warm successor."""
+        if self._stage_agg is not None:
+            self._stage_agg.uninstall()
+            self._stage_agg = None

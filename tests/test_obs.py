@@ -206,6 +206,48 @@ def test_instrumented_chunk_bitwise_identical():
         runs[None][2].obs_summary()
 
 
+def test_stage_aggregator_bitwise_inert():
+    """The PR 7 proof extended over the streaming stage telemetry: a
+    run with the StageAggregator observing every chunk span produces
+    byte-identical sampling outputs — the gauges are host-side folds of
+    host-side timestamps, nothing enters the traced program."""
+    from pulsar_timing_gibbsspec_tpu.analysis.jaxprcheck.entries import (
+        build_model, synthetic_pulsars)
+    from pulsar_timing_gibbsspec_tpu.obs.perf import StageAggregator
+    from pulsar_timing_gibbsspec_tpu.sampler.jax_backend import (
+        JaxGibbsDriver)
+
+    pta = build_model(synthetic_pulsars(2, 24, tm_cols=3, seed=0), 2)
+    x0 = pta.initial_sample(np.random.default_rng(0))
+    telemetry.reset("dispatch_ms")
+    runs = {}
+    for watched in (False, True):
+        agg = StageAggregator(job="bw").install() if watched else None
+        try:
+            drv = JaxGibbsDriver(pta, seed=7, common_rho=True,
+                                 white_adapt_iters=6, chunk_size=8,
+                                 nchains=2, warmup_sweeps=6)
+            cs, bs = drv.chain_shapes(30)
+            chain, bchain = np.zeros(cs), np.zeros(bs)
+            for _ in drv.run(x0, chain, bchain, 0, 30):
+                pass
+        finally:
+            if agg is not None:
+                agg.uninstall()
+        runs[watched] = (chain, bchain, agg)
+    assert runs[False][0].tobytes() == runs[True][0].tobytes()
+    assert runs[False][1].tobytes() == runs[True][1].tobytes()
+    # and the observer actually saw the pipeline: per-stage series fed,
+    # labeled gauges live in the registry
+    summ = runs[True][2].summary()
+    assert summ, "StageAggregator saw no pipeline spans"
+    assert any(st in summ for st in ("enqueue", "device"))
+    assert telemetry.get_gauge("dispatch_ms", job="bw",
+                               stage=next(iter(summ)),
+                               stat="ema") is not None
+    telemetry.reset("dispatch_ms")
+
+
 # ---------------------------------------------------------------------------
 # trace layer
 
@@ -252,6 +294,65 @@ def test_trace_disabled_is_free():
         pass
     trace.instant("z")
     assert trace.events() == before     # nothing recorded while off
+
+
+def test_trace_ring_bounded_and_dropped(monkeypatch):
+    """The event buffer is a ring: a long run cannot grow host memory
+    unboundedly; evictions are counted and flagged in the export."""
+    from pulsar_timing_gibbsspec_tpu.obs import trace
+
+    monkeypatch.setattr(trace, "MAX_EVENTS", 5)
+    trace.enable()                      # ring is sized at enable()
+    try:
+        for i in range(12):
+            trace.instant(f"e{i}")
+        evs = trace.events()
+        assert len(evs) == 5
+        assert [e["name"] for e in evs] == [f"e{i}" for i in range(7, 12)]
+        assert trace.dropped() == 7
+        doc = trace.to_chrome()
+        assert any(e["name"] == "trace.ring_dropped"
+                   and e["args"]["dropped"] == 7
+                   for e in doc["traceEvents"])
+    finally:
+        trace.disable()
+
+
+def test_trace_jsonl_sink_flushes_on_disable(tmp_path):
+    from pulsar_timing_gibbsspec_tpu.obs import trace
+
+    path = tmp_path / "t.jsonl"
+    trace.enable(trace.jsonl_sink(path))
+    with trace.span("work", k=1):
+        pass
+    trace.instant("mark")
+    trace.disable()                     # flush + close the sink handle
+    lines = [json.loads(ln) for ln in path.read_text().splitlines()]
+    assert [ev["name"] for ev in lines] == ["work", "mark"]
+    # supervisor metrics.jsonl record shape: args splatted inline
+    assert lines[0]["event"] == "trace_span" and lines[0]["k"] == 1
+    assert lines[0]["ms"] >= 0.0
+    assert lines[1]["event"] == "trace_instant"
+
+
+def test_trace_observer_activates_seams_while_disabled():
+    """An installed observer receives every finished event live even
+    with buffering off — and removal restores the shared nullcontext."""
+    from pulsar_timing_gibbsspec_tpu.obs import trace
+
+    trace.disable()
+    before = trace.events()             # buffer kept from prior enables
+    seen = []
+    trace.add_observer(seen.append)
+    try:
+        with trace.span("chunk.dispatch"):
+            pass
+        trace.instant("ping")
+    finally:
+        trace.remove_observer(seen.append)
+    assert [e["name"] for e in seen] == ["chunk.dispatch", "ping"]
+    assert trace.events() == before     # nothing buffered while off
+    assert trace.span("a") is trace.span("b")   # nullcontext restored
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,439 @@
+"""The performance observatory (obs.perf + analysis.jaxprcheck.cost +
+tools/perfwatch.py): streaming stage gauges, anomaly capture, the static
+roofline cost model, and the perf-ledger regression gate.
+
+The cost-model acceptance bound lives here: the jaxpr-derived
+``dot_general`` FLOPs of the CRN Gram einsum must match the analytic
+``profiling.flop_counts`` term within 5% — the tie that keeps the
+roofline attribution honest.
+"""
+
+import gzip
+import importlib.util
+import json
+import math
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from pulsar_timing_gibbsspec_tpu.config import settings
+
+settings.apply()
+
+from pulsar_timing_gibbsspec_tpu.obs import metrics, trace as otrace
+from pulsar_timing_gibbsspec_tpu.obs.perf import (DEFAULT_BANDS,
+                                                  FlightRecorder,
+                                                  RingSeries,
+                                                  StageAggregator,
+                                                  check_ledger,
+                                                  ledger_append,
+                                                  ledger_read,
+                                                  make_ledger_record,
+                                                  merge_perfetto)
+from pulsar_timing_gibbsspec_tpu.runtime import telemetry
+
+_REPO = Path(__file__).resolve().parents[1]
+
+
+def _load_perfwatch():
+    spec = importlib.util.spec_from_file_location(
+        "perfwatch", _REPO / "tools" / "perfwatch.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ---------------------------------------------------------------------------
+# RingSeries
+
+
+def test_ring_series_bounds_and_stats():
+    s = RingSeries(cap=8, ema_alpha=0.5)
+    assert s.last() is None
+    for v in range(20):
+        s.append(float(v))
+    assert len(s) == 8                    # window bounded by cap
+    assert s.count == 20                  # total appended still counted
+    assert s.last() == 19.0
+    vals = np.sort(s.values())
+    np.testing.assert_array_equal(vals, np.arange(12.0, 20.0))
+    assert 12.0 <= s.percentile(50) <= 19.0
+    # EMA folds online over ALL samples, not just the retained window
+    ema = None
+    for v in range(20):
+        ema = v if ema is None else 0.5 * v + 0.5 * ema
+    assert s.ema == pytest.approx(ema)
+
+
+# ---------------------------------------------------------------------------
+# the static cost model
+
+
+def test_cost_model_dot_general_exact():
+    import jax.numpy as jnp
+
+    from pulsar_timing_gibbsspec_tpu.analysis.jaxprcheck.cost import cost_of
+
+    a = jnp.zeros((2, 16, 5))
+    b = jnp.zeros((2, 16, 7))
+
+    def f(a, b):
+        return jnp.einsum("pnb,pnc->pbc", a, b)
+
+    rep = cost_of(f, (a, b))
+    # 2 * batch(2) * m(5) * n(7) * k(16)
+    assert rep.dot_flops == 2 * 2 * 5 * 7 * 16
+    assert rep.flops >= rep.dot_flops
+
+
+def test_cost_model_scan_multiplies_by_length():
+    import jax
+    import jax.numpy as jnp
+
+    from pulsar_timing_gibbsspec_tpu.analysis.jaxprcheck.cost import cost_of
+
+    w = jnp.zeros((4, 4))
+
+    def body_fn(c, _):
+        return w @ c, None
+
+    def scanned(c):
+        out, _ = jax.lax.scan(body_fn, c, None, length=10)
+        return out
+
+    def once(c):
+        return w @ c
+
+    rep_scan = cost_of(scanned, (jnp.zeros((4,)),))
+    rep_once = cost_of(once, (jnp.zeros((4,)),))
+    assert rep_scan.dot_flops == 10 * rep_once.dot_flops
+
+
+def test_cost_model_cholesky_rule():
+    import jax.numpy as jnp
+
+    from pulsar_timing_gibbsspec_tpu.analysis.jaxprcheck.cost import cost_of
+
+    n = 12
+    a = jnp.eye(n)
+
+    def f(a):
+        return jnp.linalg.cholesky(a)
+
+    rep = cost_of(f, (a,))
+    assert rep.flops >= n ** 3 / 3.0
+    assert rep.hbm_bytes > 0
+
+
+def test_cost_model_matches_flop_counts_on_crn_gram():
+    """The roofline acceptance bound: static model within 5% of the
+    analytic FLOP count on the CRN Gram einsum."""
+    import jax.numpy as jnp
+
+    from pulsar_timing_gibbsspec_tpu.analysis.jaxprcheck.cost import cost_of
+    from pulsar_timing_gibbsspec_tpu.analysis.jaxprcheck.entries import (
+        build_model, synthetic_pulsars)
+    from pulsar_timing_gibbsspec_tpu.profiling import flop_counts
+    from pulsar_timing_gibbsspec_tpu.sampler.compiled import compile_pta
+
+    cm = compile_pta(build_model(synthetic_pulsars(3, 40, tm_cols=3), 3))
+    x0 = jnp.zeros((cm.nx,), cm.cdtype)
+
+    def gram(x):
+        N = cm.ndiag_fast(x)
+        TN = cm.T / N[:, :, None]
+        return jnp.einsum("pnb,pnc->pbc", TN, cm.T,
+                          preferred_element_type=cm.dtype,
+                          precision="highest")
+
+    rep = cost_of(gram, (x0,))
+    want = flop_counts(cm)["gram_einsum"]
+    assert want > 0
+    assert abs(rep.dot_flops - want) <= 0.05 * want
+
+
+def test_roofline_classification_and_mfu():
+    from pulsar_timing_gibbsspec_tpu.profiling import roofline
+
+    costs = {
+        "fat_matmul": {"flops": 4.0e12, "hbm_bytes": 1.0e9},
+        "streamer": {"flops": 1.0e9, "hbm_bytes": 1.0e9},
+    }
+    roof = roofline(costs, per_block_ms={"fat_matmul": 100.0},
+                    peak_flops=1.0e14, peak_bw=1.0e12)
+    assert roof["ridge_flop_per_byte"] == pytest.approx(100.0)
+    blocks = roof["blocks"]
+    assert blocks["fat_matmul"]["bound"] == "compute"
+    assert blocks["streamer"]["bound"] == "bandwidth"
+    # MFU: 4e12 flops in 0.1 s on a 1e14 peak = 0.4
+    assert blocks["fat_matmul"]["mfu"] == pytest.approx(0.4, rel=1e-6)
+    # no measured time for streamer: mfu/ms absent, static fields stay
+    assert "mfu" not in blocks["streamer"]
+    assert blocks["streamer"]["intensity"] == pytest.approx(1.0)
+
+
+# ---------------------------------------------------------------------------
+# streaming stage telemetry
+
+
+def test_stage_aggregator_folds_spans_to_gauges():
+    telemetry.reset("dispatch_ms")
+    agg = StageAggregator(job="tj").install()
+    try:
+        # observers activate the span seams even with tracing disabled
+        with otrace.span("chunk.dispatch"):
+            pass
+        with otrace.span("chunk.writeback"):
+            pass
+        with otrace.span("not.a.stage"):
+            pass
+    finally:
+        agg.uninstall()
+    summ = agg.summary()
+    assert set(summ) == {"enqueue", "writeback"}
+    assert summ["enqueue"]["n"] == 1
+    g = telemetry.get_gauge("dispatch_ms", job="tj", stage="enqueue",
+                            stat="last")
+    assert g is not None and g >= 0.0
+    body = metrics.render_telemetry()
+    assert 'ptgibbs_dispatch_ms{job="tj",stage="enqueue",stat="ema"}' in body
+    telemetry.reset("dispatch_ms")
+    # uninstalled: spans are the shared nullcontext again (zero cost)
+    assert otrace.span("chunk.dispatch") is otrace.span("chunk.d2h")
+
+
+def test_stage_aggregator_band_breach_triggers():
+    class FakeRecorder:
+        reasons = []
+
+        def install(self):
+            return self
+
+        def uninstall(self):
+            pass
+
+        def trigger(self, reason):
+            self.reasons.append(reason)
+
+    telemetry.reset("stage_band_breaches")
+    rec = FakeRecorder()
+    agg = StageAggregator(job="tb", band_k=3.0, warm_n=4, recorder=rec)
+    for _ in range(6):
+        agg.observe("device", 10.0)
+    assert telemetry.get("stage_band_breaches", stage="device",
+                         job="tb") == 0
+    agg.observe("device", 100.0)          # 10x the EMA: breach
+    assert telemetry.get("stage_band_breaches", stage="device",
+                         job="tb") == 1
+    assert rec.reasons == ["band_breach:device"]
+    telemetry.reset("stage_band_breaches")
+    telemetry.reset("dispatch_ms")
+
+
+# ---------------------------------------------------------------------------
+# anomaly capture
+
+
+def _fake_xla_trace(profile_dir, name="plugin.trace.json.gz"):
+    d = Path(profile_dir) / "plugins" / "profile" / "run1"
+    d.mkdir(parents=True, exist_ok=True)
+    doc = {"traceEvents": [{"ph": "X", "name": "fusion.1", "ts": 0,
+                            "dur": 5, "pid": 1, "tid": 1}]}
+    with gzip.open(d / name, "wt") as fh:
+        json.dump(doc, fh)
+
+
+def test_flight_recorder_capture_and_budget(tmp_path):
+    telemetry.reset("anomaly_captures")
+    rec = FlightRecorder(tmp_path, window_chunks=2, max_captures=1,
+                         profiler=False).install()
+    try:
+        assert not rec._armed
+        otrace.instant("watchdog.soft", ema=1.0)      # the trigger
+        assert rec._armed
+        _fake_xla_trace(rec._profile_dir())
+        with otrace.span("chunk.dispatch"):
+            pass
+        with otrace.span("chunk.dispatch"):
+            pass                                       # window closes
+        assert not rec._armed
+        assert len(rec.captures) == 1
+        doc = json.loads(Path(rec.captures[0]).read_text())
+        names = [e.get("name") for e in doc["traceEvents"]]
+        assert "fusion.1" in names                     # XLA side
+        assert "chunk.dispatch" in names               # span side
+        assert doc["metadata"]["reason"] == "watchdog.soft"
+        # capture budget spent: further triggers are refused
+        assert rec.trigger("again") is False
+    finally:
+        rec.uninstall()
+    assert telemetry.get("anomaly_captures") == 1
+    telemetry.reset("anomaly_captures")
+
+
+def test_merge_perfetto_tolerates_missing_profile_dir(tmp_path):
+    out = tmp_path / "m.trace.json"
+    merge_perfetto(tmp_path / "nope", out,
+                   extra_events=[{"ph": "i", "name": "solo", "ts": 1}],
+                   meta={"reason": "t"})
+    doc = json.loads(out.read_text())
+    assert [e["name"] for e in doc["traceEvents"]] == ["solo"]
+
+
+# ---------------------------------------------------------------------------
+# the perf ledger
+
+
+def _rec(value, metric="m", kind="bench", dev="cpu", backend="cpu",
+         **extra):
+    r = {"schema": 1, "kind": kind, "metric": metric, "value": value,
+         "device_kind": dev, "backend": backend, "source": "t"}
+    r.update(extra)
+    return r
+
+
+def test_ledger_record_roundtrip(tmp_path):
+    headline = {
+        "metric": "gibbs_samples_per_sec_45psr_pta", "value": 3998.0,
+        "unit": "samples/s", "device_kind": "TPU v5 lite",
+        "backend": "tpu", "sweeps_per_sec": 62.5, "nchains": 64,
+        "ess_per_sec": 88.7,
+        "roofline": {"blocks": {"gram32": {"gflops": 1.0, "mfu": 0.31,
+                                           "intensity": 120.0,
+                                           "bound": "compute"}}},
+        "resilience": {"jaxprcheck": {"contracts": {"crn_cost": "ab12"}}},
+        "raw": [1, 2, 3],                 # heavy field: must not land
+    }
+    rec = make_ledger_record(headline, source="test", run="r1", ts=5.0)
+    assert rec["schema"] == 1
+    assert rec["ts"] == 5.0
+    assert rec["value"] == 3998.0
+    assert "raw" not in rec
+    # roofline condensed to attribution-only fields
+    assert rec["roofline"]["gram32"] == {"mfu": 0.31, "intensity": 120.0,
+                                         "bound": "compute"}
+    assert rec["contract_hashes"] == {"crn_cost": "ab12"}
+    path = tmp_path / "L.jsonl"
+    ledger_append(rec, path)
+    ledger_append(rec, path)
+    path.open("a").write("{torn json\n")
+    got = ledger_read(path)
+    assert len(got) == 2                  # corrupt line skipped
+    assert got[0] == got[1] == {k: v for k, v in rec.items()}
+
+
+def test_check_ledger_within_band_passes():
+    recs = [_rec(100.0), _rec(90.0)]      # -10% < 35% band
+    assert check_ledger(recs) == []
+
+
+def test_check_ledger_regression_fails():
+    recs = [_rec(100.0, sweeps_per_sec=50.0),
+            _rec(10.0, sweeps_per_sec=5.0)]
+    problems = check_ledger(recs)
+    assert len(problems) == 2             # value AND sweeps_per_sec
+    assert any("value" in p for p in problems)
+
+
+def test_check_ledger_tolerates_new_metrics_and_groups():
+    recs = [_rec(100.0),
+            _rec(1.0, metric="brand_new"),        # new group: no prior
+            _rec(95.0, ess_per_sec=7.0)]          # new field: no prior
+    assert check_ledger(recs) == []
+    # different backend = different group: a CPU run never gates vs TPU
+    recs = [_rec(4000.0, backend="tpu"), _rec(60.0, backend="cpu")]
+    assert check_ledger(recs) == []
+
+
+def test_check_ledger_multichip_only_newest_gates():
+    ok = {"schema": 1, "kind": "multichip", "run": "r3", "ok": True}
+    bad = {"schema": 1, "kind": "multichip", "run": "r1", "ok": False}
+    assert check_ledger([bad, ok]) == []          # history tolerated
+    problems = check_ledger([ok, dict(bad, run="r9")])
+    assert len(problems) == 1 and "r9" in problems[0]
+
+
+def test_check_ledger_band_override():
+    recs = [_rec(100.0), _rec(80.0)]
+    assert check_ledger(recs, {"value": 0.1}) != []
+    assert check_ledger(recs, {"value": 0.25}) == []
+    assert set(DEFAULT_BANDS) >= {"value", "sweeps_per_sec",
+                                  "ess_per_sec"}
+
+
+# ---------------------------------------------------------------------------
+# perfwatch CLI
+
+
+def test_perfwatch_check_cli(tmp_path):
+    pw = _load_perfwatch()
+    path = tmp_path / "L.jsonl"
+    ledger_append(_rec(100.0), path)
+    ledger_append(_rec(95.0), path)
+    assert pw.main(["--check", "--ledger", str(path),
+                    "--no-selfcheck"]) == 0
+    ledger_append(_rec(5.0), path)                # injected regression
+    assert pw.main(["--check", "--ledger", str(path),
+                    "--no-selfcheck"]) == 1
+    assert pw.main(["--report", "--ledger", str(path)]) == 0
+    # an absent ledger is a failure, not a silent pass
+    assert pw.main(["--check", "--ledger", str(tmp_path / "no.jsonl"),
+                    "--no-selfcheck"]) == 1
+
+
+def test_perfwatch_backfill_refuses_clobber(tmp_path):
+    pw = _load_perfwatch()
+    path = tmp_path / "L.jsonl"
+    path.write_text("{}\n")
+    assert pw.backfill(path, force=False) == 1
+    assert path.read_text() == "{}\n"             # untouched
+
+
+@pytest.mark.lint
+def test_perfwatch_gate_on_repo_ledger():
+    """The ci_lint layer: HEAD's committed ledger + the live cost-model
+    self-check must pass (CPU tracing only, no device execution)."""
+    pw = _load_perfwatch()
+    assert pw.main(["--check"]) == 0
+
+
+# ---------------------------------------------------------------------------
+# Prometheus rendering of the new gauges
+
+
+def test_prometheus_nonfinite_gauge_spellings():
+    body = metrics.render(
+        gauges={"a": float("nan"), "b": float("inf"),
+                "c": float("-inf")}, prefix="t")
+    lines = body.splitlines()
+    assert "t_a NaN" in lines
+    assert "t_b +Inf" in lines
+    assert "t_c -Inf" in lines
+    for ln in lines:
+        assert " nan" not in ln and " inf" not in ln
+
+
+def test_prometheus_label_escaping_roundtrip():
+    telemetry.reset("tperf_")
+    telemetry.gauge("tperf_g", 1.0, path='a"b\\c')
+    body = metrics.render_telemetry()
+    assert 'path="a\\"b\\\\c"' in body
+    telemetry.reset("tperf_")
+
+
+def test_sweep_flops_matches_flop_counts_terms():
+    from pulsar_timing_gibbsspec_tpu.analysis.jaxprcheck.entries import (
+        build_model, synthetic_pulsars)
+    from pulsar_timing_gibbsspec_tpu.profiling import (flop_counts,
+                                                       sweep_flops)
+    from pulsar_timing_gibbsspec_tpu.sampler.compiled import compile_pta
+
+    cm = compile_pta(build_model(synthetic_pulsars(2, 24, tm_cols=3), 2))
+    fc = flop_counts(cm, nchains=3)
+    fl = sweep_flops(cm, nchains=3)
+    assert fl["tnt_einsum"] == fc["gram_einsum"] + fc["basis_matvec"]
+    assert fl["cholesky"] == fc["cholesky"] + fc["tri_solves"]
+    assert fl["total"] == fl["tnt_einsum"] + fl["cholesky"]
+    assert all(v > 0 for v in fc.values())
+    assert math.isfinite(fl["total"])

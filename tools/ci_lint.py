@@ -9,7 +9,10 @@ status — the same set the ``lint`` pytest marker covers:
                  against ``jaxlint_baseline.json``;
 3. jaxprcheck  — jaxpr/HLO contract audit of the fast (CPU-traceable)
                  contracts in ``contracts/``, ratcheted against
-                 ``jaxprcheck_baseline.json``.
+                 ``jaxprcheck_baseline.json``;
+4. perfwatch   — the perf-ledger regression gate over
+                 ``PERF_LEDGER.jsonl`` plus the static cost-model
+                 self-check (CPU tracing only, no device execution).
 
 Each layer runs in its own interpreter (jaxprcheck must configure the
 JAX platform before jax is first imported), so a crash in one cannot
@@ -40,6 +43,9 @@ def main(argv=None) -> int:
                    [sys.executable, "-m",
                     "pulsar_timing_gibbsspec_tpu.analysis.jaxprcheck",
                     "--fast", *extra]))
+    layers.append(("perfwatch",
+                   [sys.executable,
+                    os.path.join("tools", "perfwatch.py"), "--check"]))
 
     failed = []
     for name, cmd in layers:

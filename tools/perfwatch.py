@@ -1,0 +1,307 @@
+"""perfwatch: the performance-trajectory gate over ``PERF_LEDGER.jsonl``.
+
+The ledger is append-only — one JSON line per bench run (``bench.py``
+appends automatically; schema in docs/OBSERVABILITY.md).  This tool
+makes the trajectory machine-checked the way jaxlint/jaxprcheck make
+style and contracts machine-checked:
+
+``--check`` (the ci_lint layer; no device execution)
+    1. Ledger gate: within each (kind, metric, device_kind, backend)
+       group the newest record's rates must sit inside explicit noise
+       bands of the best prior record (``obs.perf.check_ledger``) — a
+       committed regression fails the gate; new metrics/groups pass.
+    2. Static cost-model self-check: trace the CRN Gram einsum on the
+       CPU backend and require the jaxpr-derived ``dot_general`` FLOPs
+       to match ``profiling.flop_counts`` within 5% — the roofline
+       attribution's ground-truth tie, exercised on HEAD's code.
+
+``--backfill``
+    Rebuild the initial ledger from the committed ``BENCH_r*.json`` /
+    ``MULTICHIP_r*.json`` snapshots (refuses to clobber an existing
+    ledger without ``--force``).
+
+``--report``
+    Human-readable trajectory table per metric group.
+
+Usage::
+
+    python tools/perfwatch.py --check [--ledger PATH] [--band f=0.35]
+    python tools/perfwatch.py --backfill [--force]
+    python tools/perfwatch.py --report
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+from pathlib import Path
+
+_REPO_ROOT = Path(__file__).resolve().parents[1]
+if str(_REPO_ROOT) not in sys.path:        # direct script execution
+    sys.path.insert(0, str(_REPO_ROOT))
+
+
+def _bootstrap_cpu():
+    """Pin the CPU backend before jax first imports — the gate must
+    never touch a device."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+
+# ---------------------------------------------------------------------------
+# backfill: committed snapshots -> initial ledger
+
+#: fields restored from adjacent context where a snapshot's own JSON was
+#: truncated (BENCH_r05 committed only the tail of its headline line;
+#: the device is the same v5e host as r04 — noted on the record)
+_BACKFILL_OVERRIDES = {
+    "BENCH_r05": {"device_kind": "TPU v5 lite",
+                  "note": "device_kind restored from the r04 context "
+                          "(same session/host); r05 JSON is tail-only"},
+}
+
+_TAIL_FLOAT = {
+    "ess_per_sec": r'"ess_per_sec":\s*([0-9.eE+-]+)',
+    "rho_act_median": r'"rho_act_median":\s*([0-9.eE+-]+)',
+    "record_every": r'"record_every":\s*([0-9]+)',
+}
+_TAIL_RATE = re.compile(
+    r"#\s*jax:\s*([0-9.]+)\s*sweeps/s\s*x\s*([0-9]+)\s*chains")
+_TAIL_TS = re.compile(r"(\d{4}-\d{2}-\d{2} \d{2}:\d{2}:\d{2})")
+
+
+def _parse_bench_snapshot(path: Path) -> dict | None:
+    """A ledger record from one committed BENCH_rNN.json wrapper
+    (``{"n", "cmd", "rc", "tail", "parsed"}``) — ``parsed`` carries the
+    headline dict when the capture was complete, else the tail text is
+    mined for what it still holds."""
+    from pulsar_timing_gibbsspec_tpu.obs import perf
+
+    doc = json.loads(path.read_text())
+    run = path.stem
+    tail = doc.get("tail") or ""
+    headline = dict(doc.get("parsed") or {})
+    note = None
+    if not headline:
+        # tail-only snapshot: top-level headline keys appear verbatim
+        # in the truncated JSON text; the stderr gate line has the rate
+        for k, pat in _TAIL_FLOAT.items():
+            m = re.search(pat, tail)
+            if m:
+                headline[k] = float(m.group(1))
+        m = _TAIL_RATE.search(tail)
+        if m:
+            sweeps, nchains = float(m.group(1)), int(m.group(2))
+            headline["sweeps_per_sec"] = sweeps
+            headline["nchains"] = nchains
+            headline["metric"] = "gibbs_samples_per_sec_45psr_pta"
+            headline["value"] = sweeps * nchains
+            headline["unit"] = "samples/s"
+        note = "backfilled from tail text (truncated snapshot)"
+    if not headline.get("metric"):
+        return None
+    over = _BACKFILL_OVERRIDES.get(run, {})
+    headline.update({k: v for k, v in over.items() if k != "note"})
+    note = over.get("note", note)
+    ts = None
+    m = _TAIL_TS.search(tail)
+    if m:
+        import datetime as dt
+
+        ts = dt.datetime.strptime(
+            m.group(1), "%Y-%m-%d %H:%M:%S").timestamp()
+    return perf.make_ledger_record(headline, source=path.name, run=run,
+                                   ts=ts, note=note)
+
+
+def _parse_multichip_snapshot(path: Path) -> dict | None:
+    from pulsar_timing_gibbsspec_tpu.obs import perf
+
+    doc = json.loads(path.read_text())
+    rec = {"schema": perf.LEDGER_SCHEMA, "kind": "multichip",
+           "source": path.name, "run": path.stem, "ts": None,
+           "ok": bool(doc.get("ok")),
+           "n_devices": doc.get("n_devices")}
+    if doc.get("skipped"):
+        rec["skipped"] = True
+    if doc.get("mesh_axes"):
+        rec["mesh_axes"] = doc["mesh_axes"]
+    scaling = doc.get("scaling")
+    if scaling:
+        rec["scaling"] = scaling
+    if doc.get("collectives_evidence"):
+        rec["collectives_evidence"] = doc["collectives_evidence"]
+    return rec
+
+
+def backfill(ledger: Path, force: bool = False) -> int:
+    from pulsar_timing_gibbsspec_tpu.obs import perf
+
+    if ledger.exists() and not force:
+        print(f"perfwatch: {ledger} exists; --force to rebuild",
+              file=sys.stderr)
+        return 1
+    records = []
+    for p in sorted(_REPO_ROOT.glob("BENCH_r*.json")):
+        try:
+            rec = _parse_bench_snapshot(p)
+        except Exception as e:      # noqa: BLE001 — skip torn snapshots
+            print(f"perfwatch: skipping {p.name}: {e}", file=sys.stderr)
+            continue
+        if rec:
+            records.append(rec)
+        else:
+            print(f"perfwatch: {p.name} has no headline; skipped")
+    for p in sorted(_REPO_ROOT.glob("MULTICHIP_r*.json")):
+        try:
+            rec = _parse_multichip_snapshot(p)
+        except Exception as e:      # noqa: BLE001
+            print(f"perfwatch: skipping {p.name}: {e}", file=sys.stderr)
+            continue
+        if rec:
+            records.append(rec)
+    ledger.write_text(
+        "".join(json.dumps(r, sort_keys=True) + "\n" for r in records))
+    print(f"perfwatch: wrote {len(records)} record(s) to {ledger}")
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# the gate
+
+
+def _cost_selfcheck(tol: float = 0.05) -> list[str]:
+    """Trace the CRN Gram einsum (tiny synthetic model, CPU backend,
+    nothing executes) and compare the jaxpr-derived dot FLOPs with the
+    analytic ``profiling.flop_counts`` terms."""
+    _bootstrap_cpu()
+    import jax.numpy as jnp
+
+    from pulsar_timing_gibbsspec_tpu.analysis.jaxprcheck.cost import cost_of
+    from pulsar_timing_gibbsspec_tpu.analysis.jaxprcheck.entries import (
+        build_model, synthetic_pulsars)
+    from pulsar_timing_gibbsspec_tpu.profiling import flop_counts
+    from pulsar_timing_gibbsspec_tpu.sampler.compiled import compile_pta
+
+    cm = compile_pta(build_model(synthetic_pulsars(3, 40, tm_cols=3), 3))
+    x0 = jnp.zeros((cm.nx,), cm.cdtype)
+
+    def gram(x):
+        N = cm.ndiag_fast(x)
+        TN = cm.T / N[:, :, None]
+        return jnp.einsum("pnb,pnc->pbc", TN, cm.T,
+                          preferred_element_type=cm.dtype,
+                          precision="highest")
+
+    rep = cost_of(gram, (x0,))
+    want = flop_counts(cm)["gram_einsum"]
+    problems = []
+    if want <= 0:
+        problems.append("flop_counts returned a non-positive gram term")
+    elif abs(rep.dot_flops - want) > tol * want:
+        problems.append(
+            f"static cost model drifted from flop_counts on the CRN "
+            f"gram einsum: modeled {rep.dot_flops:.6g} dot-FLOPs vs "
+            f"analytic {want:.6g} (tolerance {tol:.0%})")
+    return problems
+
+
+def check(ledger: Path, bands: dict | None = None,
+          skip_selfcheck: bool = False) -> int:
+    from pulsar_timing_gibbsspec_tpu.obs import perf
+
+    if not ledger.exists():
+        print(f"perfwatch: no ledger at {ledger} — run "
+              "`python tools/perfwatch.py --backfill` first",
+              file=sys.stderr)
+        return 1
+    records = perf.ledger_read(ledger)
+    if not records:
+        print(f"perfwatch: ledger {ledger} holds no records",
+              file=sys.stderr)
+        return 1
+    problems = perf.check_ledger(records, bands)
+    if not skip_selfcheck:
+        problems += _cost_selfcheck()
+    if problems:
+        for p in problems:
+            print(f"perfwatch: REGRESSION: {p}", file=sys.stderr)
+        print(f"perfwatch: FAILED ({len(problems)} problem(s) over "
+              f"{len(records)} record(s))", file=sys.stderr)
+        return 1
+    print(f"perfwatch: OK ({len(records)} record(s), "
+          f"{'ledger only' if skip_selfcheck else 'ledger + cost model'})")
+    return 0
+
+
+def report(ledger: Path) -> int:
+    from pulsar_timing_gibbsspec_tpu.obs import perf
+
+    records = perf.ledger_read(ledger)
+    groups: dict = {}
+    for rec in records:
+        if rec.get("kind") == "multichip":
+            key = ("multichip", None, None, None)
+        else:
+            key = (rec.get("kind"), rec.get("metric"),
+                   rec.get("device_kind"), rec.get("backend"))
+        groups.setdefault(key, []).append(rec)
+    for key, recs in sorted(groups.items(), key=lambda kv: str(kv[0])):
+        kind, metric, dev, backend = key
+        head = metric or kind
+        print(f"{head}  [{dev or '?'} / {backend or '?'}]")
+        for r in recs:
+            if kind == "multichip":
+                print(f"  {r.get('run'):>10s}  ok={r.get('ok')}  "
+                      f"ndev={r.get('n_devices')}")
+                continue
+            bits = [f"value={r['value']:.4g}" if "value" in r else ""]
+            for f in ("sweeps_per_sec", "ess_per_sec", "mfu"):
+                if f in r:
+                    bits.append(f"{f}={r[f]:.4g}")
+            sha = r.get("git_sha", "")
+            print(f"  {r.get('run') or r.get('source', '?'):>10s}  "
+                  f"{'  '.join(b for b in bits if b)}  {sha}")
+    print(f"perfwatch: {len(records)} record(s), {len(groups)} group(s)")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="perfwatch",
+        description="perf-ledger regression gate (static; no device)")
+    ap.add_argument("--check", action="store_true")
+    ap.add_argument("--backfill", action="store_true")
+    ap.add_argument("--report", action="store_true")
+    ap.add_argument("--force", action="store_true",
+                    help="allow --backfill to overwrite the ledger")
+    ap.add_argument("--ledger", default=None, metavar="PATH")
+    ap.add_argument("--band", action="append", default=[],
+                    metavar="FIELD=FRAC",
+                    help="override a noise band, e.g. ess_per_sec=0.5")
+    ap.add_argument("--no-selfcheck", action="store_true",
+                    help="--check without the jax cost-model self-check")
+    args = ap.parse_args(argv)
+
+    ledger = Path(args.ledger) if args.ledger else (
+        _REPO_ROOT / "PERF_LEDGER.jsonl")
+    bands = {}
+    for spec in args.band:
+        field, _, frac = spec.partition("=")
+        bands[field] = float(frac)
+
+    if args.backfill:
+        return backfill(ledger, force=args.force)
+    if args.report:
+        return report(ledger)
+    if args.check:
+        return check(ledger, bands or None,
+                     skip_selfcheck=args.no_selfcheck)
+    ap.print_help()
+    return 2
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
