@@ -15,6 +15,11 @@ Entry kinds (the ``entry`` field of a contract):
 - ``chunk`` — a full compiled sweep chunk through the driver
   (:func:`..sampler.jax_backend.sweep_chunk_entry`): key lineage,
   dtype islands, donation.
+- ``megachunk`` — the device-resident mega-chunk steady dispatch
+  (:func:`..sampler.jax_backend.megachunk_sweep_chunk_entry`): the
+  ``chunk`` program scanned ``megachunk`` sub-chunks deep, carries
+  donated end-to-end, key-fold policy and dtype census pinned
+  identical to the legacy chunk (``crn_megachunk``).
 - ``sharded_step`` — one CRN sweep step under pulsar-axis sharding on
   a host-device mesh (mirrors the MULTICHIP dry-run): the C2 census
   target.
@@ -95,6 +100,25 @@ def _chunk_entry(spec):
     pta = build_model(psrs, spec.get("nmodes", 3))
     fn, args, drv = jb.sweep_chunk_entry(
         pta, spec.get("nchains", 4), chunk=spec.get("chunk", 2),
+        pad_pulsars=spec.get("pad_pulsars"), seed=spec.get("seed", 0))
+    return fn, args, {"driver": drv}
+
+
+def _megachunk_entry(spec):
+    """The mega-chunk steady dispatch: the ``chunk`` entry's program
+    scanned ``megachunk`` sub-chunks deep in one jitted function.  The
+    contract (``crn_megachunk``) pins the end-to-end carry donation, the
+    unchanged per-sweep key-fold policy (the static half of the bitwise
+    grid-independence proof) and the slab-bounded output surface."""
+    from ...sampler import jax_backend as jb
+
+    psrs = synthetic_pulsars(spec.get("n_psr", 3), spec.get("ntoa", 40),
+                             tm_cols=spec.get("tm_cols", 3),
+                             seed=spec.get("seed", 0))
+    pta = build_model(psrs, spec.get("nmodes", 3))
+    fn, args, drv = jb.megachunk_sweep_chunk_entry(
+        pta, spec.get("nchains", 4), chunk=spec.get("chunk", 2),
+        megachunk=spec.get("megachunk", 3),
         pad_pulsars=spec.get("pad_pulsars"), seed=spec.get("seed", 0))
     return fn, args, {"driver": drv}
 
@@ -267,6 +291,7 @@ def _ensemble_chunk_entry(spec):
 
 
 _ENTRIES = {"gram": _gram_entry, "chunk": _chunk_entry,
+            "megachunk": _megachunk_entry,
             "obs_chunk": _obs_chunk_entry,
             "sharded_step": _sharded_step_entry,
             "sharded_2d": _sharded_2d_entry,

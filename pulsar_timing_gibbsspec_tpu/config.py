@@ -89,6 +89,18 @@ class Settings:
     #: regardless of this flag (the breakdown-margin contract).
     joint_mixed: bool = os.environ.get("PTGIBBS_JOINT_MIXED", "1") != "0"
 
+    #: mega-chunk factor of the steady loop (sampler/jax_backend): one
+    #: device dispatch scans this many chunk_size sub-chunks back to
+    #: back, with the carry donated end-to-end — host work per dispatch
+    #: becomes a single enqueue, amortizing the ~100 ms dispatch tax
+    #: over megachunk*chunk_size sweeps.  The sampled process is
+    #: bitwise-identical for every value (per-sweep keys are pure in the
+    #: absolute iteration index); 1 (the default) is the legacy
+    #: one-chunk-per-dispatch loop.  Models with a red-hyper MH block
+    #: are bounded by the DE history delay: (2*megachunk - 1) *
+    #: chunk_size <= DE_DELAY - DE_Q (see docs/PERFORMANCE.md).
+    megachunk: int = int(os.environ.get("PTGIBBS_MEGACHUNK", "1"))
+
     #: persistent XLA compilation cache (first 45-pulsar compile costs
     #: minutes through the remote-compile tunnel; cached reruns are free).
     #: Empty string disables.

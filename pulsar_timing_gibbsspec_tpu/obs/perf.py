@@ -100,6 +100,12 @@ class RingSeries:
 #: the wait for device results — the same reading as
 #: ``profiling.dispatch_breakdown``.  ``chunk.compile_dispatch`` is
 #: deliberately absent: a compile wall is not a steady-state stage.
+#: ``chunk.carry_sync`` (the mega-chunk loop's host snapshot of the
+#: donated carry) is also unmapped — it is a sync point inside the
+#: dispatch pipeline, visible in the Perfetto timeline, not a stage of
+#: its own.  A synthetic ``dispatch_amortized`` stage (enqueue ms /
+#: sweeps-per-dispatch, from the span's ``n=`` attr) is derived in
+#: :meth:`StageAggregator._on_event`.
 SPAN_STAGES = {
     "chunk.host_prep": "host_prep",
     "chunk.dispatch": "enqueue",
@@ -157,7 +163,17 @@ class StageAggregator:
         stage = SPAN_STAGES.get(ev.get("name"))
         if stage is None:
             return
-        self.observe(stage, ev["dur"] / 1e3)
+        ms = ev["dur"] / 1e3
+        self.observe(stage, ms)
+        if stage == "enqueue":
+            # the dispatch span carries the sweeps it covers (run() tags
+            # ``n=``): fold the AMORTIZED per-sweep dispatch cost as its
+            # own stage so ``dispatch_ms{stage="dispatch_amortized"}``
+            # streams live next to the raw stage walls — the metric the
+            # mega-chunk loop exists to drive under 1 ms/sweep
+            n = (ev.get("args") or {}).get("n")
+            if n:
+                self.observe("dispatch_amortized", ms / int(n))
 
     # -- the fold
 
@@ -349,6 +365,7 @@ _HEADLINE_FIELDS = (
     "metric", "value", "unit", "vs_baseline", "device_kind", "backend",
     "sweeps_per_sec", "nchains", "mfu", "ess_per_sec",
     "ess_per_sec_device", "rho_act_median", "mesh_axes", "n_retraces",
+    "dispatch_amortized_ms_per_sweep",
     "dispatch_breakdown_ms", "stage_summary",
 )
 
@@ -428,16 +445,26 @@ def ledger_read(path=None) -> list[dict]:
 
 # -- the regression gate
 
-#: rate metrics where bigger is better, with their default noise bands
-#: (allowed fractional drop of HEAD vs the best prior record in the
-#: same group).  Wide on purpose: bench numbers span hosts and load;
-#: the gate exists to catch step regressions, not jitter.
+#: gated metrics with their default noise bands.  For rate fields
+#: (bigger is better) the band is the allowed fractional DROP of HEAD
+#: vs the best (highest) prior record in the same group; for the cost
+#: fields in :data:`LOWER_IS_BETTER` it is the allowed fractional
+#: GROWTH over the best (lowest) prior.  Wide on purpose: bench numbers
+#: span hosts and load; the gate exists to catch step regressions, not
+#: jitter.
 DEFAULT_BANDS = {
     "value": 0.35,
     "sweeps_per_sec": 0.35,
     "ess_per_sec": 0.40,
     "ess_per_sec_device": 0.40,
+    "dispatch_amortized_ms_per_sweep": 0.50,
 }
+
+#: fields where SMALLER is better — the dispatch-tax headline the
+#: mega-chunk loop drives down; the gate bounds growth above the best
+#: prior instead of a drop below it (a ``--band`` override changes the
+#: width only, never the direction)
+LOWER_IS_BETTER = frozenset({"dispatch_amortized_ms_per_sweep"})
 
 
 def _group_key(rec: dict) -> tuple:
@@ -452,9 +479,11 @@ def check_ledger(records: list[dict], bands: dict | None = None) -> list:
 
     Within each (kind, metric, device_kind, backend) group the newest
     record's rate fields must not fall more than the band fraction
-    below the best prior value.  New metrics/groups/fields (no prior)
-    pass; ``multichip`` records must carry ``ok: true``.  Returns a
-    list of problem strings — empty means the gate passes."""
+    below the best prior value; :data:`LOWER_IS_BETTER` fields
+    (dispatch tax) must not GROW more than the band above the best
+    (lowest) prior.  New metrics/groups/fields (no prior) pass;
+    ``multichip`` records must carry ``ok: true``.  Returns a list of
+    problem strings — empty means the gate passes."""
     bands = {**DEFAULT_BANDS, **(bands or {})}
     problems: list = []
     groups: dict = {}
@@ -488,6 +517,16 @@ def check_ledger(records: list[dict], bands: dict | None = None) -> list:
                     and math.isfinite(r[field])]
             if not prev:
                 continue                  # new field: tolerated
+            if field in LOWER_IS_BETTER:
+                best = min(prev)
+                ceiling = (1.0 + band) * best
+                if new_v > ceiling:
+                    problems.append(
+                        f"{key[1]} [{key[2]}/{key[3]}] {field}: newest "
+                        f"{new_v:.4g} grew past noise band "
+                        f"(best prior {best:.4g}, ceiling "
+                        f"{ceiling:.4g}, band {band:.0%})")
+                continue
             best = max(prev)
             floor = (1.0 - band) * best
             if new_v < floor:

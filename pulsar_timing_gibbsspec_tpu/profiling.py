@@ -422,6 +422,13 @@ def dispatch_breakdown(driver, x):
     bare per-call jit overhead (a scalar no-op); this says where a real
     chunk's wall actually goes.  The stages also emit ``profile.*``
     trace spans when the obs trace layer is enabled.
+
+    A ``megachunk > 1`` driver is probed through its mega dispatch
+    (``_mega_fn``) so the stages describe the program production runs;
+    ``sweeps_per_dispatch`` and ``dispatch_amortized_per_sweep``
+    ((host_prep + enqueue + writeback) / sweeps) report how far the
+    dispatch tax is amortized — the bench headline
+    ``dispatch_amortized_ms_per_sweep`` is read from here.
     """
     import jax
     import jax.numpy as jnp
@@ -432,17 +439,30 @@ def dispatch_breakdown(driver, x):
     x = np.asarray(x, np.float64)
     if x.ndim == 1:
         x = np.tile(x, (driver.C, 1))
-    xd = jnp.asarray(x, cm.cdtype)
-    bd = jnp.asarray(driver.b)
     n = driver.chunk_size
-    fn = driver._chunk_fn(n, 0)
+    n_sub = max(1, int(getattr(driver, "megachunk", 1)))
+    M = n * n_sub
+    if n_sub > 1:
+        fn = driver._mega_fn(n, n_sub, 0)
+    else:
+        fn = driver._chunk_fn(n, 0)
     obs_on = driver.obs is not None
 
     def staged():
+        # fresh carry copies per call: the mega dispatch DONATES its
+        # carries, so a reused buffer would be dead on the second probe
+        xd = jnp.asarray(x, cm.cdtype)
+        # copy=True even when driver.b already lives on device: asarray
+        # would alias the driver's live buffer and the donation above
+        # would delete it out from under the run (and the next repeat)
+        bd = jnp.array(driver.b, copy=True)
+        jax.block_until_ready((xd, bd))
         t0 = time.perf_counter()
         with otrace.span("profile.host_prep"):
+            aux = (driver._aux_mega(None, 0, n_sub) if n_sub > 1
+                   else driver._aux())
             args = (xd, bd, driver.key, jax.device_put(np.int32(0)),
-                    driver._aux(), jax.device_put(np.int32(n)))
+                    aux, jax.device_put(np.int32(M)))
             if obs_on:
                 args = args + (driver._obs_state,)
         t1 = time.perf_counter()
@@ -461,14 +481,22 @@ def dispatch_breakdown(driver, x):
     staged()              # warm: the chunk fn may still need compiling
     hp, eq, dv, wb = staged()
     out = {"host_prep": hp * 1e3, "enqueue": eq * 1e3,
-           "device": dv * 1e3, "writeback": wb * 1e3}
+           "device": dv * 1e3, "writeback": wb * 1e3,
+           "sweeps_per_dispatch": float(M),
+           # the headline this probe exists for: every ms the host spends
+           # around the device wait, amortized over the sweeps one
+           # dispatch covers
+           "dispatch_amortized_per_sweep": (hp + eq + wb) * 1e3 / M}
     # the one-shot probe publishes the same dispatch_ms family the
     # streaming StageAggregator feeds, tagged stat="probe" so the scrape
     # distinguishes a staged measurement from live EMA/percentiles
     from .runtime import telemetry
 
-    for stage, ms in out.items():
-        telemetry.gauge("dispatch_ms", ms, stage=stage, stat="probe")
+    for stage in ("host_prep", "enqueue", "device", "writeback"):
+        telemetry.gauge("dispatch_ms", out[stage], stage=stage,
+                        stat="probe")
+    telemetry.gauge("dispatch_ms", out["dispatch_amortized_per_sweep"],
+                    stage="dispatch_amortized", stat="probe")
     return out
 
 
@@ -521,8 +549,16 @@ def format_report(report: dict, flops: dict | None = None,
     lines.append(f"  {'dispatch':<20s} {report['dispatch_ms']:8.2f} ms")
     bd = report.get("dispatch_breakdown_ms")
     if bd:
-        parts = " + ".join(f"{k} {v:.1f}" for k, v in bd.items())
+        parts = " + ".join(
+            f"{k} {bd[k]:.1f}"
+            for k in ("host_prep", "enqueue", "device", "writeback")
+            if k in bd)
         lines.append(f"  chunk stages: {parts} ms")
+        if "dispatch_amortized_per_sweep" in bd:
+            lines.append(
+                f"  {'dispatch/sweep':<20s} "
+                f"{bd['dispatch_amortized_per_sweep']:8.3f} ms  "
+                f"({bd.get('sweeps_per_dispatch', 1):.0f} sweeps/dispatch)")
     roof = report.get("roofline")
     if roof:
         lines.append(

@@ -86,23 +86,29 @@ class DispatchWatchdog:
 
     # -- deadline model ------------------------------------------------------
 
-    def observe(self, dt) -> None:
-        """Feed one steady-chunk wall time (seconds).  Callers must skip
-        walls that include a fresh compile — they would poison the EMA
-        the way one outlier poisons any small-alpha smoother."""
-        dt = float(dt)
-        self.ema = dt if self.ema is None else (
-            self.ema_alpha * dt + (1.0 - self.ema_alpha) * self.ema)
+    def observe(self, dt, n=1) -> None:
+        """Feed one steady-chunk wall time (seconds) covering ``n``
+        sweeps: the EMA is kept PER SWEEP, so mega-chunk runs (one
+        dispatch spanning many sub-chunks) and legacy runs share one
+        deadline model and a chunk-geometry change between resumes
+        cannot mis-scale the guard.  ``n=1`` (the default) keeps the
+        historical per-dispatch semantics.  Callers must skip walls that
+        include a fresh compile — they would poison the EMA the way one
+        outlier poisons any small-alpha smoother."""
+        per = float(dt) / max(int(n), 1)
+        self.ema = per if self.ema is None else (
+            self.ema_alpha * per + (1.0 - self.ema_alpha) * self.ema)
         # the live deadline model, scrapeable next to the dispatch_ms
         # stage gauges (perfwatch's stall-margin view)
         telemetry.gauge("watchdog_ema_s", self.ema)
-        telemetry.gauge("watchdog_deadline_s", self.deadline())
+        telemetry.gauge("watchdog_deadline_s", self.deadline(n))
 
-    def deadline(self) -> float:
-        """Current hard deadline (seconds) for one guarded call."""
+    def deadline(self, n=1) -> float:
+        """Current hard deadline (seconds) for one guarded call covering
+        ``n`` sweeps (the per-sweep EMA scaled back up)."""
         if self.ema is None:
             return self.first_floor_s
-        return max(self.floor_s, self.k * self.ema)
+        return max(self.floor_s, self.k * self.ema * max(int(n), 1))
 
     # -- guarded execution ---------------------------------------------------
 
@@ -141,16 +147,17 @@ class DispatchWatchdog:
             except Exception:
                 pass              # observability must not kill the run
 
-    def call(self, fn, what="dispatch"):
-        """Run ``fn()`` under the deadline; returns its result or
-        re-raises its exception.  Raises :class:`DispatchStall` (and
-        abandons the call) when the hard deadline passes."""
+    def call(self, fn, what="dispatch", n=1):
+        """Run ``fn()`` under the deadline for ``n`` sweeps of work;
+        returns its result or re-raises its exception.  Raises
+        :class:`DispatchStall` (and abandons the call) when the hard
+        deadline passes."""
         self._ensure_worker()
         box = self._inbox
         box["fn"], box["out"], box["exc"] = fn, None, None
         box["done"].clear()
         box["go"].set()
-        hard = self.deadline()
+        hard = self.deadline(n)
         soft = self.soft_frac * hard
         t0 = time.monotonic()
         warned = False

@@ -93,7 +93,7 @@ class _GibbsBase:
                       "chunk_size", "pad_pulsars", "mesh", "warmup_sweeps",
                       "warmup_white_steps", "white_steps_max",
                       "exact_every", "transfer_guard", "joint_mixed",
-                      "watchdog", "ensemble", "pt_ladder"):
+                      "watchdog", "ensemble", "pt_ladder", "megachunk"):
                 opts.pop(k, None)
         return type(self)(self.pta, hypersample=c["hypersample"],
                           ecorrsample=c["ecorrsample"],

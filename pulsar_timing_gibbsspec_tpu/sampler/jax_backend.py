@@ -1991,6 +1991,31 @@ def _logpi_b_per(cm: CompiledPTA, x, b, u, beta=None):
             + jnp.sum(t2.astype(cm.cdtype), axis=1))
 
 
+def _logpi_b_pair(cm: CompiledPTA, x, b_old, b_new, u_old, u_new,
+                  beta=None):
+    """Both sides of the MH log-density ratio in one fused pass: stacks
+    (old, new) on a leading axis so ``N``, ``phi`` and the masked
+    reductions are computed once and the elementwise work runs as one
+    batched kernel instead of two :func:`_logpi_b_per` calls.  Same
+    error class (f32 elementwise, f64 accumulation).  Returns
+    ``(lpi_old, lpi_new)``, each ``(P,)`` in the compute dtype."""
+    import jax.numpy as jnp
+
+    fdt = cm.dtype
+    N = cm.ndiag_fast(x)
+    uu = jnp.stack([u_old, u_new])
+    t1 = ((-0.5 * uu + jnp.asarray(cm.y, cm.dtype)) * (uu / N)
+          * jnp.asarray(cm.toa_mask, fdt))
+    if beta is not None:
+        t1 = t1 * beta.astype(fdt)
+    phi32 = cm.phi(x, dtype=fdt)
+    bb = jnp.stack([b_old, b_new]).astype(fdt)
+    t2 = -0.5 * bb * bb / phi32
+    lp = (jnp.sum(t1.astype(cm.cdtype), axis=2)
+          + jnp.sum(t2.astype(cm.cdtype), axis=2))
+    return lp[0], lp[1]
+
+
 def draw_b_mh(cm: CompiledPTA, x, b, u, key, beta=None):
     """Metropolised b-draw: propose from the f32-factored conditional,
     accept per pulsar with the exact Hastings ratio.
@@ -2011,7 +2036,7 @@ def draw_b_mh(cm: CompiledPTA, x, b, u, key, beta=None):
     import jax.numpy as jnp
     import jax.random as jr
 
-    from ..ops.linalg import jacobi_factor_mean
+    from ..ops.linalg import jacobi_factor_mean_prop
 
     fdt = cm.dtype
     k1, k2 = jr.split(key)
@@ -2037,16 +2062,16 @@ def draw_b_mh(cm: CompiledPTA, x, b, u, key, beta=None):
     # small-slice loops on TPU and cost 12.6 ms at the (64, 45, 37, 37)
     # bench shape vs 2.1 ms for blocked_chol_inv + matvecs
     # (tools/chol_probe.py) — 75% of the whole steady sweep was this
-    # lowering (tools/sweep_probe.py: b_mh 13.5 ms of full_sweep 17.9)
-    L, Li, dj, mean = jacobi_factor_mean(Sig, d, ridge=_PROP_RIDGE)
+    # lowering (tools/sweep_probe.py: b_mh 13.5 ms of full_sweep 17.9);
+    # the _prop variant fuses the mean and sample-square-root matvecs
+    # into one 2-column batched matmul
     z = jr.normal(k1, (cm.P, cm.Bmax), fdt)
-    bp32 = mean + dj * jnp.einsum("pji,pj->pi", Li, z,
-                                  precision="highest")
+    L, Li, dj, mean, bp32 = jacobi_factor_mean_prop(Sig, d, z,
+                                                    ridge=_PROP_RIDGE)
     bp = bp32.astype(cm.cdtype)
     up = b_matvec(cm, bp)
     # ---- exact log-density ratio + proposal correction --------------------
-    lpi_new = _logpi_b_per(cm, x, bp, up, beta=beta)
-    lpi_old = _logpi_b_per(cm, x, b, u, beta=beta)
+    lpi_old, lpi_new = _logpi_b_pair(cm, x, b, bp, u, up, beta=beta)
     # logq(v) = -0.5 || L^T ((v - mean)/dj) ||^2 (+ const that cancels);
     # for the fresh proposal that quadratic form is exactly ||z||^2 —
     # which is why w_old needs full-f32 precision: it enters the ratio
@@ -2088,7 +2113,7 @@ def draw_b_refresh(cm: CompiledPTA, x, b, u, key, beta=None):
     import jax.numpy as jnp
     import jax.random as jr
 
-    from ..ops.linalg import (_batched_diag, jacobi_factor_mean,
+    from ..ops.linalg import (_batched_diag, jacobi_factor_mean_prop,
                               tf_chol_factor)
 
     cdt = cm.cdtype
@@ -2102,14 +2127,13 @@ def draw_b_refresh(cm: CompiledPTA, x, b, u, key, beta=None):
     Sig = TNT + _batched_diag(1.0 / phi)
     # tf_chol_factor applies _PROP_RIDGE to its f32 stage only and
     # removes the distortion in the two-float correction — so the ridge
-    # rides the factor, not the helper
-    L, Li, dj, mean = jacobi_factor_mean(
-        Sig, d, factor=lambda A: tf_chol_factor(A, ridge=_PROP_RIDGE))
+    # rides the factor, not the helper; the _prop variant fuses the mean
+    # and sample-square-root matvecs into one 2-column batched matmul
     z = jr.normal(k1, (cm.P, cm.Bmax), cdt)
-    bp = mean + dj * jnp.einsum("...ji,...j->...i", Li, z)
+    L, Li, dj, mean, bp = jacobi_factor_mean_prop(
+        Sig, d, z, factor=lambda A: tf_chol_factor(A, ridge=_PROP_RIDGE))
     up = b_matvec(cm, bp)
-    lpi_new = _logpi_b_per(cm, x, bp, up, beta=beta)
-    lpi_old = _logpi_b_per(cm, x, b, u, beta=beta)
+    lpi_old, lpi_new = _logpi_b_pair(cm, x, b, bp, u, up, beta=beta)
     w_old = jnp.einsum("pji,pj->pi", L, (b - mean) / dj)
     logq_old = -0.5 * jnp.sum(w_old * w_old, axis=1)
     logq_new = -0.5 * jnp.sum(z * z, axis=1)
@@ -2158,7 +2182,7 @@ class JaxGibbsDriver:
                  exact_every=EXACT_EVERY, record_precision=None,
                  record_every=1, transfer_guard=False, sentinels=True,
                  joint_mixed=None, watchdog=None, obs=None,
-                 ensemble=None, pt_ladder=None):
+                 ensemble=None, pt_ladder=None, megachunk=None):
         settings.apply()
         import jax
         import jax.random as jr
@@ -2198,6 +2222,16 @@ class JaxGibbsDriver:
         self.red_adapt_iters = red_adapt_iters
         self.red_steps = red_steps
         self.chunk_size = chunk_size or settings.chunk_size
+        #: mega-chunk factor: sub-chunks scanned back to back inside ONE
+        #: device dispatch (the device-resident steady loop).  The outer
+        #: scan re-selects the DE history buffers per sub-chunk, so each
+        #: sub sees exactly the history the legacy chunk grid would —
+        #: the sampled process is bitwise-identical for every value.
+        #: 1 (default) is the legacy one-chunk-per-dispatch loop.
+        self.megachunk = int(settings.megachunk if megachunk is None
+                             else megachunk)
+        if self.megachunk < 1:
+            raise ValueError("megachunk must be >= 1")
         #: dtype of the recorded per-sweep states shipped device->host.
         #: "f32" (default) records in the storage dtype; "bf16" halves the
         #: dominant device-to-host payload again for bandwidth-starved
@@ -2323,6 +2357,21 @@ class JaxGibbsDriver:
             raise ValueError(
                 f"chunk_size={self.chunk_size} exceeds the DE history "
                 f"delay margin ({DE_DELAY - DE_Q}); use chunk_size <= "
+                f"{DE_DELAY - DE_Q} for models with a red hyper MH block")
+        if (self.do_red_mh and self.megachunk > 1
+                and (2 * self.megachunk - 1) * self.chunk_size
+                > DE_DELAY - DE_Q):
+            # the mega dispatch stages every sub-chunk's DE buffers up
+            # front, while the PREVIOUS mega's rows are still in flight
+            # (double buffering) — so the last sub-chunk's history must
+            # predate the previous dispatch's first iteration too:
+            # (2*n_sub - 1)*chunk_size <= DE_DELAY - DE_Q.  A silent
+            # seed-freeze fallback would make the sampled process depend
+            # on the mega grid, breaking bitwise grid-independence
+            raise ValueError(
+                f"megachunk={self.megachunk} x chunk_size="
+                f"{self.chunk_size} outruns the DE history delay margin: "
+                f"(2*megachunk - 1)*chunk_size must be <= "
                 f"{DE_DELAY - DE_Q} for models with a red hyper MH block")
         # sampled ORF weights (bin_orf / legendre_orf): MH block on the
         # coefficient-conditional correlated likelihood
@@ -2709,6 +2758,30 @@ class JaxGibbsDriver:
             *de,
         )
 
+    def _aux_mega(self, chain, ii, n_sub):
+        """:meth:`_aux` for a mega dispatch: the shared adaptation
+        entries plus the DE history triples of EVERY sub-chunk stacked
+        on a leading ``n_sub`` axis — the outer scan selects sub ``j``'s
+        triple by index, so each sub sees exactly the buffers its legacy
+        dispatch would have staged.  The stacked buffers reuse the
+        memoized per-period device arrays (:meth:`_de_bufs`); the ctor's
+        mega DE guard guarantees every period's chain rows are already
+        written when this stages."""
+        import jax.numpy as jnp
+
+        base = self._aux()[:8] if self.red_hist is None \
+            else self._aux(chain, ii)[:8]
+        if self.red_hist is None:
+            return base + (None, None, None)
+        has, hbs, sws = [], [], []
+        for j in range(n_sub):
+            m0 = (ii + j * self.chunk_size) // DE_Q
+            hp, hn = self._de_bufs(chain, m0)
+            has.append(hp)
+            hbs.append(hn)
+            sws.append(jnp.full((self.C,), (m0 + 1) * DE_Q, jnp.int32))
+        return base + (jnp.stack(has), jnp.stack(hbs), jnp.stack(sws))
+
     def _sweep_body(self, bdraw="mh"):
         """One post-adaptation Gibbs sweep (reference order,
         ``pulsar_gibbs.py:656-698``) as a single-chain body
@@ -2907,9 +2980,10 @@ class JaxGibbsDriver:
 
         return body
 
-    def _make_chunk(self, body, n, rec_off=0, obs=False, ensemble=False):
-        """Jitted scan of ``n`` sweeps, the single-chain ``body`` vmapped
-        over the chains axis.
+    def _sub_core(self, body, n, rec_off=0, ensemble=False):
+        """Un-jitted core of one ``n``-sweep scan, shared by the legacy
+        chunk program (:meth:`_make_chunk`) and the mega-chunk outer scan
+        (:meth:`_make_megachunk`).
 
         Per-sweep, per-chain keys are
         ``fold_in(fold_in(base_key, iteration), chain)`` so the random
@@ -2930,7 +3004,13 @@ class JaxGibbsDriver:
         recorded samples carry f32-storage statistical content anyway.
         The sweep *carry* stays full precision: ``n_keep`` dynamically
         indexes the f64 pre-cast stack so resume/tail states never see
-        the rounding."""
+        the rounding.
+
+        Returns ``_core(x, b, base_key, it0, aux, n_keep[, ens_state])``
+        whose trailing outputs — the full pre-thinning f64 stack and the
+        FINAL scan carry — exist only for the obs/mega wrappers; the
+        plain chunk drops them and jit DCE restores the exact legacy
+        program (contracts/crn_quick.json stays byte-identical)."""
         import jax
         import jax.numpy as jnp
         import jax.random as jr
@@ -3065,10 +3145,20 @@ class JaxGibbsDriver:
             health = chunk_health(xs_rec, bs_rec)
             if ens is not None:
                 return (x_end, b_end, xs_rec.astype(self.rdtype), bs_flat,
-                        health, es_sel, xs)
+                        health, es_sel, xs, x, b, es_end)
             return (x_end, b_end, xs_rec.astype(self.rdtype), bs_flat,
-                    health, xs)
+                    health, xs, x, b)
 
+        return _core
+
+    def _make_chunk(self, body, n, rec_off=0, obs=False, ensemble=False):
+        """Jitted scan of ``n`` sweeps, the single-chain ``body`` vmapped
+        over the chains axis (:meth:`_sub_core` holds the core program
+        and the PRNG/record/thinning contracts)."""
+        import jax
+
+        _core = self._sub_core(body, n, rec_off, ensemble=ensemble)
+        ens = self._ens if ensemble else None
         # the full f64 stack ``xs`` is an extra _core output only so the
         # instrumented variant can fold it into the sketch; the plain
         # variant drops it, and jit DCE restores the exact pre-obs
@@ -3105,6 +3195,140 @@ class JaxGibbsDriver:
 
         return jax.jit(run_chunk_obs)
 
+    def _make_megachunk(self, body, n, n_sub, rec_off=0, obs=False,
+                        ensemble=False):
+        """The device-resident steady loop: ONE jitted dispatch scanning
+        ``n_sub`` sub-chunks of ``n`` sweeps back to back, with the chunk
+        carry donated end-to-end.
+
+        Equivalence to the legacy chunk grid is exact and bitwise: the
+        outer scan body calls the same :meth:`_sub_core` program per
+        sub-chunk (per-sweep keys are pure in the absolute iteration, the
+        matvec ``u = T b`` is recomputed at each sub entry, and the obs
+        sketch folds each sub's entry state + full stack exactly as a
+        dispatch-per-chunk run would).  The per-sub DE history buffers
+        ride the aux pytree with a leading ``n_sub`` axis and are
+        re-selected inside the scan, so every sub sees the history its
+        legacy twin would (the ctor bounds ``(2*n_sub - 1)*chunk_size``
+        by the DE delay margin).
+
+        The record stacks come back as the legacy concatenation —
+        ``record_every | chunk_size`` makes every sub ship exactly
+        ``n // record_every`` rows on the shared residue, so the
+        ``(n_sub, r, ...)`` scan stack reshapes to the ``(n_sub*r, ...)``
+        slab a legacy grid would emit row for row.  Health reductions
+        combine across subs (finite AND, move_frac mean).
+
+        ``n_keep`` is the mega-wide keep point: each sub selects with
+        ``clip(n_keep - j*n, 0, n)`` and the kept carry is where-updated
+        for subs whose start precedes the keep point — identical values
+        to the legacy trailing-chunk selection.
+
+        Donation: the carries (x, b[, ens_state][, sketch]) alias their
+        outputs, so a resident steady phase holds one generation of
+        carry instead of two; ``run()`` host-snapshots the pending
+        writeback's carry leaves before the next dispatch
+        (contracts/crn_megachunk.json pins the aliasing surface)."""
+        import jax
+        import jax.numpy as jnp
+
+        core = self._sub_core(body, n, rec_off, ensemble=ensemble)
+        ens = self._ens if ensemble else None
+        obs_on = bool(obs)
+        if obs_on:
+            from ..obs import sketch as obs_sketch
+            spec = self.obs
+
+        def mega(x, b, base_key, it0, aux, n_keep, ens_state=None,
+                 sk=None):
+            shared, de = aux[:8], aux[8:]
+            has_de = de[0] is not None
+
+            def outer(carry, j):
+                if ens is not None:
+                    x, b, es, keep, sk_c = carry
+                else:
+                    x, b, keep, sk_c = carry
+                    es = None
+                if has_de:
+                    aux_j = shared + tuple(
+                        jax.lax.dynamic_index_in_dim(a, j, keepdims=False)
+                        for a in de)
+                else:
+                    aux_j = shared + (None, None, None)
+                sub_keep = jnp.clip(n_keep - j * n, 0, n)
+                out = core(x, b, base_key, it0 + j * n, aux_j, sub_keep,
+                           es)
+                if ens is not None:
+                    (x_sel, b_sel, xs_rec, bs_flat, health, es_sel,
+                     xs_full, x_fin, b_fin, es_fin) = out
+                    sel = (x_sel, b_sel, es_sel)
+                else:
+                    (x_sel, b_sel, xs_rec, bs_flat, health, xs_full,
+                     x_fin, b_fin) = out
+                    sel = (x_sel, b_sel)
+                if obs_on:
+                    # per-sub sketch fold off the SUB entry state — the
+                    # same update stream a dispatch-per-chunk run feeds
+                    sk_c = obs_sketch.update(spec, sk_c, x, xs_full)
+                # keep-carry update: live for every sub whose start is at
+                # or before the keep point; j=0 always overwrites the
+                # placeholder init, and at an exact sub boundary both the
+                # previous sub's final carry and this sub's row-0 select
+                # hold the identical value
+                live = j * n <= n_keep
+                keep = jax.tree_util.tree_map(
+                    lambda a, kb: jnp.where(live, a, kb), sel, keep)
+                ys = (xs_rec, bs_flat, health)
+                if ens is not None:
+                    return (x_fin, b_fin, es_fin, keep, sk_c), ys
+                return (x_fin, b_fin, keep, sk_c), ys
+
+            keep0 = ((x, b, ens_state) if ens is not None else (x, b))
+            carry0 = ((x, b, ens_state, keep0, sk) if ens is not None
+                      else (x, b, keep0, sk))
+            carry_end, (xs_s, bs_s, health_s) = jax.lax.scan(
+                outer, carry0, jnp.arange(n_sub, dtype=jnp.int32))
+            if ens is not None:
+                _, _, _, keep, sk_end = carry_end
+                x_keep, b_keep, es_keep = keep
+            else:
+                _, _, keep, sk_end = carry_end
+                x_keep, b_keep = keep
+            xs_all = xs_s.reshape((-1,) + xs_s.shape[2:])
+            bs_all = bs_s.reshape((-1,) + bs_s.shape[2:])
+            health = {"finite": jnp.all(health_s["finite"], axis=0),
+                      "move_frac": jnp.mean(health_s["move_frac"],
+                                            axis=0)}
+            outs = (x_keep, b_keep, xs_all, bs_all, health)
+            if ens is not None:
+                outs = outs + (es_keep,)
+            if obs_on:
+                outs = outs + (sk_end,)
+            return outs
+
+        # positional wrappers matching the legacy chunk signatures run()
+        # stages, with the carries donated (the legacy jits donate
+        # nothing — their outputs stay live in the pending writeback)
+        if ens is not None and obs_on:
+            def run_mega(x, b, base_key, it0, aux, n_keep, ens_state, sk):
+                return mega(x, b, base_key, it0, aux, n_keep, ens_state,
+                            sk)
+            donate = (0, 1, 6, 7)
+        elif ens is not None:
+            def run_mega(x, b, base_key, it0, aux, n_keep, ens_state):
+                return mega(x, b, base_key, it0, aux, n_keep, ens_state)
+            donate = (0, 1, 6)
+        elif obs_on:
+            def run_mega(x, b, base_key, it0, aux, n_keep, sk):
+                return mega(x, b, base_key, it0, aux, n_keep, None, sk)
+            donate = (0, 1, 6)
+        else:
+            def run_mega(x, b, base_key, it0, aux, n_keep):
+                return mega(x, b, base_key, it0, aux, n_keep)
+            donate = (0, 1)
+        return jax.jit(run_mega, donate_argnums=donate)
+
     def _warmup_chunk_fn(self, n):
         if ("warmup", n) not in self._sweep_fns:
             self._sweep_fns[("warmup", n)] = self._make_chunk(
@@ -3128,6 +3352,19 @@ class JaxGibbsDriver:
                 bodies, n, rec_off, obs=self.obs is not None,
                 ensemble=self._ens is not None)
         return self._sweep_fns[(n, rec_off)]
+
+    def _mega_fn(self, n, n_sub, rec_off=0):
+        key = ("mega", n, n_sub, rec_off)
+        if key not in self._sweep_fns:
+            if self.cm.has_ke:
+                bodies = self._sweep_body("exact")
+            else:
+                bodies = (self._sweep_body("mh"),
+                          self._sweep_body("exact"))
+            self._sweep_fns[key] = self._make_megachunk(
+                bodies, n, n_sub, rec_off, obs=self.obs is not None,
+                ensemble=self._ens is not None)
+        return self._sweep_fns[key]
 
     # ---- facade protocol ----------------------------------------------------
 
@@ -3214,6 +3451,21 @@ class JaxGibbsDriver:
         from ..parallel.sharding import shard_carry
 
         return shard_carry(self._mesh, tree, self.C)
+
+    def _host_carry(self, pending):
+        """The pending-writeback tuple with its carry leaves converted to
+        host arrays (mega-chunk mode): the next dispatch DONATES the
+        device buffers these leaves alias, so they must be read out
+        before it is enqueued.  The record slabs (xs, bs) are outputs
+        only — never donated — and stay on device for the overlapped
+        d2h/writeback path."""
+        (row, m, xs, bs, x_end, b_end, it_end, health, sk, es) = pending
+        tm = self._jax.tree_util.tree_map
+        return (row, m, xs, bs,
+                np.asarray(x_end), np.asarray(b_end), it_end,
+                tm(np.asarray, health),
+                None if sk is None else tm(np.asarray, sk),
+                None if es is None else tm(np.asarray, es))
 
     def run(self, x, chain, bchain, start, niter):
         import jax.numpy as jnp
@@ -3372,6 +3624,12 @@ class JaxGibbsDriver:
 
         it_base = self._it_base(niter)
         wd = self.watchdog
+        # mega-chunk mode: one dispatch covers n_sub sub-chunks (M
+        # sweeps); the watchdog deadline and EMA normalize per sweep so
+        # the guard tolerates the longer dispatch without going blind
+        n_sub = max(1, int(getattr(self, "megachunk", 1)))
+        M = self.chunk_size * n_sub
+        mega_on = n_sub > 1
         # steady-chunk wall EMA, kept even without a watchdog: it is the
         # drain path's estimate of what landing the in-flight chunk costs
         wall_ema = None
@@ -3381,7 +3639,7 @@ class JaxGibbsDriver:
                 # is up; the fate of the chunk already in flight is
                 # decided below against the deadline
                 break
-            n = min(self.chunk_size, niter - ii)
+            n = min(M, niter - ii)
             # always run the full compiled chunk length: a trailing
             # odd-length chunk would trigger a fresh ~30 s XLA compile for
             # one tail.  Because per-sweep keys are fold_in(base, iteration)
@@ -3399,16 +3657,30 @@ class JaxGibbsDriver:
             # compile at first execution — its wall must not feed the
             # watchdog EMA (first_floor_s covers cold compiles)
             n_fns = len(self._sweep_fns)
-            fn = self._chunk_fn(self.chunk_size, off)
+            fn = (self._mega_fn(self.chunk_size, n_sub, off) if mega_on
+                  else self._chunk_fn(self.chunk_size, off))
             fresh_compile = len(self._sweep_fns) != n_fns
+            if mega_on and pending is not None:
+                # the mega program donates its carry: enqueueing the
+                # next dispatch invalidates the in-flight outputs the
+                # pending writeback still needs.  Snapshot the SMALL
+                # carry leaves to host first — this blocks on the
+                # previous mega's device compute (an explicit sync point
+                # the legacy loop pays at writeback anyway), while the
+                # big record slabs still convert after the dispatch, so
+                # D2H + ChainStore writeback keep overlapping compute
+                with otrace.span("chunk.carry_sync", it0=ii):
+                    pending = self._host_carry(pending)
             # stage every argument BEFORE the dispatch with explicit
             # device_put (jnp.asarray of a Python scalar is an IMPLICIT
             # transfer and would trip the guard); the dispatch itself is
             # then transfer-free under transfer_guard("disallow")
             with otrace.span("chunk.host_prep", it0=ii):
                 dput = self._jax.device_put
+                aux_dev = (self._aux_mega(chain, ii, n_sub) if mega_on
+                           else self._aux(chain, ii))
                 args = (x, b_dev, self.key, dput(np.int32(ii)),
-                        self._place_carry(self._aux(chain, ii)),
+                        self._place_carry(aux_dev),
                         dput(np.int32(n)))
                 if ens_on:
                     args = args + (es_dev,)
@@ -3435,7 +3707,7 @@ class JaxGibbsDriver:
                     "chunk.compile_dispatch" if fresh_compile
                     else "chunk.dispatch", it0=ii, n=n):
                 if wd is not None:
-                    outs = wd.call(_go, what=f"chunk@{ii}")
+                    outs = wd.call(_go, what=f"chunk@{ii}", n=M)
                 else:
                     outs = _go()
             x, b_dev, xs, bs, health = outs[:5]
@@ -3466,7 +3738,7 @@ class JaxGibbsDriver:
                 # it runs under the same watchdog deadline
                 if wd is not None:
                     yield wd.call(lambda p=pending: _writeback(*p),
-                                  what=f"writeback@{pending[0]}")
+                                  what=f"writeback@{pending[0]}", n=M)
                 else:
                     yield _writeback(*pending)
             dt = time.monotonic() - t0
@@ -3478,7 +3750,7 @@ class JaxGibbsDriver:
                 telemetry.gauge("chunk_wall_ms", dt * 1e3)
                 telemetry.gauge("chunk_wall_ema_ms", wall_ema * 1e3)
                 if wd is not None:
-                    wd.observe(dt)
+                    wd.observe(dt, n=M)
             pending = (rowc, m, xs, bs, x, b_dev, ii + n, health,
                        self._obs_state if obs_on else None,
                        es_dev if ens_on else None)
@@ -3801,6 +4073,55 @@ def sweep_chunk_entry(pta, nchains, *, chunk=2, pad_pulsars=None, seed=0):
         jnp.asarray(0, jnp.int32),
         drv._aux(),
         jnp.asarray(chunk, jnp.int32),
+    )
+    return fn, args, drv
+
+
+def megachunk_sweep_chunk_entry(pta, nchains, *, chunk=2, megachunk=3,
+                                pad_pulsars=None, seed=0):
+    """The device-resident mega-chunk steady dispatch —
+    :func:`sweep_chunk_entry`'s program scanned ``megachunk`` sub-chunks
+    deep in ONE jitted function (``contracts/crn_megachunk.json``).
+
+    The contract pins what makes the mega dispatch safe to amortize
+    over: the (x, b) carries donated end-to-end through the outer scan,
+    the per-sweep key-fold policy unchanged from the legacy chunk (keys
+    are pure in the absolute iteration — the bitwise grid-independence
+    proof's static half), and the output surface bounded by the thinned
+    record slab (``megachunk`` times the legacy chunk's rows, nothing
+    else grows)."""
+    import jax
+    import jax.numpy as jnp
+    import jax.random as jr
+
+    n_sub = int(megachunk)
+    drv = JaxGibbsDriver(pta, nchains=int(nchains), seed=seed,
+                         pad_pulsars=pad_pulsars, chunk_size=int(chunk),
+                         megachunk=n_sub)
+    cm = drv.cm
+    C = drv.C
+    if len(cm.idx.white):
+        W = int(np.asarray(cm.white_par_ix).shape[1])
+        eye = np.tile(np.eye(W, dtype=np.float64), (C, cm.P, 1, 1))
+        drv.aclength_white = 2
+        drv.chol_white = eye
+        drv.asqrt_white = eye.copy()
+        drv.mode_white = np.zeros((C, cm.P, W), np.float64)
+    if len(cm.idx.ecorr) and (cm.ec_cols.shape[1] or cm.has_ke):
+        E = int(np.asarray(cm.ecorr_par_ix).shape[1])
+        eye = np.tile(np.eye(E, dtype=np.float64), (C, cm.P, 1, 1))
+        drv.aclength_ecorr = 2
+        drv.chol_ecorr = eye
+        drv.asqrt_ecorr = eye.copy()
+        drv.mode_ecorr = np.zeros((C, cm.P, E), np.float64)
+    fn = drv._mega_fn(int(chunk), n_sub, 0)
+    args = (
+        jax.ShapeDtypeStruct((C, cm.nx), cm.cdtype),
+        jax.ShapeDtypeStruct((C, cm.P, cm.Bmax), cm.cdtype),
+        jax.ShapeDtypeStruct((), jr.key(0).dtype),
+        jnp.asarray(0, jnp.int32),
+        drv._aux_mega(None, None, n_sub),
+        jnp.asarray(chunk * n_sub, jnp.int32),
     )
     return fn, args, drv
 

@@ -1,0 +1,371 @@
+"""Mega-chunk steady loop acceptance (ISSUE 12, docs/PERFORMANCE.md).
+
+The device-resident mega-chunk dispatch (``megachunk`` sub-chunks scanned
+inside ONE jitted function) must be a pure execution-grid change: every
+recorded row, carry and health datum bitwise-identical to the legacy
+one-chunk-per-dispatch loop, across resume seams, chunk-geometry changes,
+thinning, the DE jump-history window and mid-run kills on a 2-d mesh.
+The amortization itself is covered by the ``dispatch_amortized`` probe /
+gauge / ledger plumbing tested at the bottom.
+"""
+
+import importlib.util
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from pulsar_timing_gibbsspec_tpu.analysis.jaxprcheck.entries import (
+    build_model, synthetic_pulsars)
+from pulsar_timing_gibbsspec_tpu.sampler import jax_backend as jb
+from pulsar_timing_gibbsspec_tpu.sampler.gibbs import (PTABlockGibbs,
+                                                       PulsarBlockGibbs)
+
+_REPO = Path(__file__).resolve().parents[1]
+
+# one compile-friendly geometry shared by the facade cases: small CRN
+# free-spectrum model, 2 vmapped chains, warmup well clear of the seams
+KW = dict(backend="jax", seed=9, progress=False, white_adapt_iters=20,
+          chunk_size=10, nchains=2, warmup_sweeps=5)
+NITER = 64
+
+
+def _load_bench():
+    spec = importlib.util.spec_from_file_location(
+        "bench", _REPO / "bench.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def tiny_pta():
+    psrs = synthetic_pulsars(3, 40, tm_cols=3, seed=0)
+    return build_model(psrs, 3)
+
+
+@pytest.fixture(scope="module")
+def x0(tiny_pta):
+    return tiny_pta.initial_sample(np.random.default_rng(5))
+
+
+@pytest.fixture(scope="module")
+def legacy64(tiny_pta, x0, tmp_path_factory):
+    """The uninterrupted legacy-grid run every mega case must equal."""
+    out = tmp_path_factory.mktemp("legacy64")
+    return PulsarBlockGibbs(tiny_pta, **KW).sample(
+        x0, outdir=str(out), niter=NITER, save_every=20)
+
+
+# ---------------------------------------------------------------------------
+# dispatch-level identity
+
+
+def test_mega_fn_bitwise_vs_legacy_chunks(tiny_pta):
+    """One mega dispatch (3 sub-chunks of 2 sweeps) vs three legacy
+    dispatches: record slabs, end carries and chunk health must agree —
+    xs/bs/x/b bitwise, ``finite`` AND-reduced, ``move_frac`` averaged.
+    Also the mid-mega truncation: ``n_keep=3`` lands the carry exactly
+    where legacy 2-full-sweeps-plus-``n_keep=1`` lands it."""
+    import jax
+    import jax.numpy as jnp
+
+    fn, args, drv = jb.sweep_chunk_entry(tiny_pta, 4, chunk=2, seed=0)
+    rng = np.random.default_rng(0)
+    x0 = jnp.tile(jnp.asarray(tiny_pta.initial_sample(rng), drv.cm.cdtype),
+                  (drv.C, 1))
+    b0 = jnp.zeros((drv.C, drv.cm.P, drv.cm.Bmax), drv.cm.cdtype)
+    n, n_sub, key = 2, 3, drv.key
+
+    legacy_fn = drv._chunk_fn(n, 0)
+    x, b = x0, b0
+    xs_all, bs_all, healths = [], [], []
+    for j in range(n_sub):
+        out = legacy_fn(x, b, key, jnp.asarray(j * n, jnp.int32),
+                        drv._aux(), jnp.asarray(n, jnp.int32))
+        x, b, xs, bs, health = out[:5]
+        xs_all.append(np.asarray(xs))
+        bs_all.append(np.asarray(bs))
+        healths.append(jax.tree_util.tree_map(np.asarray, health))
+
+    mega_fn = drv._mega_fn(n, n_sub, 0)
+    aux = drv._aux_mega(None, None, n_sub)
+    out = mega_fn(x0, b0, key, jnp.asarray(0, jnp.int32), aux,
+                  jnp.asarray(n * n_sub, jnp.int32))
+    xm, bm, xs_m, bs_m, health_m = out[:5]
+
+    np.testing.assert_array_equal(np.concatenate(xs_all, axis=0),
+                                  np.asarray(xs_m))
+    np.testing.assert_array_equal(np.concatenate(bs_all, axis=0),
+                                  np.asarray(bs_m))
+    np.testing.assert_array_equal(np.asarray(x), np.asarray(xm))
+    np.testing.assert_array_equal(np.asarray(b), np.asarray(bm))
+    hm = jax.tree_util.tree_map(np.asarray, health_m)
+    np.testing.assert_array_equal(
+        hm["finite"], np.all([h["finite"] for h in healths], axis=0))
+    assert np.allclose(hm["move_frac"],
+                       np.mean([h["move_frac"] for h in healths], axis=0),
+                       rtol=1e-6)
+
+    # donation means fresh carries for the truncated replay
+    x0 = jnp.tile(jnp.asarray(
+        tiny_pta.initial_sample(np.random.default_rng(0)), drv.cm.cdtype),
+        (drv.C, 1))
+    b0 = jnp.zeros((drv.C, drv.cm.P, drv.cm.Bmax), drv.cm.cdtype)
+    out2 = mega_fn(x0, b0, key, jnp.asarray(0, jnp.int32), aux,
+                   jnp.asarray(3, jnp.int32))
+    x = jnp.tile(jnp.asarray(
+        tiny_pta.initial_sample(np.random.default_rng(0)), drv.cm.cdtype),
+        (drv.C, 1))
+    b = jnp.zeros((drv.C, drv.cm.P, drv.cm.Bmax), drv.cm.cdtype)
+    ref = legacy_fn(x, b, key, jnp.asarray(0, jnp.int32), drv._aux(),
+                    jnp.asarray(n, jnp.int32))
+    ref = legacy_fn(ref[0], ref[1], key, jnp.asarray(n, jnp.int32),
+                    drv._aux(), jnp.asarray(1, jnp.int32))
+    np.testing.assert_array_equal(np.asarray(out2[0]), np.asarray(ref[0]))
+
+
+# ---------------------------------------------------------------------------
+# run-level identity, resume seams, retrace contract
+
+
+def test_megachunk_run_bitwise_vs_legacy(tiny_pta, x0, legacy64, tmp_path):
+    mega = PulsarBlockGibbs(tiny_pta, megachunk=3, **KW).sample(
+        x0, outdir=str(tmp_path), niter=NITER, save_every=20)
+    assert np.all(np.isfinite(mega))
+    np.testing.assert_array_equal(mega, legacy64)
+
+
+def test_megachunk_resume_chunk_change_no_retrace(tiny_pta, x0, legacy64,
+                                                  tmp_path):
+    """The elastic seam: stop a (chunk 10, mega 3) run at row 40 and
+    resume it as (chunk 8, mega 2).  Per-sweep keys are pure in the
+    absolute iteration, so the chain stays bitwise-identical to the
+    legacy grid; and the driver brackets the new geometry's cache-miss
+    compile as planned, so the steady-phase retrace count stays zero."""
+    from pulsar_timing_gibbsspec_tpu import profiling
+
+    kw = {k: v for k, v in KW.items() if k != "chunk_size"}
+    PulsarBlockGibbs(tiny_pta, chunk_size=10, megachunk=3, **kw).sample(
+        x0, outdir=str(tmp_path), niter=40, save_every=20)
+    with profiling.recompile_counter() as rc:
+        rc.phase("steady")
+        g = PulsarBlockGibbs(tiny_pta, chunk_size=8, megachunk=2, **kw)
+        resumed = g.sample(x0, outdir=str(tmp_path), niter=NITER,
+                           resume=True, save_every=20)
+    assert rc.unplanned("steady") == 0
+    np.testing.assert_array_equal(resumed, legacy64)
+
+
+def test_megachunk_thinned_bitwise(tiny_pta, x0, tmp_path):
+    """record_every thinning rides the mega grid unchanged: the slab is
+    megachunk x the legacy chunk's thinned rows, nothing else."""
+    kw = dict(KW, record_every=4, chunk_size=12)
+    legacy = PulsarBlockGibbs(tiny_pta, **kw).sample(
+        x0, outdir=str(tmp_path / "l"), niter=NITER, save_every=20)
+    mega = PulsarBlockGibbs(tiny_pta, megachunk=2, **kw).sample(
+        x0, outdir=str(tmp_path / "m"), niter=NITER, save_every=20)
+    np.testing.assert_array_equal(mega, legacy)
+
+
+def test_megachunk_de_history_bitwise_and_guard(tmp_path):
+    """The DE jump reads a replay of rows ``DE_DELAY`` behind the head;
+    a mega dispatch advances the head ``megachunk`` chunks per refresh
+    opportunity, so the run must cross several refresh boundaries and
+    stay bitwise — and the ctor must reject geometries whose lookback
+    outruns the history window."""
+    from pulsar_timing_gibbsspec_tpu.models.factory import model_general
+    from pulsar_timing_gibbsspec_tpu.sampler.jax_backend import (
+        DE_DELAY, DE_HIST_LEN, DE_Q)
+
+    psrs = synthetic_pulsars(2, 30, tm_cols=3, seed=1)
+    pta = model_general(psrs, tm_svd=True, red_var=True,
+                        red_psd="powerlaw", red_components=4,
+                        white_vary=False, common_psd="spectrum",
+                        common_components=4)
+    x0 = pta.initial_sample(np.random.default_rng(8))
+    kw = dict(backend="jax", seed=12, progress=False,
+              white_adapt_iters=50, chunk_size=20)
+    niter = DE_DELAY + DE_HIST_LEN + 2 * DE_Q - 60
+    legacy = PulsarBlockGibbs(pta, **kw).sample(
+        x0, outdir=str(tmp_path / "l"), niter=niter, save_every=100)
+    mega = PulsarBlockGibbs(pta, megachunk=3, **kw).sample(
+        x0, outdir=str(tmp_path / "m"), niter=niter, save_every=100)
+    assert np.all(np.isfinite(legacy))
+    np.testing.assert_array_equal(mega, legacy)
+    with pytest.raises(ValueError, match="outruns the DE history"):
+        PulsarBlockGibbs(pta, megachunk=4, **kw)
+
+
+def test_megachunk_chaos_kill_mid_run_2d_bitwise(synth_pta, tmp_path):
+    """The torn-checkpoint kill mid-mega on the 2-d (2, 4) chains x
+    pulsars mesh: the crash lands between the two os.replace calls at a
+    row inside the mega cadence, the supervised retry rolls back to the
+    .bak generation and replays — final chain bitwise-identical to an
+    uninterrupted LEGACY-grid run (identity and recovery in one)."""
+    from pulsar_timing_gibbsspec_tpu.parallel.sharding import make_mesh
+    from pulsar_timing_gibbsspec_tpu.runtime import (faults, preemption,
+                                                     run_supervised,
+                                                     telemetry)
+
+    faults.clear()
+    telemetry.reset()
+    preemption.reset()
+    try:
+        x0 = synth_pta.initial_sample(np.random.default_rng(0))
+        kw = dict(backend="jax", seed=3, progress=False, warmup_sweeps=2,
+                  chunk_size=4, nchains=4, pad_pulsars=4)
+        base = PTABlockGibbs(synth_pta, mesh=make_mesh((2, 4)),
+                             **kw).sample(x0, outdir=tmp_path / "base",
+                                          niter=24, save_every=4)
+        faults.inject("crash", point="chainstore.between_replaces",
+                      at_row=16)
+        g = PTABlockGibbs(synth_pta, mesh=make_mesh((2, 4)), megachunk=2,
+                          **kw)
+        chain, rep = run_supervised(g, x0, tmp_path / "chaos", 24,
+                                    save_every=4, sleep=lambda s: None)
+        np.testing.assert_array_equal(chain, base)
+        assert rep.retries == 1
+    finally:
+        faults.clear()
+        preemption.reset()
+
+
+# ---------------------------------------------------------------------------
+# the dispatch-tax instruments
+
+
+def test_dispatch_breakdown_reports_amortized_tax(tiny_pta):
+    """The profiling probe on a mega driver: stage keys plus the two
+    amortization fields, with the per-sweep tax equal to the host-side
+    stage sum divided by the sweeps one dispatch covers."""
+    from pulsar_timing_gibbsspec_tpu import profiling
+
+    fn, args, drv = jb.megachunk_sweep_chunk_entry(tiny_pta, 4, chunk=2,
+                                                   megachunk=3)
+    x = np.asarray(tiny_pta.initial_sample(np.random.default_rng(3)))
+    x = np.tile(x, (drv.C, 1))
+    bd = profiling.dispatch_breakdown(drv, x)
+    assert bd["sweeps_per_dispatch"] == 6.0
+    host = bd["host_prep"] + bd["enqueue"] + bd["writeback"]
+    assert bd["dispatch_amortized_per_sweep"] == pytest.approx(host / 6.0)
+
+
+def test_stage_aggregator_amortizes_dispatch_over_sweeps():
+    """A ``chunk.dispatch`` span carrying ``n=`` (sweeps per dispatch)
+    must yield the synthetic ``dispatch_amortized`` stage at 1/n the
+    enqueue wall — the streaming view of the dispatch tax."""
+    from pulsar_timing_gibbsspec_tpu.obs import trace as otrace
+    from pulsar_timing_gibbsspec_tpu.obs.perf import StageAggregator
+    from pulsar_timing_gibbsspec_tpu.runtime import telemetry
+
+    telemetry.reset("dispatch_ms")
+    agg = StageAggregator(job="tm").install()
+    try:
+        with otrace.span("chunk.dispatch", it0=0, n=8):
+            pass
+    finally:
+        agg.uninstall()
+    summ = agg.summary()
+    assert set(summ) == {"enqueue", "dispatch_amortized"}
+    assert (summ["dispatch_amortized"]["last"]
+            == pytest.approx(summ["enqueue"]["last"] / 8.0))
+    g = telemetry.get_gauge("dispatch_ms", job="tm",
+                            stage="dispatch_amortized", stat="last")
+    assert g is not None and g >= 0.0
+    telemetry.reset("dispatch_ms")
+
+
+def test_check_ledger_dispatch_tax_is_lower_is_better():
+    """The amortized-dispatch headline gates in the opposite direction
+    from the rate fields: growth past (1 + band) x best prior fails,
+    improvement and in-band noise pass, and a --band override changes
+    the width but never the direction."""
+    from pulsar_timing_gibbsspec_tpu.obs.perf import (DEFAULT_BANDS,
+                                                      LOWER_IS_BETTER,
+                                                      check_ledger)
+
+    assert "dispatch_amortized_ms_per_sweep" in DEFAULT_BANDS
+    assert "dispatch_amortized_ms_per_sweep" in LOWER_IS_BETTER
+
+    def rec(tax):
+        return {"schema": 1, "kind": "bench", "metric": "m", "value": 100.0,
+                "device_kind": "cpu", "backend": "cpu", "source": "t",
+                "dispatch_amortized_ms_per_sweep": tax}
+
+    assert check_ledger([rec(1.0), rec(1.4)]) == []        # in band (50%)
+    assert check_ledger([rec(1.0), rec(0.2)]) == []        # improvement
+    problems = check_ledger([rec(1.0), rec(1.6)])
+    assert len(problems) == 1 and "grew past" in problems[0]
+    assert check_ledger(
+        [rec(1.0), rec(1.4)],
+        {"dispatch_amortized_ms_per_sweep": 0.1}) != []    # tighter band
+    assert check_ledger(
+        [rec(1.0), rec(1.6)],
+        {"dispatch_amortized_ms_per_sweep": 0.7}) == []    # wider band
+
+
+def test_watchdog_deadline_is_per_sweep():
+    """Mega-chunk dispatches cover M sweeps: the EMA must normalize by
+    ``n`` so a chunk-geometry change between resumes cannot mis-scale
+    the stall deadline."""
+    from pulsar_timing_gibbsspec_tpu.runtime.watchdog import DispatchWatchdog
+
+    wd = DispatchWatchdog(k=2.0, floor_s=1.0, first_floor_s=123.0)
+    assert wd.deadline(8) == 123.0                  # no EMA yet
+    wd.observe(8.0, n=8)                            # 1 s per sweep
+    assert wd.ema == pytest.approx(1.0)
+    assert wd.deadline(4) == pytest.approx(8.0)     # k * ema * n
+    assert wd.deadline(1) == pytest.approx(2.0)
+    wd2 = DispatchWatchdog(k=2.0, floor_s=1.0, first_floor_s=123.0)
+    wd2.observe(8.0)                                # legacy n=1 semantics
+    assert wd2.ema == pytest.approx(8.0)
+
+
+def test_trim_steady_drops_drain_and_partial_tail():
+    """The bench rate windows: a partial trailing chunk (smaller
+    iteration stride) and the final full chunk (its writeback has no
+    next compute to hide under — the drain) are both trimmed before
+    windowing, so every window measures the same steady process.  The
+    numpy oracle's stride-1 marks keep their tail."""
+    bench = _load_bench()
+    t = 1.58
+    marks = [(100 * i, t * i) for i in range(25)]        # steady chunks
+    marks.append((2500, marks[-1][1] + 7.9))             # drain-priced
+    marks.append((2540, marks[-1][1] + 0.7))             # partial chunk
+    trimmed = bench._trim_steady(marks)
+    assert len(trimmed) == 25 and trimmed[-1][0] == 2400
+    rates = bench._window_rates(marks)
+    assert len(rates) == bench.NWINDOWS
+    assert np.allclose(rates, 100.0 / t, rtol=1e-9)
+    # stride-1 marks (the oracle): no drain drop
+    oracle = [(i, 0.5 * i) for i in range(20)]
+    assert len(bench._trim_steady(oracle)) == 20
+    # too short to judge: untouched
+    assert len(bench._trim_steady([(0, 0.0), (4, 1.0)])) == 2
+
+
+def test_jacobi_factor_mean_prop_matches_unfused():
+    """The fused mean+proposal kernel is the refresh hot path: it must
+    reproduce ``jacobi_factor_mean`` plus the separate square-root
+    matvec bit-for-bit in f64 (same factor, same contraction order)."""
+    import jax.numpy as jnp
+
+    from pulsar_timing_gibbsspec_tpu.ops import linalg
+
+    rng = np.random.default_rng(42)
+    B = 6
+    A = rng.standard_normal((3, B, B))
+    Sig = jnp.asarray(A @ np.swapaxes(A, -1, -2) + 5.0 * np.eye(B))
+    d = jnp.asarray(rng.standard_normal((3, B)))
+    z = jnp.asarray(rng.standard_normal((3, B)))
+    L, Li, dj, mean = linalg.jacobi_factor_mean(Sig, d)
+    bp_ref = mean + dj * jnp.einsum("...ji,...j->...i", Li, z,
+                                    precision="highest")
+    Lf, Lif, djf, meanf, bpf = linalg.jacobi_factor_mean_prop(Sig, d, z)
+    np.testing.assert_array_equal(np.asarray(L), np.asarray(Lf))
+    np.testing.assert_array_equal(np.asarray(dj), np.asarray(djf))
+    np.testing.assert_allclose(np.asarray(mean), np.asarray(meanf),
+                               rtol=1e-13, atol=1e-13)
+    np.testing.assert_allclose(np.asarray(bp_ref), np.asarray(bpf),
+                               rtol=1e-13, atol=1e-13)

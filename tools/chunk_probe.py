@@ -4,6 +4,16 @@ Times, per steady chunk on the real device: the chunk dispatch call
 (fn(...) return), the xs conversion, the b_flat conversion, aux build —
 against the per-block sweep sums.  Usage: python tools/chunk_probe.py
 [--nchains 32] [--chunk 100]
+
+``--amortize`` switches to the dispatch-tax sweep (docs/PERFORMANCE.md
+mega-chunk knobs): for each chunk size it stages one dispatch through
+``profiling.dispatch_breakdown`` and tabulates the host-side overhead
+(host_prep + enqueue + writeback) amortized per sweep — the quantity the
+mega-chunk loop drives under 1 ms/sweep.  ``--mega N`` scans N
+sub-chunks inside each dispatch, so the table directly shows how the
+tax falls as one dispatch covers more sweeps.  Works on any backend;
+on CPU shrink the geometry first (e.g. ``--npsr 8 --adapt 100
+--sizes 16,64,256``).
 """
 
 from __future__ import annotations
@@ -18,11 +28,70 @@ if __name__ == "__main__":   # script bootstrap; no import side effects
     sys.path.insert(0, ".")
 
 
+def amortize(args):
+    """chunk_size -> amortized dispatch-tax table (one row per size)."""
+    import bench
+
+    from pulsar_timing_gibbsspec_tpu import profiling
+    from pulsar_timing_gibbsspec_tpu.sampler.jax_backend import JaxGibbsDriver
+
+    sizes = [int(s) for s in args.sizes.split(",")]
+    import os
+    if os.path.isdir(bench.REFDATA):
+        pta = bench.build_pta(args.npsr)
+    else:
+        # no reference data (bare container / CI): the synthetic CRN
+        # model from the contract entries keeps the tax measurable —
+        # the host-side overhead barely depends on the model size
+        from pulsar_timing_gibbsspec_tpu.analysis.jaxprcheck.entries import (
+            build_model, synthetic_pulsars)
+
+        print(f"# {bench.REFDATA} missing; synthetic "
+              f"{args.npsr}-pulsar stand-in")
+        pta = build_model(synthetic_pulsars(args.npsr, 100, 3, seed=0), 10)
+    x0 = pta.initial_sample(np.random.default_rng(0))
+    drv = JaxGibbsDriver(pta, seed=1, common_rho=True,
+                         white_adapt_iters=args.adapt,
+                         chunk_size=min(sizes), nchains=args.nchains,
+                         megachunk=args.mega)
+    niter = args.adapt + 2 * min(sizes)
+    cshape, bshape = drv.chain_shapes(niter)
+    it = drv.run(x0, np.zeros(cshape), np.zeros(bshape), 0, niter)
+    next(it)   # warmup + adaptation; the steady loop is never entered
+    print(f"# {args.npsr} psr x {drv.C} chains, megachunk={args.mega} "
+          f"(host-side tax only; device compute excluded)")
+    print(f"{'chunk':>6} {'sweeps/disp':>11} {'host_prep':>10} "
+          f"{'enqueue':>8} {'writeback':>10} {'ms/sweep':>9}")
+    for s in sizes:
+        # chunk fns are cached per size, so one adapted driver serves
+        # the whole sweep; the ctor's DE guard does not apply (the CRN
+        # bench model has no powerlaw-red MH block)
+        drv.chunk_size = s
+        bd = profiling.dispatch_breakdown(drv, drv.x_cur)
+        print(f"{s:>6} {int(bd['sweeps_per_dispatch']):>11} "
+              f"{bd['host_prep']:>10.2f} {bd['enqueue']:>8.2f} "
+              f"{bd['writeback']:>10.2f} "
+              f"{bd['dispatch_amortized_per_sweep']:>9.4f}")
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--nchains", type=int, default=32)
     ap.add_argument("--chunk", type=int, default=100)
     ap.add_argument("--nchunks", type=int, default=4)
+    ap.add_argument("--amortize", action="store_true",
+                    help="dispatch-tax sweep: chunk_size -> host overhead "
+                    "amortized per sweep, one dispatch_breakdown staging "
+                    "per size (see module docstring)")
+    ap.add_argument("--sizes", default="64,256,1024,4096",
+                    help="comma-separated chunk sizes for --amortize")
+    ap.add_argument("--npsr", type=int, default=45,
+                    help="pulsar count for --amortize (bench geometry)")
+    ap.add_argument("--adapt", type=int, default=300,
+                    help="white-adaptation iterations for --amortize")
+    ap.add_argument("--mega", type=int, default=1,
+                    help="megachunk depth for --amortize: sub-chunks "
+                    "scanned inside each dispatch")
     ap.add_argument("--overlap", action="store_true",
                     help="mirror run()'s double-buffered loop instead of "
                     "the serial component timing: dispatch chunk i+1, then "
@@ -30,6 +99,8 @@ def main():
                     "component sum measures how much transfer the tunnel "
                     "actually hides under device compute")
     args = ap.parse_args()
+    if args.amortize:
+        return amortize(args)
 
     import bench
     import jax.numpy as jnp

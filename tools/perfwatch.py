@@ -10,6 +10,9 @@ style and contracts machine-checked:
        group the newest record's rates must sit inside explicit noise
        bands of the best prior record (``obs.perf.check_ledger``) — a
        committed regression fails the gate; new metrics/groups pass.
+       Rate fields gate on DROPS below the best prior; the dispatch-tax
+       field ``dispatch_amortized_ms_per_sweep`` gates on GROWTH above
+       the best (lowest) prior (``obs.perf.LOWER_IS_BETTER``).
     2. Static cost-model self-check: trace the CRN Gram einsum on the
        CPU backend and require the jaxpr-derived ``dot_general`` FLOPs
        to match ``profiling.flop_counts`` within 5% — the roofline
@@ -258,7 +261,8 @@ def report(ledger: Path) -> int:
                       f"ndev={r.get('n_devices')}")
                 continue
             bits = [f"value={r['value']:.4g}" if "value" in r else ""]
-            for f in ("sweeps_per_sec", "ess_per_sec", "mfu"):
+            for f in ("sweeps_per_sec", "ess_per_sec", "mfu",
+                      "dispatch_amortized_ms_per_sweep"):
                 if f in r:
                     bits.append(f"{f}={r[f]:.4g}")
             sha = r.get("git_sha", "")
