@@ -50,10 +50,22 @@ Fault kinds:
   return ``devices`` — simulates the pool handing the next incarnation
   a different device count than the checkpoint was written under
   (``integrity.reshard_restore`` consults it).
-- ``"tenant_evict"`` make :func:`tenant_evict_request` return True at
+- ``"tenant_evict"`` make :func:`tenant_evict_request` return truthy at
   the ``"serve.chunk"`` seam — forces the serving scheduler to evict a
   resident tenant back to the queue (checkpoint + requeue), the churn
-  half of the kill-mid-multiplex chaos drill.
+  half of the kill-mid-multiplex chaos drill.  With ``tenant=<id>`` the
+  fault names its victim and ``at_row`` counts THAT JOB's resident
+  chunks (not the global chunk counter), so a campaign schedule can
+  evict "tenant 2 after its 3rd chunk" deterministically regardless of
+  when admission placed it.
+- ``"poison_rows"`` NaN-poison one tenant's rows of a multiplexed chunk
+  via :func:`poison_tenant_rows` (simulated single-tenant divergence —
+  the blast-radius drill's trigger).  ``tenant`` selects the victim
+  row; ``at_row`` counts the victim's resident chunks.
+- ``"device_loss"`` raise :class:`DeviceLost` at a fire point, carrying
+  ``devices`` = the surviving device count — the serving tier's
+  evacuation drill (drain residents, rebuild on the surviving submesh,
+  re-admit).
 """
 
 from __future__ import annotations
@@ -81,6 +93,22 @@ class XlaRuntimeError(RuntimeError):
     """
 
 
+class DeviceLost(RuntimeError):
+    """A device dropped out of the mesh mid-run.
+
+    Unlike a transient :class:`XlaRuntimeError`, the lost capacity does
+    not come back on retry: the run must EVACUATE — drain state through
+    verified checkpoints, rebuild programs on the surviving submesh
+    (``devices``, or None when unknown) and resume there.  The serving
+    tier's :meth:`~..serve.service.SamplerService.evacuate` and the
+    single-tenant ``integrity.reshard_restore`` are the two consumers.
+    """
+
+    def __init__(self, msg, devices=None):
+        super().__init__(msg)
+        self.devices = devices
+
+
 @dataclass
 class _Fault:
     kind: str
@@ -90,7 +118,8 @@ class _Fault:
     backend: str | None = None  # only fire for this backend name
     path: str | None = None     # target file for file-damage kinds
     seconds: float = 0.0        # stall sleep / drain deadline
-    devices: int | None = None  # device_count_change_on_resume target
+    devices: int | None = None  # device_count override / survivors
+    tenant: int | None = None   # victim tenant for serve-tier kinds
     fired: int = 0
 
 
@@ -99,10 +128,11 @@ _lock = threading.Lock()
 
 
 def inject(kind, point=None, at_row=None, times=1, backend=None, path=None,
-           seconds=0.0, devices=None):
+           seconds=0.0, devices=None, tenant=None):
     """Arm a fault; returns the handle (remove with :func:`clear`)."""
     f = _Fault(kind=kind, point=point, at_row=at_row, times=times,
-               backend=backend, path=path, seconds=seconds, devices=devices)
+               backend=backend, path=path, seconds=seconds, devices=devices,
+               tenant=tenant)
     with _lock:
         _armed.append(f)
     return f
@@ -166,10 +196,16 @@ def fire(point, row=None, backend=None, outdir=None):
         preemption.request_drain(
             reason=f"sigterm_at_seam:{point}",
             deadline_s=f.seconds or None)
-    for f in _take(point, row, backend, ("crash", "xla_error")):
+    for f in _take(point, row, backend, ("crash", "xla_error",
+                                         "device_loss")):
         if f.kind == "crash":
             raise InjectedCrash(
                 f"injected crash at {point} (row {row})")
+        if f.kind == "device_loss":
+            raise DeviceLost(
+                f"injected device loss at {point} (row {row}): "
+                f"{f.devices if f.devices is not None else '?'} "
+                "device(s) survive", devices=f.devices)
         raise XlaRuntimeError(
             f"INTERNAL: injected device failure at {point} (row {row})")
 
@@ -187,15 +223,87 @@ def device_count_override(default=None):
     return hits[-1].devices if hits else default
 
 
-def tenant_evict_request(row=None):
-    """Consume an armed ``tenant_evict`` fault at the ``serve.chunk``
-    seam (counting a firing).  Returns True when the serving scheduler
-    should evict a resident tenant this chunk — the service checkpoints
-    the tenant and requeues it, so the drill proves mid-multiplex churn
-    is loss-free.  False when nothing is armed."""
+def tenant_evict_request(row=None, job_rows=None):
+    """Consume armed ``tenant_evict`` faults at the ``serve.chunk``
+    seam (counting a firing each).
+
+    ``row`` is the service's global chunk counter; ``job_rows`` maps
+    resident ``tenant_id -> chunks that tenant has been resident``
+    (the service passes it so ``at_row`` on a tenant-targeted fault
+    counts the VICTIM's chunks, not everyone's — a global counter
+    cannot say "evict tenant 2 after its 3rd chunk" when admission
+    order varies).  Returns the set of victim tenant_ids, or ``True``
+    for an untargeted request (evict any one resident — historical
+    behavior), or ``False`` when nothing fired.
+    """
     if not _armed:
         return False
-    return bool(_take("serve.chunk", row, None, ("tenant_evict",)))
+    victims = set()
+    untargeted = False
+    with _lock:
+        for f in _armed:
+            if f.kind != "tenant_evict" or f.fired >= f.times:
+                continue
+            if f.point is not None and f.point != "serve.chunk":
+                continue
+            if f.tenant is not None:
+                held = None if job_rows is None \
+                    else job_rows.get(int(f.tenant))
+                if held is None or (f.at_row is not None
+                                    and held < f.at_row):
+                    continue
+                f.fired += 1
+                victims.add(int(f.tenant))
+            else:
+                if f.at_row is not None and (row is None
+                                             or row < f.at_row):
+                    continue
+                f.fired += 1
+                untargeted = True
+    if victims:
+        return victims
+    return untargeted
+
+
+def poison_tenant_rows(np_xs, np_bs, tenant_slots, job_rows):
+    """NaN-poison ONE tenant's rows of a multiplexed chunk for armed
+    ``poison_rows`` faults (the blast-radius drill: a single tenant's
+    chunk output diverges while its co-residents' rows stay exact).
+
+    ``np_xs`` (chunk, T, nx) / ``np_bs`` (chunk, T, ...) are the host
+    copies of the recorded stacks; ``tenant_slots`` maps tenant_id ->
+    slot index; ``job_rows`` maps tenant_id -> chunks resident (the
+    per-job ``at_row`` clock, same as :func:`tenant_evict_request`).
+    Returns ``(np_xs, np_bs, poisoned_slots)`` — the arrays are copied
+    first when read-only (``np.asarray`` of a device array is an
+    immutable view), so callers must rebind them.
+    """
+    if not _armed:
+        return np_xs, np_bs, set()
+    poisoned = set()
+    with _lock:
+        for f in _armed:
+            if f.kind != "poison_rows" or f.fired >= f.times:
+                continue
+            if f.tenant is None:
+                continue
+            slot = tenant_slots.get(int(f.tenant))
+            if slot is None:
+                continue
+            held = job_rows.get(int(f.tenant), 0)
+            if f.at_row is not None and held < f.at_row:
+                continue
+            f.fired += 1
+            poisoned.add(int(slot))
+    if poisoned:
+        if not np_xs.flags.writeable:
+            np_xs = np_xs.copy()
+        if not np_bs.flags.writeable:
+            np_bs = np_bs.copy()
+        for slot in poisoned:
+            np_xs[:, slot] = np.nan
+            np_bs[:, slot] = np.nan
+    return np_xs, np_bs, poisoned
 
 
 def _damage(path, kind):

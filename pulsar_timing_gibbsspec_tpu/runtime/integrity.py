@@ -270,7 +270,7 @@ def rollback(outdir) -> bool:
     return True
 
 
-def load_resume(outdir):
+def load_resume(outdir, force_requeue=False):
     """Standalone verified checkpoint load for a bare directory.
 
     ``ChainStore.load_resume`` needs a live store instance (the facade
@@ -281,12 +281,30 @@ def load_resume(outdir):
     :class:`CheckpointError` semantics.  Returns
     ``(chain, bchain, start_iter, adapt_state)`` or ``None`` when there
     is nothing to resume from.
+
+    A manifest whose ``serve.state`` is ``"quarantined"`` marks a job
+    the serving tier PARKED after exhausting its quarantine budget: the
+    checkpoint itself is verified (rows up to the last clean save), but
+    resuming it blindly would replay the same poisoned trajectory.
+    Such a directory REFUSES to load unless ``force_requeue=True``
+    (the ``--force-requeue`` flag on the CLI surfaces) — an operator
+    decision, not a scheduler default.
     """
     from ..sampler.chains import ChainStore
 
     outdir = Path(outdir)
     if not (outdir / "chain.npy").exists():
         return None
+    man = read_manifest(outdir)
+    if (not force_requeue and isinstance(man, dict)
+            and not man.get("corrupt")
+            and (man.get("serve") or {}).get("state") == "quarantined"):
+        raise CheckpointError(
+            f"{outdir} holds a QUARANTINED job (its serving tier "
+            "parked it after repeated row-health breaches).  The "
+            "checkpoint is verified but the job needs an operator "
+            "decision: resume with force_requeue=True "
+            "(--force-requeue) to requeue it from the verified rows")
 
     def _names(fname):
         p = outdir / fname

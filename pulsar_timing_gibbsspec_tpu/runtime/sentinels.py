@@ -46,15 +46,33 @@ class ChainDivergence(FloatingPointError):
         self.what = what
 
 
-def chunk_health(xs, bs):
+#: slack (in x = 0.5*log10(rho) units) past the prior bounds before a
+#: recorded rho value counts as a breach — grid endpoints land exactly
+#: on the bound, so the tolerance keeps legal draws out of the flag
+RHO_BOUND_TOL = 1e-6
+
+
+def chunk_health(xs, bs, rho_ix=None, rho_lo=None, rho_hi=None):
     """On-device health reductions over a chunk's recorded stacks.
 
-    ``xs`` is (n, C, nx), ``bs`` (n, C, ...): returns per-chain scalars
-    — ``finite`` (C,) bool and ``move_frac`` (C,) float32, the fraction
-    of recorded steps where the chain state changed at all (a fully
-    stuck chain — MH acceptance collapsed to zero AND every conditional
-    frozen — scores 0.0).  Traced inside the jitted chunk, so the host
-    receives a handful of scalars, not a verdict-sized transfer.
+    ``xs`` is (n, C, nx), ``bs`` (n, C, ...) where C is the chain axis
+    (the tenant-row axis in the serving tier — rows are independent
+    conditional chains, so each gets its own verdict).  Returns a
+    per-row health vector:
+
+    - ``finite`` (C,) bool — every recorded value finite;
+    - ``move_frac`` (C,) float32 — fraction of recorded steps where the
+      chain state changed at all (a fully stuck chain — MH acceptance
+      collapsed to zero AND every conditional frozen — scores 0.0);
+    - ``rho_ok`` (C,) bool — every recorded common-rho coordinate
+      (``xs[..., rho_ix]``, x units = 0.5*log10(rho)) inside the prior
+      bounds ``[rho_lo, rho_hi]`` ± :data:`RHO_BOUND_TOL`.  A breach
+      means the conjugate draw escaped its own grid — numerically
+      poisoned even while still finite.  All-True when the model
+      samples no common rho (``rho_ix`` None/empty).
+
+    Traced inside the jitted chunk, so the host receives a handful of
+    scalars per row, not a verdict-sized transfer.
     """
     import jax.numpy as jnp
 
@@ -67,7 +85,22 @@ def chunk_health(xs, bs):
     else:
         # a single recorded row carries no movement information
         moved = jnp.ones((xs.shape[1],), jnp.float32)
-    return {"finite": fin, "move_frac": moved}
+    C = xs.shape[1]
+    if (rho_ix is None or getattr(rho_ix, "size", 0) == 0
+            or rho_lo is None or rho_hi is None):
+        rho_ok = jnp.ones((C,), bool)
+    elif getattr(rho_ix, "ndim", 1) == 2:
+        # serving tier: per-row index columns (C, K) from the stacked
+        # CompiledPTA — gather each row's own rho coordinates
+        rows = jnp.take_along_axis(
+            xs, rho_ix.astype(jnp.int32)[None, :, :], axis=2)
+        rho_ok = jnp.all((rows >= rho_lo - RHO_BOUND_TOL)
+                         & (rows <= rho_hi + RHO_BOUND_TOL), axis=(0, 2))
+    else:
+        rows = xs[:, :, jnp.asarray(rho_ix, jnp.int32)]
+        rho_ok = jnp.all((rows >= rho_lo - RHO_BOUND_TOL)
+                         & (rows <= rho_hi + RHO_BOUND_TOL), axis=(0, 2))
+    return {"finite": fin, "move_frac": moved, "rho_ok": rho_ok}
 
 
 class SentinelMonitor:
@@ -104,6 +137,16 @@ class SentinelMonitor:
         stuck = mv <= 0.0
         self._streak = np.where(stuck, self._streak + 1, 0)
         events = []
+        if "rho_ok" in health:
+            rok = np.atleast_1d(np.asarray(health["rho_ok"]))
+            self.last["rho_ok_frac"] = float(rok.mean())
+            if not rok.all():
+                # a rho-bound breach is numerically poisoned state even
+                # while finite: warn + count, leave the verdict (rewind
+                # vs quarantine) to the supervisor / serving tier
+                telemetry.incr("rho_bound_breaches")
+                events.append({"event": "rho_bound_breach", "iter": int(it),
+                               "chains": np.where(~rok)[0].tolist()})
         low = (mv < self.collapse_frac) & ~stuck
         if low.any():
             events.append({"event": "mh_acceptance_collapse", "iter": int(it),

@@ -81,20 +81,38 @@ class DispatchWatchdog:
         self.on_event = on_event
         self.poll_s = float(poll_s)
         self.ema = None
+        self._n_seen = None
         self._worker = None
         self._inbox = None
 
     # -- deadline model ------------------------------------------------------
 
+    def _check_geometry(self, n) -> None:
+        """Reset the EMA when the sweeps-per-dispatch changes (e.g.
+        ``megachunk`` differs across a resume): the per-sweep wall is
+        NOT geometry-invariant — a bigger dispatch amortizes its fixed
+        overhead over more sweeps — so an EMA seeded under the old
+        geometry would misprice the new one and a resumed run could
+        trip a spurious soft-warn on its first healthy chunk.  The
+        first post-change call falls back to ``first_floor_s``, exactly
+        like a fresh run."""
+        n = max(int(n), 1)
+        if self._n_seen is not None and n != self._n_seen \
+                and self.ema is not None:
+            self.ema = None
+            telemetry.incr("watchdog_ema_resets")
+        self._n_seen = n
+
     def observe(self, dt, n=1) -> None:
         """Feed one steady-chunk wall time (seconds) covering ``n``
         sweeps: the EMA is kept PER SWEEP, so mega-chunk runs (one
         dispatch spanning many sub-chunks) and legacy runs share one
-        deadline model and a chunk-geometry change between resumes
-        cannot mis-scale the guard.  ``n=1`` (the default) keeps the
+        deadline model.  A change in ``n`` between calls resets the EMA
+        (:meth:`_check_geometry`).  ``n=1`` (the default) keeps the
         historical per-dispatch semantics.  Callers must skip walls that
         include a fresh compile — they would poison the EMA the way one
         outlier poisons any small-alpha smoother."""
+        self._check_geometry(n)
         per = float(dt) / max(int(n), 1)
         self.ema = per if self.ema is None else (
             self.ema_alpha * per + (1.0 - self.ema_alpha) * self.ema)
@@ -152,6 +170,7 @@ class DispatchWatchdog:
         returns its result or re-raises its exception.  Raises
         :class:`DispatchStall` (and abandons the call) when the hard
         deadline passes."""
+        self._check_geometry(n)
         self._ensure_worker()
         box = self._inbox
         box["fn"], box["out"], box["exc"] = fn, None, None

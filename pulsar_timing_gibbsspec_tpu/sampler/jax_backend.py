@@ -2980,6 +2980,18 @@ class JaxGibbsDriver:
 
         return body
 
+    def _rho_health_args(self):
+        """``(rho_ix, lo, hi)`` for the chunk-health rho-bound flag: the
+        sampled common-rho coordinates and the prior bounds in x units
+        (``rho = 10**(2x)`` ⇒ ``x = 0.5·log10(rho)``), or all-None when
+        the model samples no common rho."""
+        cm = self.cm
+        ix = np.asarray(cm.rho_ix_x)
+        if ix.size == 0:
+            return None, None, None
+        return (ix, 0.5 * float(np.log10(cm.rhomin)),
+                0.5 * float(np.log10(cm.rhomax)))
+
     def _sub_core(self, body, n, rec_off=0, ensemble=False):
         """Un-jitted core of one ``n``-sweep scan, shared by the legacy
         chunk program (:meth:`_make_chunk`) and the mega-chunk outer scan
@@ -3139,10 +3151,12 @@ class JaxGibbsDriver:
             # reads x_end (selected from the pre-cast stack above), so
             # checkpoints and trailing chunks never see the rounding.
             # Health reductions ride the same dispatch: a handful of
-            # per-chain scalars (all-finite, moved fraction) computed on
-            # device, so divergence/stuck-chain detection costs no extra
-            # transfer (runtime.sentinels, docs/RESILIENCE.md)
-            health = chunk_health(xs_rec, bs_rec)
+            # per-chain scalars (all-finite, moved fraction, rho-bound
+            # breach) computed on device, so divergence/stuck-chain
+            # detection costs no extra transfer (runtime.sentinels,
+            # docs/RESILIENCE.md)
+            health = chunk_health(xs_rec, bs_rec,
+                                  *self._rho_health_args())
             if ens is not None:
                 return (x_end, b_end, xs_rec.astype(self.rdtype), bs_flat,
                         health, es_sel, xs, x, b, es_end)
@@ -3299,7 +3313,8 @@ class JaxGibbsDriver:
             bs_all = bs_s.reshape((-1,) + bs_s.shape[2:])
             health = {"finite": jnp.all(health_s["finite"], axis=0),
                       "move_frac": jnp.mean(health_s["move_frac"],
-                                            axis=0)}
+                                            axis=0),
+                      "rho_ok": jnp.all(health_s["rho_ok"], axis=0)}
             outs = (x_keep, b_keep, xs_all, bs_all, health)
             if ens is not None:
                 outs = outs + (es_keep,)

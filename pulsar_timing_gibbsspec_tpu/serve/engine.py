@@ -137,18 +137,24 @@ def mux_body(chunk: int):
     """The raw (unjitted) multiplexed chunk: ``lax.scan`` of
     ``vmap(sharded_sweep_step)`` over the tenant axis.
 
-    ``mux(cm_stack, x, b, tkeys, it0) -> (x, b, xs, bs)`` with
+    ``mux(cm_stack, x, b, tkeys, it0) -> (x, b, xs, bs, health)`` with
     ``x (T, nx)``, ``b (T, P, Bmax)``, ``tkeys (T,)`` typed keys,
     ``it0 (T,) int32`` per-tenant absolute iteration of the chunk start
     (tenants admitted at different times run at different absolute
     iterations in the same chunk).  ``xs``/``bs`` record every sweep:
-    ``(chunk, T, ...)``.  Exposed unjitted so jaxprcheck can trace the
-    same program the service runs (``contracts/serve_buckets.json``).
+    ``(chunk, T, ...)``.  ``health`` is the per-tenant-row verdict of
+    :func:`~..runtime.sentinels.chunk_health` — finite / move_frac /
+    rho_ok, each ``(T,)`` — computed inside the jitted chunk so the
+    blast-radius decision (quarantine ONE row, keep the others) rides
+    the same dispatch as the recorded stacks instead of a host rescan.
+    Exposed unjitted so jaxprcheck can trace the same program the
+    service runs (``contracts/serve_buckets.json``).
     """
     import jax
     import jax.numpy as jnp
     import jax.random as jr
 
+    from ..runtime.sentinels import chunk_health
     from ..sampler import jax_backend as jb
 
     n = int(chunk)
@@ -164,16 +170,35 @@ def mux_body(chunk: int):
 
         (x, b), (xs, bs) = jax.lax.scan(
             sweep, (x, b), jnp.arange(n, dtype=jnp.int32))
-        return x, b, xs, bs
+        # per-row health: rho_ix_x is an array leaf (stacked (T, K) with
+        # per-row columns) while the rho bounds are static-box floats —
+        # graft verification already proved them identical across rows
+        rho_ix = cm_stack.rho_ix_x
+        if getattr(rho_ix, "size", 0):
+            health = chunk_health(
+                xs, bs, rho_ix,
+                0.5 * float(np.log10(cm_stack.rhomin)),
+                0.5 * float(np.log10(cm_stack.rhomax)))
+        else:
+            health = chunk_health(xs, bs)
+        return x, b, xs, bs, health
 
     return mux
 
 
 def make_mux(chunk: int):
-    """The jitted :func:`mux_body` with the (x, b) carries donated — the
-    scheduler threads them as device-resident carries between chunks."""
+    """The jitted :func:`mux_body`.  On non-CPU backends the (x, b)
+    carries are donated — the scheduler threads them as device-resident
+    carries between chunks and the old buffers are dead weight.  On the
+    CPU backend donation is deliberately OFF: donating the carries of
+    this program intermittently corrupts the heap inside the CPU
+    runtime (observed as segfaults/aborts in the chunk dispatch or the
+    following host writeback once the tenant axis is ≥ 4), and CPU
+    donation saves nothing — the host has no HBM to economize."""
     import jax
 
+    if jax.default_backend() == "cpu":
+        return jax.jit(mux_body(chunk))
     return jax.jit(mux_body(chunk), donate_argnums=(1, 2))
 
 
@@ -222,6 +247,12 @@ class ProgramCache:
         """The canonical CompiledPTA sharing ``cm``'s program (used for
         inert filler rows in partially occupied stacks)."""
         return self._canon[(bucket, model_signature(cm))]
+
+    def has_bucket(self, bucket) -> bool:
+        """Whether any canonical program exists for ``bucket`` — the
+        admission controller's warmth probe (bucket granularity: the
+        signature needs a compile to learn, the bucket doesn't)."""
+        return any(k[0] == bucket for k in self._canon)
 
     def mux(self, chunk: int):
         fn = self._mux.get(int(chunk))

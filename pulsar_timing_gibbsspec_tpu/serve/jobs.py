@@ -16,12 +16,17 @@ anywhere (:func:`Job.manifest_extra`).
 
 States (mapped onto the supervisor failure taxonomy by the service):
 
-- ``queued``    waiting for a batch-row slot
-- ``warming``   bucket routing / compile / graft / b-init in progress
-- ``sampling``  resident: riding the vmap axis of the compiled sweep
-- ``draining``  preemption drain: checkpointing to a verified set
-- ``done``      niter recorded rows checkpointed
-- ``failed``    terminal failure (``Job.failure`` carries the class)
+- ``queued``      waiting for a batch-row slot
+- ``warming``     bucket routing / compile / graft / b-init in progress
+- ``sampling``    resident: riding the vmap axis of the compiled sweep
+- ``draining``    preemption drain: checkpointing to a verified set
+- ``quarantined`` row-health breach: reverted to its verified
+  checkpoint, waiting out its circuit breaker (re-admitted with the
+  quarantine budget) or — budget exhausted — parked terminally with
+  the marker in its manifest (``integrity.load_resume`` refuses the
+  directory without ``force_requeue``)
+- ``done``        niter recorded rows checkpointed
+- ``failed``      terminal failure (``Job.failure`` carries the class)
 """
 
 from __future__ import annotations
@@ -31,7 +36,8 @@ import time
 
 import numpy as np
 
-JOB_STATES = ("queued", "warming", "sampling", "draining", "done", "failed")
+JOB_STATES = ("queued", "warming", "sampling", "draining", "quarantined",
+              "done", "failed")
 
 
 @dataclasses.dataclass
@@ -59,6 +65,7 @@ class Job:
     b: np.ndarray | None = None        # (P, Bmax) current coefficients
     retries: int = 0
     chunks_resident: int = 0     # chunks since last admission (fair share)
+    quarantines: int = 0         # row-health breaches (capped budget)
 
     # SLO bookkeeping
     submitted_at: float = dataclasses.field(default_factory=time.monotonic)
@@ -120,14 +127,16 @@ class Job:
                         self.it, adapt_state=self.adapt_state(),
                         extra=self.manifest_extra())
 
-    def try_resume(self) -> bool:
+    def try_resume(self, force_requeue=False) -> bool:
         """Load a verified checkpoint from ``outdir`` if one exists
         (``integrity.load_resume`` semantics: manifest verification,
-        ``.bak`` rollback, ``CheckpointError`` when unrecoverable).
-        Returns True when progress was restored."""
+        ``.bak`` rollback, ``CheckpointError`` when unrecoverable —
+        including the refusal of a quarantine-marked directory unless
+        ``force_requeue``).  Returns True when progress was restored."""
         from ..runtime import integrity
 
-        got = integrity.load_resume(self.outdir)
+        got = integrity.load_resume(self.outdir,
+                                    force_requeue=force_requeue)
         if got is None:
             return False
         chain, bchain, upto, adapt = got

@@ -19,20 +19,51 @@ with admission/eviction strictly *between* chunks:
   tenant's values, and the program never retraces for occupancy.
 
 Failure handling maps onto the supervisor taxonomy
-(``runtime/supervisor.classify_failure``): ``user`` errors re-raise
-immediately, a non-finite chunk row fails that job alone
-(``divergence``), and device/crash classes retry the whole step with
-deterministic backoff after reverting every resident to its verified
-checkpoint — each retry replays bit-exactly from the last save, so
-recovery is bounded by ``save_every`` chunks.  A preemption drain
-(``runtime/preemption``) checkpoints every resident to a verified set,
-marks the drain, and raises :class:`~..runtime.preemption.Preempted`
-(``EXIT_PREEMPTED=75`` semantics preserved per job: every in-flight
-request resumes from its own directory).
+(``runtime/supervisor.classify_failure``) with per-row blast-radius
+isolation as the organizing principle — tenant rows are independent
+conditional chains under vmap, so one bad tenant must never perturb a
+neighbor's bits:
 
-Chaos seam: ``faults.fire("serve.chunk", row=<global chunk>)`` runs
-before every dispatch, and ``faults.tenant_evict_request`` forces an
-eviction — the ``tenant_evict`` drill in ``tools/chaos_probe.py``.
+- **quarantine** — the jitted chunk returns a per-tenant-row health
+  vector (finite / move_frac / rho_ok, ``runtime.sentinels``); a row
+  breach QUARANTINES only that job: its poisoned rows never reach the
+  host buffers, the slot swaps to an inert filler at the next chunk
+  boundary, the job restarts from its own verified checkpoint under a
+  capped per-job budget (``quarantine_max``), and every co-resident
+  keeps running untouched (proven bitwise in tests/test_quarantine.py).
+  Budget exhausted → the job parks terminally in state ``quarantined``
+  with the marker in its manifest (``integrity.load_resume`` then
+  refuses the directory without ``force_requeue``).
+- **circuit breakers** — with ``breaker=`` configured, each tenant
+  gets a failure-rate breaker (``runtime.supervisor.CircuitBreaker``):
+  open tenants are rejected at :meth:`~SamplerService.submit` (typed
+  :class:`~..runtime.supervisor.CircuitOpen`) and their quarantined
+  jobs wait out the cooldown before the half-open probe re-admits.
+- **admission control** — with ``admission=`` configured, submissions
+  are gated on ``queue_depth`` backpressure and cold dataset shapes
+  are DEFERRED during a compile storm
+  (``runtime.supervisor.AdmissionController``, driven by the
+  ``compile_stalls``/``queue_depth``/``time_to_first_sample_ms``
+  gauges the service already publishes).
+- **device loss** — ``faults.DeviceLost`` triggers
+  :meth:`~SamplerService.evacuate`: every resident checkpoints its
+  intact host rows, programs rebuild on the surviving submesh, and the
+  jobs re-admit — same recovery shape as ``reshard_restore`` for the
+  single-tenant driver, applied per job.
+- **whole-step failures** — device/crash classes still retry the whole
+  step with deterministic backoff after reverting every resident to
+  its verified checkpoint; ``user`` errors re-raise immediately.  A
+  preemption drain (``runtime/preemption``) checkpoints every resident
+  to a verified set, marks the drain, and raises
+  :class:`~..runtime.preemption.Preempted` (``EXIT_PREEMPTED=75``
+  semantics preserved per job).
+
+Chaos seams: ``faults.fire("serve.chunk", row=<global chunk>)`` runs
+before every dispatch; ``faults.tenant_evict_request`` forces an
+eviction (per-tenant targetable); ``faults.poison_tenant_rows`` NaN-
+poisons one tenant's chunk rows — the drills in
+``tools/chaos_probe.py`` and the seeded campaign in
+``tools/chaos_campaign.py``.
 """
 
 from __future__ import annotations
@@ -76,7 +107,9 @@ class SamplerService:
     def __init__(self, root, table: BucketTable, *, slots=2, chunk=4,
                  save_every=1, quantum=8, service_seed=0, max_retries=2,
                  backoff_base=0.0, cache: ProgramCache | None = None,
-                 mesh=None, ensemble=False, pt_ladder=1, perf=False):
+                 mesh=None, ensemble=False, pt_ladder=1, perf=False,
+                 quarantine_max=2, breaker=None, admission=None,
+                 evac_max=2, clock=time.monotonic):
         # the multiplexed chunk is vmap(sharded_sweep_step) over the
         # TENANT axis — rows are unrelated analyses, so any cross-chain
         # ensemble stage (stretch pairing, tempering swaps) would couple
@@ -133,6 +166,24 @@ class SamplerService:
         self._next_tenant = 0
         self._retries = 0
 
+        # blast-radius isolation: per-job quarantine budget, per-tenant
+        # circuit breakers, service-level admission control, and the
+        # device-loss evacuation budget.  ``breaker``/``admission``
+        # accept True (defaults) or a kwargs dict; ``clock`` is
+        # injectable so breaker cooldowns are testable without sleeping
+        self.quarantine_max = int(quarantine_max)
+        self.evac_max = int(evac_max)
+        self._clock = clock
+        self._breaker_cfg = ({} if breaker is True else breaker)
+        self._breakers: dict[int, supervisor.CircuitBreaker] = {}
+        if admission is True:
+            admission = {}
+        self._admission = None if admission is None else \
+            supervisor.AdmissionController(clock=clock, **admission)
+        self._quarantines = 0
+        self._evacuations = 0
+        self._quarantine_log: list[dict] = []
+
         # perf=True hangs the streaming stage aggregator off the trace
         # seams: every serve.prepare/dispatch/d2h/writeback span folds
         # into dispatch_ms{stage=...,job="svc"} gauges that prometheus()
@@ -150,13 +201,23 @@ class SamplerService:
                outdir=None) -> Job:
         """Queue an analysis request.  ``tenant_id`` (with the service
         seed) IS the PRNG identity — pass the original value to readmit
-        a job in a fresh process, or leave None for a new stream."""
+        a job in a fresh process, or leave None for a new stream.
+
+        Raises :class:`~..runtime.supervisor.CircuitOpen` when admission
+        control rejects on queue-depth backpressure, or when the
+        tenant's circuit breaker is open (a tenant whose uploads keep
+        poisoning rows must wait out the cooldown)."""
+        if self._admission is not None:
+            self._admission.admit_submission(len(self.queue))
         if job_id is None:
             job_id = f"job{len(self.jobs):04d}"
         if job_id in self.jobs:
             raise ValueError(f"duplicate job_id {job_id!r}")
         if tenant_id is None:
             tenant_id = self._next_tenant
+        br = self._breakers.get(int(tenant_id))
+        if br is not None:
+            br.check(f"tenant {int(tenant_id)}")
         self._next_tenant = max(self._next_tenant, int(tenant_id) + 1)
         if outdir is None:
             outdir = self.root / job_id
@@ -194,17 +255,26 @@ class SamplerService:
 
     # -- admission / eviction ----------------------------------------------
 
+    def _route(self, job) -> bool:
+        """Route only (cheap — no compile): sets ``job.bucket``.
+        Returns False after marking the job failed on overflow."""
+        if job.bucket is not None:
+            return True
+        try:
+            job.bucket = self.table.route(probe_shape(job.pta))
+        except BucketOverflow as exc:
+            job.failure = f"overflow: {exc}"
+            job.set_state("failed")
+            return False
+        return True
+
     def _prepare(self, job) -> bool:
         """Route + compile + graft (idempotent; cached on the job).
         Returns False after marking the job failed on a routing error."""
         if job.cm is not None:
             return True
         job.set_state("warming")
-        try:
-            job.bucket = self.table.route(probe_shape(job.pta))
-        except BucketOverflow as exc:
-            job.failure = f"overflow: {exc}"
-            job.set_state("failed")
+        if not self._route(job):
             return False
         from ..analysis import guards
 
@@ -219,6 +289,8 @@ class SamplerService:
         if not warm:
             self._compile_stalls += 1
             telemetry.gauge("compile_stalls", float(self._compile_stalls))
+            if self._admission is not None:
+                self._admission.note_compile()
         telemetry.gauge("warm_hit_rate", self.cache.warm_hit_rate())
         return True
 
@@ -262,9 +334,75 @@ class SamplerService:
         telemetry.gauge("queue_depth", float(len(self.queue)))
         self._dirty = True
 
+    def _tenant_breaker(self, tenant_id, create=False):
+        """The tenant's circuit breaker (None when breakers are off)."""
+        if self._breaker_cfg is None:
+            return None
+        br = self._breakers.get(int(tenant_id))
+        if br is None and create:
+            br = self._breakers[int(tenant_id)] = \
+                supervisor.CircuitBreaker(clock=self._clock,
+                                          **self._breaker_cfg)
+        return br
+
+    def _quarantine(self, slot, why):
+        """Blast-radius isolation for one poisoned row: drop the job
+        from its slot (an inert filler swaps in at the restack — the
+        next chunk boundary), discard the poisoned chunk (it never
+        reached the host buffers), and restart the job from its own
+        verified state — in-memory ``(x, b, it)`` still hold the last
+        clean chunk's end, which the checkpoint here persists.  Every
+        co-resident keeps running untouched: rows are independent under
+        vmap and their writeback proceeds in the same loop.
+
+        Within the ``quarantine_max`` budget the job requeues (state
+        ``quarantined``; its breaker gates re-admission).  Budget
+        exhausted → the job parks terminally with the quarantine marker
+        in its manifest: a deterministic replay that breaches again
+        will breach forever, and ``integrity.load_resume`` refuses the
+        directory until an operator passes ``force_requeue``.
+        """
+        job = self.residents[slot]
+        job.quarantines += 1
+        self._quarantines += 1
+        telemetry.incr("sentinel_trips")
+        telemetry.incr("quarantines")
+        telemetry.gauge("quarantined_jobs", float(sum(
+            1 for j in self.jobs.values() if j.state == "quarantined") + 1))
+        self._quarantine_log.append({
+            "job_id": job.job_id, "tenant_id": int(job.tenant_id),
+            "chunk": int(self.global_chunk), "why": why,
+            "count": int(job.quarantines)})
+        br = self._tenant_breaker(job.tenant_id, create=True)
+        if br is not None:
+            br.record_failure()
+        self.residents[slot] = None
+        self._dirty = True
+        otrace.instant("serve.quarantine", job=job.job_id,
+                       tenant=int(job.tenant_id), why=why,
+                       count=int(job.quarantines))
+        if job.quarantines > self.quarantine_max:
+            job.failure = (f"quarantined: {why} — budget exhausted "
+                           f"({job.quarantines - 1} replays); "
+                           "resume requires force_requeue")
+            job.set_state("quarantined")
+            job.checkpoint()    # manifest carries the quarantine marker
+            return
+        # verified checkpoint of the clean prefix, THEN the state flip:
+        # the pending-requeue manifest must stay resumable by a fresh
+        # incarnation without the operator override
+        job.checkpoint()
+        job.set_state("quarantined")
+        self.queue.append(job)
+        telemetry.gauge("queue_depth", float(len(self.queue)))
+
     def _admissions(self):
         """Fill free slots from the queue head, constrained to one
-        (bucket, signature) group at a time."""
+        (bucket, signature) group at a time.  A quarantined job waits
+        for its tenant's breaker (half-open probe after the cooldown);
+        during a compile storm, cold dataset shapes are deferred so a
+        burst of novel buckets cannot serialize warm tenants behind
+        back-to-back XLA compiles."""
         if not any(self.residents):
             self._active = None
         for slot in range(self.slots):
@@ -272,6 +410,21 @@ class SamplerService:
                 continue
             take = None
             for job in self.queue:
+                if job.state == "quarantined":
+                    # non-consuming gate: the half-open probe must only
+                    # be claimed when the job is actually admitted — a
+                    # group-key mismatch after allow() would strand the
+                    # breaker half-open with its probe spent, starving
+                    # the tenant forever
+                    br = self._tenant_breaker(job.tenant_id)
+                    if br is not None and not br.would_allow():
+                        continue        # wait out the cooldown
+                if (self._admission is not None and job.cm is None):
+                    if not self._route(job):
+                        continue        # failed routing; skip
+                    if self._admission.defer_cold(
+                            self.cache.has_bucket(job.bucket)):
+                        continue        # compile storm: hold cold shapes
                 if not self._prepare(job):
                     continue            # failed routing; skip
                 key = self._group_key(job)
@@ -282,6 +435,10 @@ class SamplerService:
                     break
             if take is None:
                 break
+            if take.state == "quarantined":
+                br = self._tenant_breaker(take.tenant_id)
+                if br is not None and not br.allow():
+                    break   # probe raced away; retry next round
             self.queue.remove(take)
             self.queue[:] = [j for j in self.queue
                              if j.state != "failed"]
@@ -372,18 +529,32 @@ class SamplerService:
                                 chunk=self.global_chunk):
                 args = (self._stack, self._X, self._B, self._K,
                         self._it0())
-                X, B, xs, bs = mux(*args)
+                X, B, xs, bs, health = mux(*args)
             self._warmed.add(warm_key)
         else:
             # the zero-retrace contract lives HERE: a steady chunk with
             # a warmed (chunk, group) must compile nothing
             with otrace.span("serve.dispatch", chunk=self.global_chunk):
-                X, B, xs, bs = mux(self._stack, self._X, self._B,
-                                   self._K, self._it0())
+                X, B, xs, bs, health = mux(self._stack, self._X, self._B,
+                                           self._K, self._it0())
         self._X, self._B = X, B
         with otrace.span("serve.d2h", chunk=self.global_chunk):
-            np_xs = np.asarray(xs, np.float64)     # (chunk, T, nx)
-            np_bs = np.asarray(bs, np.float64)     # (chunk, T, P, Bmax)
+            # OWNED host copies, not np.asarray views: on the CPU
+            # backend a view aliases the XLA output buffer of a
+            # donation-aliased program, and the runtime may reclaim it
+            # while the writeback loop is still reading (intermittent
+            # segfault under multi-bucket churn)
+            np_xs = np.array(xs, np.float64)       # (chunk, T, nx)
+            np_bs = np.array(bs, np.float64)       # (chunk, T, P, Bmax)
+            h_fin = np.array(health["finite"])     # (T,) per-row verdict
+            h_rho = np.array(health["rho_ok"])
+        # chaos seam: NaN-poison one tenant's host rows (simulated
+        # single-tenant divergence — the blast-radius drill trigger)
+        live = {int(j.tenant_id): (s, j.chunks_resident)
+                for s, j in enumerate(self.residents) if j is not None}
+        np_xs, np_bs, _poisoned = faults.poison_tenant_rows(
+            np_xs, np_bs, {t: s for t, (s, _) in live.items()},
+            {t: r for t, (_, r) in live.items()})
         now = time.monotonic()
         with otrace.span("serve.writeback", chunk=self.global_chunk):
             for slot, job in enumerate(self.residents):
@@ -392,13 +563,20 @@ class SamplerService:
                 rows = np_xs[:, slot]
                 brows = np_bs[:, slot].reshape(self.chunk, -1)
                 take = min(self.chunk, job.niter - job.it)
-                if not (np.isfinite(rows[:take]).all()
-                        and np.isfinite(brows[:take]).all()):
-                    telemetry.incr("sentinel_trips")
-                    job.failure = "divergence: non-finite chunk rows"
-                    job.set_state("failed")
-                    self.residents[slot] = None
-                    self._dirty = True
+                # the device health vector covers the whole chunk row
+                # (including sweeps past the job's tail); the host check
+                # covers what would actually be recorded — either way
+                # the breach stays confined to THIS row
+                breach = None
+                if not h_fin[slot]:
+                    breach = "non-finite row (device health)"
+                elif not h_rho[slot]:
+                    breach = "rho-bound breach (device health)"
+                elif not (np.isfinite(rows[:take]).all()
+                          and np.isfinite(brows[:take]).all()):
+                    breach = "non-finite chunk rows (host)"
+                if breach is not None:
+                    self._quarantine(slot, breach)
                     continue
                 job.chain[job.it:job.it + take] = rows[:take]
                 job.bchain[job.it:job.it + take] = brows[:take]
@@ -410,6 +588,9 @@ class SamplerService:
                     job.first_sample_at = now
                     telemetry.gauge("time_to_first_sample_ms",
                                     job.time_to_first_sample_ms())
+                br = self._breakers.get(int(job.tenant_id))
+                if br is not None:
+                    br.record_success()
                 self._observe_job(job, rows[:take], now)
 
     def _observe_job(self, job, rows, now):
@@ -485,11 +666,20 @@ class SamplerService:
             self._drain()
         self.global_chunk += 1
         faults.fire("serve.chunk", row=self.global_chunk)
-        if faults.tenant_evict_request(row=self.global_chunk):
+        evict_req = faults.tenant_evict_request(
+            row=self.global_chunk,
+            job_rows={int(j.tenant_id): j.chunks_resident
+                      for j in self.residents if j is not None})
+        if evict_req:
             for slot, job in enumerate(self.residents):
-                if job is not None:
+                if job is None:
+                    continue
+                if evict_req is True:
+                    # untargeted (historical): evict any one resident
                     self._evict(slot, "injected")
                     break
+                if int(job.tenant_id) in evict_req:
+                    self._evict(slot, "injected")
         # fair share: the longest-resident tenant yields to a non-empty
         # queue after its quantum
         if self.queue:
@@ -516,16 +706,74 @@ class SamplerService:
         telemetry.gauge("queue_depth", float(len(self.queue)))
         return True
 
+    def evacuate(self, devices=None) -> None:
+        """Device-loss recovery: drain every resident through its own
+        verified checkpoint (the host row buffers are intact — the lost
+        device only held carries and compiled programs), drop every
+        device-resident artifact, rebuild on the surviving submesh and
+        re-admit the drained jobs at the queue head.  The per-job
+        analogue of the single-tenant ``integrity.reshard_restore``
+        path: streams are pure in (service_seed, tenant_id, iteration),
+        so the re-admitted jobs replay bit-identically on the new
+        topology."""
+        with otrace.span("serve.evacuate",
+                         jobs=sum(1 for j in self.residents if j),
+                         devices=devices):
+            drained = []
+            for slot, job in enumerate(self.residents):
+                if job is None:
+                    continue
+                job.checkpoint()
+                job.set_state("queued")
+                job.cm = None          # recompile on the new topology
+                self.residents[slot] = None
+                drained.append(job)
+            self.queue[:0] = drained
+            telemetry.gauge("queue_depth", float(len(self.queue)))
+            # compiled programs, canonical statics and filler carries
+            # are pinned to the lost topology: rebuild from scratch
+            self.cache = ProgramCache()
+            for job in self.jobs.values():
+                job.cm = None
+            self._warmed.clear()
+            self._fillers.clear()
+            self._stack = self._X = self._B = self._K = None
+            self._active = None
+            self._dirty = True
+            if devices is None or int(devices) <= 1:
+                self.mesh = None
+            else:
+                from ..parallel.sharding import (chain_submesh_size,
+                                                 make_mesh)
+
+                try:
+                    mesh = make_mesh(int(devices))
+                    nc = chain_submesh_size(mesh)
+                    if nc > 1 and self.slots % nc:
+                        mesh = None   # tenant axis no longer divides
+                    self.mesh = mesh
+                except Exception:
+                    self.mesh = None  # survivors can't form a mesh
+
     def run(self) -> dict:
         """Drive every submitted job to done/failed.  Retries
         retryable step failures (device/crash/stall classes) with
         deterministic backoff after reverting residents to their
-        checkpoints; re-raises ``user`` errors and ``Preempted``."""
+        checkpoints; evacuates onto the surviving submesh on device
+        loss (up to ``evac_max`` times); re-raises ``user`` errors and
+        ``Preempted``."""
         while True:
             try:
                 worked = self.step()
             except preemption.Preempted:
                 raise
+            except faults.DeviceLost as exc:
+                if self._evacuations >= self.evac_max:
+                    raise
+                self._evacuations += 1
+                telemetry.incr("device_evacuations")
+                self.evacuate(exc.devices)
+                continue
             except Exception as exc:             # noqa: BLE001
                 cls = supervisor.classify_failure(exc)
                 if cls in ("user", "unknown") \
@@ -538,8 +786,13 @@ class SamplerService:
                     seed=self.service_seed))
                 self._revert_residents()
                 continue
-            if not worked and not self.queue:
-                break
+            if not worked:
+                if not self.queue:
+                    break
+                # every queued job is deferred (quarantine cooldown or
+                # compile storm): idle briefly instead of hot-spinning
+                # until a breaker's half-open probe comes due
+                time.sleep(0.005)
         return self.report()
 
     def prometheus(self) -> str:
@@ -555,6 +808,7 @@ class SamplerService:
         jobs = {jid: {"state": j.state, "it": int(j.it),
                       "tenant_id": int(j.tenant_id),
                       "retries": int(j.retries),
+                      "quarantines": int(j.quarantines),
                       "failure": j.failure,
                       "time_to_first_sample_ms":
                           j.time_to_first_sample_ms()}
@@ -568,6 +822,13 @@ class SamplerService:
             "compile_stalls": int(self._compile_stalls),
             "warm_hit_rate": self.cache.warm_hit_rate(),
             "service_retries": int(self._retries),
+            "quarantines": int(self._quarantines),
+            "quarantine_log": list(self._quarantine_log),
+            "evacuations": int(self._evacuations),
+            "breakers": {t: b.snapshot()
+                         for t, b in self._breakers.items()},
+            "admission": (None if self._admission is None
+                          else self._admission.snapshot()),
             "mesh": mesh_layout(self.mesh),
             "gauges": telemetry.gauges(),
         }

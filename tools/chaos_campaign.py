@@ -1,0 +1,367 @@
+"""Seeded randomized chaos campaign for the multi-tenant serving tier.
+
+The blast-radius contract under test (docs/RESILIENCE.md): tenant rows
+of the multiplexed sweep are independent conditional chains, so ANY
+injected misbehavior — a poisoned tenant, a mid-chunk crash, a lost
+device — must stay confined to its victim while every other tenant's
+chain remains **bitwise identical** to an uninterrupted solo run.
+
+Each seed draws a randomized fault schedule (reproducible:
+``default_rng([campaign_seed, seed])``) over a 4-tenant run through one
+shared bucket:
+
+- ``poison``       NaN-poison one tenant's chunk rows (the quarantine
+                   drill; fires once, victim replays clean from its
+                   verified checkpoint)
+- ``evict``        tenant-targeted eviction at the victim's Nth chunk
+- ``crash``        injected crash at the chunk seam (service retry)
+- ``xla``          injected XlaRuntimeError at the seam (service retry)
+- ``stall``        short injected sleep at the seam (latency, no error)
+- ``device_loss``  injected DeviceLost → full evacuation through
+                   verified checkpoints and re-admission
+- ``storm``        a fifth, cold-shape tenant (second bucket) submitted
+                   under admission control with a tight compile-storm
+                   window (full campaign only — its compile is a
+                   one-time cost across the whole campaign)
+
+Invariants checked after EVERY seed:
+
+1. every job reaches ``done`` and its chain/bchain is bitwise equal to
+   its solo baseline (co-resident isolation AND victim recovery);
+2. quarantine latency ≤ 1 chunk: each poison that actually FIRED (read
+   off the fault handle — churn/evacuation can reset a victim's chunk
+   clock below a scheduled threshold, leaving the fault armed but
+   inert) produces exactly one quarantine event for its victim —
+   detection happened on the poisoned chunk itself, since a missed
+   chunk would leak NaNs into the chain and break invariant 1;
+3. zero unplanned steady retraces (``recompile_counter``): churn,
+   quarantine and evacuation all reuse or deliberately rebuild
+   programs — no silent jit cache misses;
+4. gauge consistency: the ``quarantines`` counter matches the log, the
+   ``evacuations`` counter matches the fired device losses, retries
+   stay within budget, and the queue fully drained.
+
+Baselines and compiled programs are shared across seeds (one
+``ProgramCache``), so the marginal cost of a seed is dispatch, not XLA.
+
+Usage: python tools/chaos_campaign.py [--seeds N] [--quick]
+       [--campaign-seed N] [--outdir DIR] [--json]
+Exit status 0 when every seed holds every invariant, 1 otherwise.
+``--quick --seeds 5`` is the optional ci_lint layer (``--chaos``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import shutil
+import sys
+import tempfile
+from pathlib import Path
+
+import numpy as np
+
+if __name__ == "__main__":   # script bootstrap; no import side effects
+    sys.path.insert(0, ".")
+
+NITER = 12
+TENANTS = ((24, 0), (28, 1), (32, 2), (36, 3))
+STORM_TENANT = (44, 9)       # routes to the second (cold) bucket
+
+
+def _models():
+    from pulsar_timing_gibbsspec_tpu.analysis.jaxprcheck.entries import (
+        build_model, synthetic_pulsars)
+
+    def mk(ntoa, seed):
+        return build_model(
+            synthetic_pulsars(2, ntoa, tm_cols=3, seed=seed), 3)
+
+    return [mk(*t) for t in TENANTS], mk(*STORM_TENANT)
+
+
+def _table():
+    from pulsar_timing_gibbsspec_tpu.serve.buckets import (BucketSpec,
+                                                           BucketTable)
+
+    return BucketTable([BucketSpec(2, 40, 24, 3),
+                        BucketSpec(2, 48, 24, 3)])
+
+
+def _service(root, cache, **kw):
+    from pulsar_timing_gibbsspec_tpu.serve import SamplerService
+
+    kw.setdefault("slots", 4)
+    kw.setdefault("chunk", 4)
+    kw.setdefault("quantum", 100)
+    kw.setdefault("save_every", 1)
+    return SamplerService(root, _table(), cache=cache, **kw)
+
+
+def _solo_baselines(root, cache, ptas):
+    """Uninterrupted single-tenant runs — the bitwise ground truth."""
+    out = []
+    for i, pta in enumerate(ptas):
+        svc = _service(root / f"solo{i}", cache)
+        job = svc.submit(pta, NITER, job_id=f"solo{i}", tenant_id=i)
+        svc.run()
+        if job.state != "done":
+            raise RuntimeError(f"solo baseline {i} failed: {job.failure}")
+        out.append((job.chain.copy(), job.bchain.copy()))
+    return out
+
+
+def _draw_schedule(rng, quick):
+    """A reproducible fault schedule: (kind, kwargs) pairs, bounded so
+    the service budgets (max_retries=2, quarantine_max=2, evac_max=2)
+    are never exceeded by construction — the campaign tests isolation,
+    not budget exhaustion (tests/test_quarantine.py covers that)."""
+    kinds = ["poison", "evict", "crash", "xla", "stall"]
+    if not quick:
+        kinds += ["device_loss", "storm"]
+    n = 1 if quick else int(rng.integers(1, 4))
+    sched, retryable, lost, per_tenant_poison = [], 0, 0, {}
+    for _ in range(n):
+        kind = str(rng.choice(kinds))
+        if kind in ("crash", "xla", "stall") and retryable >= 2:
+            kind = "evict"
+        if kind == "device_loss" and lost >= 1:
+            kind = "poison"
+        tenant = int(rng.integers(0, len(TENANTS)))
+        at = int(rng.integers(1, 3))
+        if kind == "poison":
+            if per_tenant_poison.get(tenant, 0) >= 2:
+                kind = "evict"
+            else:
+                per_tenant_poison[tenant] = \
+                    per_tenant_poison.get(tenant, 0) + 1
+        if kind in ("crash", "xla", "stall"):
+            retryable += 1
+        if kind == "device_loss":
+            lost += 1
+        sched.append((kind, {"tenant": tenant, "at": at}))
+    return sched
+
+
+def _arm(sched):
+    """Arm the schedule; returns the live fault handles (parallel to
+    ``sched``, None for kinds with no registry entry).  The handles
+    outlive ``faults.clear()`` — their ``fired`` counters are how the
+    invariants distinguish a fault that actually fired from one whose
+    trigger never came up (e.g. a poison whose victim-clock threshold
+    became unreachable after an evacuation reset ``chunks_resident``)."""
+    from pulsar_timing_gibbsspec_tpu.runtime import faults
+
+    handles = []
+    for kind, kw in sched:
+        if kind == "poison":
+            handles.append(faults.inject(
+                "poison_rows", tenant=kw["tenant"],
+                at_row=kw["at"], times=1))
+        elif kind == "evict":
+            handles.append(faults.inject(
+                "tenant_evict", point="serve.chunk",
+                tenant=kw["tenant"], at_row=kw["at"], times=1))
+        elif kind == "crash":
+            handles.append(faults.inject(
+                "crash", point="serve.chunk", at_row=kw["at"] + 1,
+                times=1))
+        elif kind == "xla":
+            handles.append(faults.inject(
+                "xla_error", point="serve.chunk", at_row=kw["at"] + 1,
+                times=1))
+        elif kind == "stall":
+            handles.append(faults.inject(
+                "stall", point="serve.chunk", at_row=kw["at"] + 1,
+                seconds=0.02, times=1))
+        elif kind == "device_loss":
+            handles.append(faults.inject(
+                "device_loss", point="serve.chunk", at_row=kw["at"] + 1,
+                times=1, devices=1))
+        else:
+            handles.append(None)      # storm: no registry entry
+    return handles
+
+
+def _run_seed(seed, args, root, cache, ptas, storm_pta, solos,
+              storm_solo):
+    """One seeded drill.  Returns (record, failure list)."""
+    from pulsar_timing_gibbsspec_tpu.profiling import recompile_counter
+    from pulsar_timing_gibbsspec_tpu.runtime import faults
+
+    rng = np.random.default_rng([args.campaign_seed, seed])
+    sched = _draw_schedule(rng, args.quick)
+    with_storm = any(k == "storm" for k, _ in sched)
+    fails = []
+
+    kw = {}
+    if bool(rng.integers(0, 2)):
+        # half the seeds run with per-tenant breakers on a short
+        # cooldown: re-admission must still converge to bitwise
+        kw["breaker"] = {"window": 4, "threshold": 1.0,
+                        "min_events": 1, "cooldown_s": 0.01}
+    if with_storm:
+        kw["admission"] = {"max_queue": 16, "storm_compiles": 1,
+                           "storm_window_s": 0.1}
+    svc = _service(root / f"seed{seed}", cache, **kw)
+    faults.clear()
+    handles = _arm(sched)
+    jobs = []
+    try:
+        with recompile_counter() as rc:
+            rc.phase("steady")
+            for i, pta in enumerate(ptas):
+                jobs.append(svc.submit(pta, NITER, job_id=f"job{i}",
+                                       tenant_id=i))
+            if with_storm:
+                jobs.append(svc.submit(storm_pta, NITER,
+                                       job_id="storm",
+                                       tenant_id=len(TENANTS)))
+            report = svc.run()
+    except Exception as exc:                      # noqa: BLE001
+        faults.clear()
+        return {"seed": seed, "schedule": sched,
+                "error": repr(exc)}, [f"seed {seed}: run raised {exc!r}"]
+    finally:
+        faults.clear()
+
+    # 1. completion + bitwise isolation/recovery for EVERY tenant
+    refs = list(solos) + ([storm_solo] if with_storm else [])
+    for i, job in enumerate(jobs):
+        if job.state != "done":
+            fails.append(f"seed {seed}: {job.job_id} state={job.state!r}"
+                         f" ({job.failure})")
+            continue
+        ref_c, ref_b = refs[i]
+        if not (np.array_equal(job.chain, ref_c)
+                and np.array_equal(job.bchain, ref_b)):
+            fails.append(f"seed {seed}: {job.job_id} chain diverged "
+                         "from its solo baseline (blast radius leaked)")
+
+    # 2. each FIRED poison → exactly one quarantine of its victim.
+    # Firing is read off the fault handles, not the schedule: a poison's
+    # victim clock (chunks_resident) legitimately resets when churn or
+    # an evacuation re-admits the victim, so a scheduled threshold can
+    # become unreachable — an unfired poison is a no-op, not a missed
+    # detection (invariant 1 still proves the chains stayed clean).
+    fired_poison = [kw_ for (k, kw_), h in zip(sched, handles)
+                    if k == "poison" and h is not None and h.fired]
+    unfired = sum(1 for (k, _), h in zip(sched, handles)
+                  if h is not None and not h.fired)
+    qlog = report["quarantine_log"]
+    if len(qlog) != len(fired_poison):
+        fails.append(f"seed {seed}: {len(fired_poison)} poison(s) fired "
+                     f"but {len(qlog)} quarantine(s) logged — detection "
+                     "missed the poisoned chunk")
+    victims = sorted(kw_["tenant"] for kw_ in fired_poison)
+    logged = sorted(ev["tenant_id"] for ev in qlog)
+    if victims != logged:
+        fails.append(f"seed {seed}: quarantined tenants {logged} != "
+                     f"poisoned tenants {victims}")
+
+    # 3. no unplanned steady retraces
+    unplanned = rc.unplanned("steady")
+    if unplanned:
+        fails.append(f"seed {seed}: {unplanned} unplanned steady "
+                     "retrace(s)")
+
+    # 4. counter/gauge consistency (device losses also counted as
+    # actually fired, same reasoning as invariant 2)
+    n_loss = sum(1 for (k, _), h in zip(sched, handles)
+                 if k == "device_loss" and h is not None and h.fired)
+    if report["quarantines"] != len(qlog):
+        fails.append(f"seed {seed}: quarantines counter "
+                     f"{report['quarantines']} != log {len(qlog)}")
+    if report["evacuations"] != n_loss:
+        fails.append(f"seed {seed}: evacuations {report['evacuations']} "
+                     f"!= injected device losses {n_loss}")
+    if report["service_retries"] > 2:
+        fails.append(f"seed {seed}: retry budget exceeded "
+                     f"({report['service_retries']})")
+    if svc.queue:
+        fails.append(f"seed {seed}: queue not drained "
+                     f"({len(svc.queue)} left)")
+
+    rec = {"seed": seed, "schedule": sched,
+           "quarantines": report["quarantines"],
+           "evacuations": report["evacuations"],
+           "evictions": report["evictions"],
+           "retries": report["service_retries"],
+           "chunks": report["chunks"],
+           "unplanned_retraces": unplanned,
+           "unfired_faults": unfired,
+           "ok": not fails}
+    return rec, fails
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="seeded chaos campaign over the serving tier")
+    ap.add_argument("--seeds", type=int, default=25,
+                    help="number of randomized fault schedules")
+    ap.add_argument("--quick", action="store_true",
+                    help="one fault per seed, no device-loss/storm "
+                    "draws (the ci_lint --chaos layer)")
+    ap.add_argument("--campaign-seed", type=int, default=0)
+    ap.add_argument("--outdir", default=None,
+                    help="work dir (default: a fresh temp dir)")
+    ap.add_argument("--json", action="store_true",
+                    help="print the full JSON report")
+    args = ap.parse_args(argv)
+
+    from pulsar_timing_gibbsspec_tpu.serve import ProgramCache
+
+    tmp = None
+    if args.outdir is None:
+        tmp = tempfile.mkdtemp(prefix="chaos_campaign_")
+        root = Path(tmp)
+    else:
+        root = Path(args.outdir)
+        root.mkdir(parents=True, exist_ok=True)
+
+    cache = ProgramCache()
+    ptas, storm_pta = _models()
+    print(f"[campaign] building {len(ptas)} solo baselines "
+          "(shared program cache) ...", flush=True)
+    solos = _solo_baselines(root, cache, ptas)
+    storm_solo = None
+    if not args.quick:
+        svc = _service(root / "solo_storm", cache)
+        job = svc.submit(storm_pta, NITER, job_id="solo_storm",
+                         tenant_id=len(TENANTS))
+        svc.run()
+        if job.state != "done":
+            raise RuntimeError("storm-tenant baseline failed")
+        storm_solo = (job.chain.copy(), job.bchain.copy())
+
+    records, failures = [], []
+    for seed in range(args.seeds):
+        rec, fails = _run_seed(seed, args, root, cache, ptas, storm_pta,
+                               solos, storm_solo)
+        records.append(rec)
+        failures.extend(fails)
+        tag = "ok" if not fails else "FAIL"
+        kinds = [k for k, _ in rec.get("schedule", [])]
+        print(f"[campaign] seed {seed:3d} {tag:4s} faults={kinds}",
+              flush=True)
+
+    report = {"seeds": args.seeds, "quick": bool(args.quick),
+              "campaign_seed": args.campaign_seed,
+              "passed": args.seeds - len({f.split(':')[0]
+                                          for f in failures}),
+              "failures": failures, "records": records}
+    if args.json:
+        print(json.dumps(report, indent=2, default=str))
+    if failures:
+        print(f"[campaign] {len(failures)} invariant failure(s):")
+        for f in failures:
+            print(f"  - {f}")
+    else:
+        print(f"[campaign] all {args.seeds} seeds held every invariant")
+    if tmp is not None:
+        shutil.rmtree(tmp, ignore_errors=True)
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

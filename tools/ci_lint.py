@@ -14,6 +14,12 @@ status — the same set the ``lint`` pytest marker covers:
                  ``PERF_LEDGER.jsonl`` plus the static cost-model
                  self-check (CPU tracing only, no device execution).
 
+With ``--chaos`` an optional fifth layer runs the quick seeded chaos
+campaign (``tools/chaos_campaign.py --quick --seeds 5``) — the serving
+tier's blast-radius invariants under randomized fault schedules.  It
+executes real (CPU) sampling, so it is opt-in rather than part of the
+static gate.
+
 Each layer runs in its own interpreter (jaxprcheck must configure the
 JAX platform before jax is first imported), so a crash in one cannot
 mask another.  Exit status is 0 only when every layer passes.
@@ -29,6 +35,9 @@ def main(argv=None) -> int:
 
     repo = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
     extra = list(argv) if argv is not None else sys.argv[1:]
+    chaos = "--chaos" in extra
+    if chaos:
+        extra = [a for a in extra if a != "--chaos"]
 
     layers = []
     exe = shutil.which("ruff")
@@ -46,6 +55,11 @@ def main(argv=None) -> int:
     layers.append(("perfwatch",
                    [sys.executable,
                     os.path.join("tools", "perfwatch.py"), "--check"]))
+    if chaos:
+        layers.append(("chaos",
+                       [sys.executable,
+                        os.path.join("tools", "chaos_campaign.py"),
+                        "--quick", "--seeds", "5"]))
 
     failed = []
     for name, cmd in layers:
