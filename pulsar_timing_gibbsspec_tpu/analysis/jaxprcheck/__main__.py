@@ -61,13 +61,19 @@ def main(argv=None) -> int:
 
     from ..baseline import (compare_to_baseline, load_baseline,
                             write_baseline)
-    from .runner import discover_contracts, run_contracts
+    from .runner import (check_contract_coverage, discover_contracts,
+                         run_contracts)
 
     contracts = discover_contracts(args.contracts, fast_only=args.fast)
     if not contracts:
         print("jaxprcheck: no contracts found", file=sys.stderr)
         return 2
     violations, facts = run_contracts(contracts)
+    if args.contracts is None:
+        # committed contract dir only (a test pointing --contracts at a
+        # fixture subset is not claiming repo-wide coverage); runs under
+        # --fast too — coverage enumerates all contracts either way
+        violations.extend(check_contract_coverage())
 
     if args.write_baseline:
         write_baseline(args.baseline, violations, _REPO_ROOT)

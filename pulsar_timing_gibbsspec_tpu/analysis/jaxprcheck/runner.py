@@ -82,6 +82,11 @@ def discover_contracts(root=None, fast_only=False) -> list:
     out = []
     for p in sorted(root.glob("*.json")):
         c = load_contract(p)
+        if "entry" not in c:
+            # contracts/ also holds non-jaxprcheck configs (racecheck's
+            # allowlists live there); only entry-bearing files are
+            # traceable contracts
+            continue
         if fast_only and not c.get("fast", False):
             continue
         out.append(c)
@@ -299,6 +304,25 @@ def run_contract(contract: dict):
         facts[kind] = chk_facts
         violations.extend(Violation(path, kind, m) for m in msgs)
     return violations, facts
+
+
+def check_contract_coverage(root=None) -> list:
+    """One ``coverage`` violation per jit entry builder in
+    :mod:`.entries` that no committed contract pins — a new compiled
+    program cannot land unaudited.  Enumerates ALL contracts (not just
+    the fast subset): a slow contract still covers its entry."""
+    from .entries import _ENTRIES
+
+    covered = {c["entry"].get("entry")
+               for c in discover_contracts(root)}
+    out = []
+    for kind in sorted(set(_ENTRIES) - covered):
+        out.append(Violation(
+            os.path.join("contracts", kind), "coverage",
+            f"jit entry builder {kind!r} (jaxprcheck/entries.py) has no "
+            "pinned contracts/*.json — add a contract before the "
+            "compiled program ships"))
+    return out
 
 
 def run_contracts(contracts):

@@ -1,0 +1,29 @@
+"""racecheck: static concurrency, signal-safety, and buffer-lifetime
+auditor for the serving runtime (docs/LINTING.md).
+
+The third static layer next to jaxlint (AST JAX discipline) and
+jaxprcheck (jaxpr/HLO contracts): whole-program invariants of the code
+*around* the compiled sampler — the watchdog worker thread, the
+preemption signal path, the donation protocol between scheduler and
+jitted mux, and the job/breaker state machines.  Pure ``ast`` over
+``runtime/``/``serve/``/``obs/``; the audited modules are never
+imported, so the gate runs anywhere in milliseconds with zero device
+(or even jax) involvement.
+
+Rules: L1 unguarded-shared-write, L2 lock-order-hazard,
+S1 signal-unsafe-call, C6 use-after-donate, M1 unknown-state,
+M2 unreachable-state, M3 undeclared-transition.
+Suppress a site with ``# racecheck: disable=<RULE>``; accept
+pre-existing debt in ``racecheck_baseline.json`` — each baselined
+(file, rule) pair must carry a one-line justification.
+"""
+
+from .model import RULES, Corpus, Finding, ModuleModel, build_corpus
+from .runner import (analyze_repo, analyze_sources, check_justifications,
+                     load_baseline_file, load_config, run_passes,
+                     write_baseline_file)
+
+__all__ = ["RULES", "Corpus", "Finding", "ModuleModel", "build_corpus",
+           "analyze_repo", "analyze_sources", "check_justifications",
+           "load_baseline_file", "load_config", "run_passes",
+           "write_baseline_file"]

@@ -1,0 +1,96 @@
+"""CLI: audit the runtime/serving layers, ratchet against the baseline.
+
+Usage::
+
+    python -m pulsar_timing_gibbsspec_tpu.analysis.racecheck [paths...]
+
+    --config PATH      contracts-style config (default
+                       <repo>/contracts/racecheck.json)
+    --json             machine-readable findings on stdout
+    --baseline PATH    ratchet file (default <repo>/racecheck_baseline.json)
+    --no-baseline      report every finding, ignore the ratchet
+    --write-baseline   accept current findings as the new baseline
+                       (existing justifications kept; new pairs get a
+                       TODO stub the gate rejects until filled in)
+
+Exit status 1 when findings beyond the baseline exist, when a stale
+baseline entry should be ratcheted down is *not* an error (reported),
+and when any baselined pair lacks a one-line justification.  Pure AST
+analysis: the audited modules are parsed, never imported — no jax, no
+threads, no signal handlers, no device.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+from .runner import (_REPO_ROOT, analyze_repo, check_justifications,
+                     load_baseline_file, load_config, write_baseline_file)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="racecheck",
+        description="static concurrency / signal-safety / buffer-lifetime "
+                    "auditor for the serving runtime (AST only, no import)")
+    ap.add_argument("paths", nargs="*",
+                    help="files or directories (default: config paths)")
+    ap.add_argument("--config", default=None, metavar="PATH")
+    ap.add_argument("--json", action="store_true", dest="as_json")
+    ap.add_argument("--baseline",
+                    default=str(_REPO_ROOT / "racecheck_baseline.json"))
+    ap.add_argument("--no-baseline", action="store_true")
+    ap.add_argument("--write-baseline", action="store_true")
+    args = ap.parse_args(argv)
+
+    config = load_config(args.config)
+    findings, analyzed = analyze_repo(args.paths or None, config)
+
+    if args.write_baseline:
+        data = write_baseline_file(args.baseline, findings, _REPO_ROOT)
+        todo = check_justifications(data)
+        print(f"racecheck: baseline written to {args.baseline} "
+              f"({len(findings)} finding(s), {len(todo)} justification(s) "
+              "to fill in)")
+        return 0
+
+    from ..baseline import compare_to_baseline
+
+    if args.no_baseline:
+        new, stale, missing = list(findings), [], []
+    else:
+        data = load_baseline_file(args.baseline)
+        new, stale = compare_to_baseline(findings, data["violations"],
+                                         _REPO_ROOT, set(analyzed))
+        missing = check_justifications(data)
+
+    if args.as_json:
+        print(json.dumps(
+            {"analyzed": analyzed,
+             "findings": [{"path": f.path, "line": f.line,
+                           "rule": f.rule, "msg": f.msg}
+                          for f in findings],
+             "new": len(new),
+             "missing_justifications": [list(m) for m in missing]},
+            indent=2, sort_keys=True))
+    else:
+        for f in new:
+            print(str(f))
+        for f, rule, base, cur in stale:
+            print(f"stale baseline entry: {f} [{rule}] baseline {base} "
+                  f"> current {cur}; ratchet the baseline down")
+        for f, rule in missing:
+            print(f"baselined without justification: {f} [{rule}] — add "
+                  f"a one-line reason under justifications in "
+                  f"{Path(args.baseline).name}")
+        ok = "OK" if not new and not missing else "FAIL"
+        print(f"racecheck: {len(analyzed)} file(s), {len(findings)} "
+              f"finding(s), {len(new)} new — {ok}")
+    return 1 if (new or missing) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,348 @@
+"""C6: use-after-donate — the host side of ``donate_argnums``.
+
+``jax.jit(fn, donate_argnums=...)`` invalidates the donated argument
+buffers the moment the call dispatches: the runtime may alias the
+output into the donated storage, and on the CPU backend the "buffer"
+is host heap — touching the stale reference afterwards is exactly the
+PR 13 corruption (intermittent segfaults in the chunk dispatch once
+the service re-read a donated mux carry).  jaxprcheck's ``donation``
+check proves the *device* side (outputs actually alias); this pass
+proves the *host* side: after a donating call, every donated argument
+name must be re-bound from the call's outputs (``x, b = mux(s, x, b,
+...)``) or never read again — a later read of the stale name is a C6
+finding.
+
+Donating callables are discovered three ways, all static:
+
+1. a direct binding ``mux = jax.jit(body, donate_argnums=(1, 2))``;
+2. a *factory* — a function whose ``return`` is such a jit call
+   (``serve/engine.make_mux``) — makes every ``g = make_mux(n)``
+   binding a donating callable with the same positions (positions are
+   the union over the factory's returns: a branch that disables
+   donation on one backend does not make the host pattern safe on the
+   others);
+3. an immediately-invoked ``jax.jit(..., donate_argnums=...)(args)``.
+
+The walk is branch-aware: ``if``/``try`` arms run on copies of the
+liveness state and a name dead in any surviving arm stays dead at the
+join; a ``return``/``raise`` arm drops out of the join.  Re-binding
+(any assignment to the name, including attribute targets) revives it.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .model import Corpus, Finding, ModuleModel, qualname
+
+_JIT_NAMES = {"jax.jit", "jit"}
+
+
+def _int_elems(node):
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                out.append(e.value)
+        return out
+    return []
+
+
+def _str_elems(node):
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return [e.value for e in node.elts
+                if isinstance(e, ast.Constant) and isinstance(e.value, str)]
+    return []
+
+
+def _jit_donation(mod: ModuleModel, call: ast.Call):
+    """``(argnums, argnames)`` of a donating jit call, else None."""
+    if not isinstance(call, ast.Call):
+        return None
+    if mod.expand(qualname(call.func)) not in _JIT_NAMES:
+        return None
+    nums, names = set(), set()
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            nums.update(_int_elems(kw.value))
+        elif kw.arg == "donate_argnames":
+            names.update(_str_elems(kw.value))
+    if not nums and not names:
+        return None
+    return frozenset(nums), frozenset(names)
+
+
+def _collect_factories(corpus: Corpus) -> dict:
+    """id(fndef) -> (argnums, argnames) for functions returning a
+    donating jit call (union over all returns)."""
+    out: dict = {}
+    for mod in corpus.modules.values():
+        for fn in mod.all_defs:
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            nums, names = set(), set()
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Return) and node.value is not None:
+                    got = _jit_donation(mod, node.value) \
+                        if isinstance(node.value, ast.Call) else None
+                    if got:
+                        nums |= got[0]
+                        names |= got[1]
+            if nums or names:
+                out[id(fn)] = (frozenset(nums), frozenset(names))
+    return out
+
+
+def _terminates(stmts) -> bool:
+    return bool(stmts) and isinstance(
+        stmts[-1], (ast.Return, ast.Raise, ast.Break, ast.Continue))
+
+
+def _assign_targets(stmt):
+    """Flat token list of assignment-target names/attribute chains."""
+    def flat(t):
+        if isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                yield from flat(e)
+        else:
+            q = qualname(t)
+            if q is not None:
+                yield q
+
+    out = []
+    if isinstance(stmt, ast.Assign):
+        for t in stmt.targets:
+            out.extend(flat(t))
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        out.extend(flat(stmt.target))
+    elif isinstance(stmt, ast.Delete):
+        for t in stmt.targets:
+            out.extend(flat(t))
+    elif isinstance(stmt, ast.For):
+        out.extend(flat(stmt.target))
+    elif isinstance(stmt, ast.With):
+        for it in stmt.items:
+            if it.optional_vars is not None:
+                out.extend(flat(it.optional_vars))
+    return out
+
+
+class _Liveness:
+    __slots__ = ("donors", "dead")
+
+    def __init__(self, donors=None, dead=None):
+        #: callable token -> (argnums, argnames)
+        self.donors: dict = dict(donors or {})
+        #: donated token -> (line, callee display)
+        self.dead: dict = dict(dead or {})
+
+    def copy(self):
+        return _Liveness(self.donors, self.dead)
+
+
+class _FnDonateScan:
+    def __init__(self, mod: ModuleModel, corpus: Corpus, factories: dict,
+                 findings: list):
+        self.mod = mod
+        self.corpus = corpus
+        self.factories = factories
+        self.findings = findings
+
+    # -- expression-level helpers -------------------------------------------
+
+    def _walk_exprs(self, node):
+        """Expression-tree walk that skips nested defs/lambdas."""
+        stack = [node]
+        while stack:
+            cur = stack.pop()
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda, ast.ClassDef)):
+                continue
+            yield cur
+            stack.extend(ast.iter_child_nodes(cur))
+
+    def _check_reads(self, node, st: _Liveness):
+        if not st.dead:
+            return
+        for cur in self._walk_exprs(node):
+            if not isinstance(cur, (ast.Name, ast.Attribute)):
+                continue
+            if not isinstance(getattr(cur, "ctx", None), ast.Load):
+                continue
+            q = qualname(cur)
+            if q in st.dead:
+                line, callee = st.dead.pop(q)
+                self.findings.append(Finding(
+                    self.mod.path, cur.lineno, "C6",
+                    f"'{q}' is read after being donated to '{callee}' "
+                    f"(line {line}): the buffer may already be aliased "
+                    "by the call's outputs — re-bind the name from the "
+                    "results or copy before the donating call"))
+
+    def _donation_of(self, call: ast.Call, st: _Liveness):
+        """(argnums, argnames) when ``call`` donates, else None."""
+        direct = _jit_donation(self.mod, call)
+        if direct:
+            return direct
+        tok = qualname(call.func)
+        if tok in st.donors:
+            return st.donors[tok]
+        # immediately-invoked jitted callable: jax.jit(f, donate...)(x)
+        if isinstance(call.func, ast.Call):
+            return _jit_donation(self.mod, call.func)
+        return None
+
+    def _kills(self, node, st: _Liveness):
+        """Tokens a statement's donating calls invalidate."""
+        killed: dict = {}
+        for cur in self._walk_exprs(node):
+            if not isinstance(cur, ast.Call):
+                continue
+            got = self._donation_of(cur, st)
+            if not got:
+                continue
+            nums, names = got
+            callee = qualname(cur.func) or "<jit>"
+            for i in nums:
+                if i < len(cur.args):
+                    q = qualname(cur.args[i])
+                    if q is not None:
+                        killed[q] = (cur.lineno, callee)
+            for kw in cur.keywords:
+                if kw.arg in names:
+                    q = qualname(kw.value)
+                    if q is not None:
+                        killed[q] = (cur.lineno, callee)
+        return killed
+
+    def _donor_from_value(self, value, st: _Liveness):
+        """Donation spec when ``value`` evaluates to a donating
+        callable (a donating jit call, or a factory call)."""
+        if not isinstance(value, ast.Call):
+            return None
+        got = _jit_donation(self.mod, value)
+        if got:
+            return got
+        res = self.corpus.resolve_call(self.mod, value)
+        if res[0] == "func" and id(res[2]) in self.factories:
+            return self.factories[id(res[2])]
+        return None
+
+    # -- statement walk -----------------------------------------------------
+
+    def walk(self, stmts, st: _Liveness):
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            if isinstance(stmt, ast.If):
+                self._simple(stmt.test, st)
+                a, b = st.copy(), st.copy()
+                self.walk(stmt.body, a)
+                self.walk(stmt.orelse, b)
+                self._merge(st, [(a, _terminates(stmt.body))],
+                            [(b, _terminates(stmt.orelse)
+                              if stmt.orelse else False)])
+            elif isinstance(stmt, (ast.For, ast.While)):
+                header = stmt.iter if isinstance(stmt, ast.For) \
+                    else stmt.test
+                self._simple(header, st)
+                for tok in _assign_targets(stmt):
+                    st.dead.pop(tok, None)
+                a = st.copy()
+                self.walk(stmt.body, a)
+                self.walk(stmt.orelse, a)
+                self._merge(st, [(a, False)], [])
+            elif isinstance(stmt, ast.With):
+                for it in stmt.items:
+                    self._simple(it.context_expr, st)
+                for tok in _assign_targets(stmt):
+                    st.dead.pop(tok, None)
+                self.walk(stmt.body, st)
+            elif isinstance(stmt, ast.Try):
+                arms = []
+                a = st.copy()
+                self.walk(stmt.body, a)
+                self.walk(stmt.orelse, a)
+                arms.append((a, _terminates(stmt.body + stmt.orelse)))
+                for h in stmt.handlers:
+                    b = st.copy()
+                    self.walk(h.body, b)
+                    arms.append((b, _terminates(h.body)))
+                self._merge(st, arms, [])
+                self.walk(stmt.finalbody, st)
+            else:
+                self._statement(stmt, st)
+
+    def _merge(self, st: _Liveness, arms_a, arms_b):
+        """Join: dead in any surviving arm stays dead; donors union."""
+        st.dead.clear()
+        st.donors.clear()
+        for arm, terminated in arms_a + arms_b:
+            if terminated:
+                continue
+            for k, v in arm.dead.items():
+                st.dead.setdefault(k, v)
+            for k, v in arm.donors.items():
+                st.donors.setdefault(k, v)
+
+    def _simple(self, node, st: _Liveness):
+        """Reads-then-kills over one expression (no revival targets)."""
+        self._check_reads(node, st)
+        for tok, info in self._kills(node, st).items():
+            st.dead[tok] = info
+
+    def _statement(self, stmt, st: _Liveness):
+        self._check_reads(stmt, st)
+        killed = self._kills(stmt, st)
+        targets = set(_assign_targets(stmt))
+        for tok in targets:
+            st.dead.pop(tok, None)
+            st.donors.pop(tok, None)
+        for tok, info in killed.items():
+            if tok not in targets:
+                st.dead[tok] = info
+        # new donor bindings: mux = jax.jit(...donate...) / make_mux(n)
+        value = getattr(stmt, "value", None)
+        if isinstance(stmt, ast.Assign) and value is not None:
+            spec = self._donor_from_value(value, st)
+            if spec is not None:
+                for tok in targets:
+                    st.donors[tok] = spec
+
+
+def check_donate(corpus: Corpus) -> list:
+    """All C6 findings over the corpus."""
+    findings: list = []
+    factories = _collect_factories(corpus)
+    for mod in corpus.modules.values():
+        # module-level donors (mux = jax.jit(..., donate_argnums=...))
+        seed = _Liveness()
+        for stmt in mod.tree.body:
+            if isinstance(stmt, ast.Assign) and \
+                    isinstance(stmt.value, ast.Call):
+                spec = _jit_donation(mod, stmt.value)
+                if spec is None:
+                    res = corpus.resolve_call(mod, stmt.value)
+                    if res[0] == "func" and id(res[2]) in factories:
+                        spec = factories[id(res[2])]
+                if spec is not None:
+                    for t in stmt.targets:
+                        q = qualname(t)
+                        if q is not None:
+                            seed.donors[q] = spec
+        scan = _FnDonateScan(mod, corpus, factories, findings)
+        # module body (scripts/fixtures) and every function body
+        st = _Liveness(seed.donors)
+        scan.walk([s for s in mod.tree.body
+                   if not isinstance(s, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef,
+                                         ast.ClassDef))], st)
+        for fn in mod.all_defs:
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scan.walk(fn.body, _Liveness(seed.donors))
+    return findings

@@ -1,0 +1,313 @@
+"""L1/L2: lock discipline over the module-global registries.
+
+**L1 — unguarded shared write.**  Every *write* (rebinding through a
+``global`` declaration, subscript store/delete, in-place mutator call)
+to a shared mutable module global must happen lexically inside a
+``with <module-lock>:`` block.  The runtime registries are touched
+from the watchdog worker thread (trace spans run inside the dispatch
+closure), the signal path, and the between-chunk scheduler, so an
+unguarded ``_tids[ident] = ...`` is a real torn-dict hazard, not
+style.  Unguarded *reads* are deliberately out of scope: under
+CPython's GIL a single reference load is atomic, and the hot paths
+(``drain_requested``, the span fast path) rely on exactly that —
+flagging them would bury the signal.
+
+**L2 — lock-order hazard.**  A graph of "acquired B while holding A"
+edges, built per function and propagated through corpus-resolvable
+calls (so ``with a_lock: helper()`` where ``helper`` takes ``b_lock``
+contributes the A->B edge).  A cycle in the graph is a potential
+deadlock; re-acquiring a held non-reentrant ``Lock`` (directly or via
+a call chain) is the degenerate self-cycle and is flagged at the site.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .model import (MUTATORS, Corpus, Finding, ModuleModel, qualname,
+                    walk_excluding_defs)
+
+
+def _local_binds(fn) -> set:
+    """Names bound in ``fn``'s own scope (parameters, assignments, loop
+    targets, with-as, except-as, nested def/class names) — they shadow
+    same-named module globals, so writes through them are not shared
+    state."""
+    out: set = set()
+    a = fn.args
+    for arg in [*a.posonlyargs, *a.args, *a.kwonlyargs]:
+        out.add(arg.arg)
+    if a.vararg is not None:
+        out.add(a.vararg.arg)
+    if a.kwarg is not None:
+        out.add(a.kwarg.arg)
+
+    def names_of(t):
+        if isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                yield from names_of(e)
+        elif isinstance(t, ast.Name):
+            yield t.id
+
+    for node in walk_excluding_defs(fn):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            out.add(node.name)
+        elif isinstance(node, ast.Assign):
+            for t in node.targets:
+                out.update(names_of(t))
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            out.update(names_of(node.target))
+        elif isinstance(node, ast.For):
+            out.update(names_of(node.target))
+        elif isinstance(node, ast.withitem):
+            if node.optional_vars is not None:
+                out.update(names_of(node.optional_vars))
+        elif isinstance(node, ast.ExceptHandler):
+            if node.name:
+                out.add(node.name)
+    return out
+
+
+def _held_locks(mod: ModuleModel, items) -> set:
+    """Module-lock names acquired by a ``with`` statement's items."""
+    got = set()
+    for it in items:
+        q = qualname(it.context_expr)
+        if q in mod.locks:
+            got.add(q)
+    return got
+
+
+def _expr_mutations(mod: ModuleModel, node, shadowed=frozenset()):
+    """(name, node) for in-place mutator calls on shared globals inside
+    an expression tree (nested defs excluded — defining is not calling)."""
+    for cur in walk_excluding_defs(node):
+        if not isinstance(cur, ast.Call) or \
+                not isinstance(cur.func, ast.Attribute):
+            continue
+        base = cur.func.value
+        if isinstance(base, ast.Name) and base.id in mod.shared \
+                and base.id not in shadowed and cur.func.attr in MUTATORS:
+            yield base.id, cur
+
+
+def _stmt_writes(mod: ModuleModel, stmt, global_decls: set,
+                 shadowed=frozenset()):
+    """(name, node) writes to shared globals in one simple statement."""
+    yield from _expr_mutations(mod, stmt, shadowed)
+
+    def targets_of(t):
+        if isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                yield from targets_of(e)
+        else:
+            yield t
+
+    tgts = []
+    if isinstance(stmt, ast.Assign):
+        for t in stmt.targets:
+            tgts.extend(targets_of(t))
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        tgts.extend(targets_of(stmt.target))
+    elif isinstance(stmt, ast.Delete):
+        for t in stmt.targets:
+            tgts.extend(targets_of(t))
+    for t in tgts:
+        if isinstance(t, ast.Name):
+            if t.id in mod.shared and t.id in global_decls:
+                yield t.id, t
+        elif isinstance(t, (ast.Subscript, ast.Attribute)):
+            base = t.value
+            if isinstance(base, ast.Name) and base.id in mod.shared \
+                    and base.id not in shadowed:
+                yield base.id, t
+
+
+class _FnLockScan:
+    """One function scope: L1 sites, local acquisitions, call sites
+    annotated with the locks held around them."""
+
+    def __init__(self, mod: ModuleModel, corpus: Corpus, fn):
+        self.mod = mod
+        self.corpus = corpus
+        self.fn = fn
+        self.global_decls = mod.global_names(fn)
+        self.shadowed = _local_binds(fn) - self.global_decls
+        self.l1: list = []           # (name, node)
+        self.acquires: set = set()   # lock ids ever taken in this scope
+        self.order_edges: list = []  # (held_id, taken_id, node)
+        self.self_reacquire: list = []     # (lock_id, node)
+        self.calls: list = []        # (resolved, frozenset(held_ids), node)
+
+    def _lock_id(self, name: str) -> str:
+        return f"{self.mod.modname}.{name}"
+
+    def run(self):
+        self._walk(self.fn.body, frozenset())
+        return self
+
+    def _scan_expr(self, node, held):
+        for name, site in _expr_mutations(self.mod, node, self.shadowed):
+            if not held:
+                self.l1.append((name, site))
+        self._scan_calls(node, held)
+
+    def _scan_calls(self, node, held):
+        for cur in walk_excluding_defs(node):
+            if not isinstance(cur, ast.Call):
+                continue
+            # bare ``X.acquire()`` on a module lock counts as taking it
+            # (scope-less: it feeds the transitive summary, not ``held``)
+            if isinstance(cur.func, ast.Attribute) and \
+                    cur.func.attr == "acquire" and \
+                    isinstance(cur.func.value, ast.Name) and \
+                    cur.func.value.id in self.mod.locks:
+                lid = self._lock_id(cur.func.value.id)
+                for h in held:
+                    if h == lid:
+                        self.self_reacquire.append((lid, cur))
+                    else:
+                        self.order_edges.append((h, lid, cur))
+                self.acquires.add(lid)
+                continue
+            res = self.corpus.resolve_call(self.mod, cur)
+            if res[0] == "func":
+                self.calls.append((res, held, cur))
+
+    def _walk(self, stmts, held):
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            if isinstance(stmt, ast.With):
+                new = _held_locks(self.mod, stmt.items)
+                for it in stmt.items:
+                    self._scan_expr(it.context_expr, held)
+                new_ids = set()
+                for name in new:
+                    lid = self._lock_id(name)
+                    if lid in held and self.mod.locks[name] == "Lock":
+                        self.self_reacquire.append((lid, stmt))
+                    for h in held:
+                        if h != lid:
+                            self.order_edges.append((h, lid, stmt))
+                    new_ids.add(lid)
+                self.acquires.update(new_ids)
+                self._walk(stmt.body, held | frozenset(new_ids))
+            elif isinstance(stmt, ast.If):
+                self._scan_expr(stmt.test, held)
+                self._walk(stmt.body, held)
+                self._walk(stmt.orelse, held)
+            elif isinstance(stmt, ast.While):
+                self._scan_expr(stmt.test, held)
+                self._walk(stmt.body, held)
+                self._walk(stmt.orelse, held)
+            elif isinstance(stmt, ast.For):
+                self._scan_expr(stmt.iter, held)
+                self._walk(stmt.body, held)
+                self._walk(stmt.orelse, held)
+            elif isinstance(stmt, ast.Try):
+                self._walk(stmt.body, held)
+                for h in stmt.handlers:
+                    self._walk(h.body, held)
+                self._walk(stmt.orelse, held)
+                self._walk(stmt.finalbody, held)
+            else:
+                for name, site in _stmt_writes(self.mod, stmt,
+                                               self.global_decls,
+                                               self.shadowed):
+                    if not held:
+                        self.l1.append((name, site))
+                self._scan_calls(stmt, held)
+
+
+def check_locks(corpus: Corpus) -> list:
+    """All L1/L2 findings over the corpus."""
+    findings: list = []
+    lock_kinds: dict = {}
+    for mod in corpus.modules.values():
+        for name, kind in mod.locks.items():
+            lock_kinds[f"{mod.modname}.{name}"] = kind
+
+    scans: dict = {}
+    for mod in corpus.modules.values():
+        for fn in mod.all_defs:
+            scans[id(fn)] = _FnLockScan(mod, corpus, fn).run()
+
+    # L1
+    for scan in scans.values():
+        for name, site in scan.l1:
+            mod = scan.mod
+            avail = ", ".join(sorted(mod.locks)) or "none defined"
+            findings.append(Finding(
+                mod.path, getattr(site, "lineno", 0), "L1",
+                f"write to shared module global '{name}' outside any "
+                f"module-lock 'with' block (module locks: {avail}); "
+                "the registry is reachable from the watchdog worker "
+                "thread / signal path"))
+
+    # transitive acquire summaries (fixpoint over the call graph)
+    trans = {k: set(s.acquires) for k, s in scans.items()}
+    changed = True
+    while changed:
+        changed = False
+        for k, scan in scans.items():
+            for (res, _held, _node) in scan.calls:
+                callee = id(res[2])
+                if callee in trans and not trans[callee] <= trans[k]:
+                    trans[k] |= trans[callee]
+                    changed = True
+
+    # L2 self-reacquire: direct, and through a call chain
+    edges: dict = {}   # (a, b) -> (path, line)
+    for scan in scans.values():
+        for lid, node in scan.self_reacquire:
+            findings.append(Finding(
+                scan.mod.path, getattr(node, "lineno", 0), "L2",
+                f"re-acquisition of non-reentrant lock '{lid}' while "
+                "already held — self-deadlock"))
+        for (a, b, node) in scan.order_edges:
+            edges.setdefault((a, b),
+                             (scan.mod.path, getattr(node, "lineno", 0)))
+        for (res, held, node) in scan.calls:
+            callee_locks = trans.get(id(res[2]), set())
+            for h in held:
+                for t in callee_locks:
+                    if t == h:
+                        if lock_kinds.get(h) == "Lock":
+                            findings.append(Finding(
+                                scan.mod.path, getattr(node, "lineno", 0),
+                                "L2",
+                                f"call to '{res[3]}' can re-acquire "
+                                f"non-reentrant lock '{h}' already held "
+                                "here — self-deadlock"))
+                    else:
+                        edges.setdefault(
+                            (h, t),
+                            (scan.mod.path, getattr(node, "lineno", 0)))
+
+    # L2 cycles in the acquired-while-holding graph
+    graph: dict = {}
+    for (a, b) in edges:
+        graph.setdefault(a, set()).add(b)
+    seen_cycles: set = set()
+    for start in sorted(graph):
+        stack = [(start, (start,))]
+        while stack:
+            cur, path = stack.pop()
+            for nxt in graph.get(cur, ()):
+                if nxt == start:
+                    cyc = frozenset(path)
+                    if cyc in seen_cycles:
+                        continue
+                    seen_cycles.add(cyc)
+                    p, line = edges[(cur, start)]
+                    order = " -> ".join(path + (start,))
+                    findings.append(Finding(
+                        p, line, "L2",
+                        f"lock-order cycle: {order} — two threads taking "
+                        "these locks in opposite orders can deadlock"))
+                elif nxt not in path:
+                    stack.append((nxt, path + (nxt,)))
+    return findings

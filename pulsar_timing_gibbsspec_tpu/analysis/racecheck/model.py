@@ -1,0 +1,322 @@
+"""The shared program model racecheck's passes walk.
+
+Everything here is plain :mod:`ast` — the checked modules are parsed,
+never imported, so auditing ``runtime/``/``serve/`` cannot initialize
+jax, spin up the watchdog thread, or install signal handlers as a side
+effect.  One :class:`Corpus` holds a :class:`ModuleModel` per file and
+resolves cross-module calls through each module's import alias map
+(relative imports included — the runtime layers import each other as
+``from . import telemetry`` / ``from ..obs import trace as otrace``),
+which is what lets the signal pass follow the handler into
+``telemetry.incr`` and the lock pass summarize callee acquisitions
+across files.
+
+Per module the model records the concurrency-relevant surface:
+
+- **locks** — module-level ``X = threading.Lock()`` / ``RLock()``
+  assigns (the repo convention for registry guards), with their kind:
+  the signal pass treats ``RLock`` acquisition as reentrancy-safe and
+  plain ``Lock`` as a self-deadlock hazard;
+- **shared mutable globals** — module-level dict/list/set/deque
+  displays or constructor calls, plus any name a function rebinds
+  through a ``global`` declaration (the ``_enabled``/``_dropped``
+  scalar flags);
+- **functions** — every def (nested included) with parent links, so
+  handlers registered as closures (``preemption.install``'s
+  ``_handler``) are first-class call-graph roots.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from pathlib import Path
+
+RULES = {
+    "L1": "unguarded-shared-write",
+    "L2": "lock-order-hazard",
+    "S1": "signal-unsafe-call",
+    "C6": "use-after-donate",
+    "M1": "unknown-state",
+    "M2": "unreachable-state",
+    "M3": "undeclared-transition",
+}
+
+_PRAGMA_RE = re.compile(r"#\s*racecheck:\s*disable=([A-Za-z0-9,\s]+)")
+
+#: constructor calls whose module-level result is shared mutable state
+_MUTABLE_CTORS = {
+    "dict", "list", "set", "collections.deque", "collections.OrderedDict",
+    "collections.defaultdict", "collections.Counter", "deque",
+    "OrderedDict", "defaultdict", "Counter",
+}
+
+#: method names that mutate their receiver in place
+MUTATORS = {
+    "append", "appendleft", "extend", "extendleft", "insert", "add",
+    "update", "setdefault", "pop", "popleft", "popitem", "remove",
+    "discard", "clear", "sort", "reverse",
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    path: str
+    line: int
+    rule: str
+    msg: str
+
+    def __str__(self):
+        return (f"{self.path}:{self.line}: {self.rule} "
+                f"[{RULES[self.rule]}] {self.msg}")
+
+
+def pragma_rules(line: str) -> set:
+    """Rules a trailing ``# racecheck: disable=...`` comment suppresses."""
+    m = _PRAGMA_RE.search(line)
+    if not m:
+        return set()
+    return {r.strip().upper() for r in m.group(1).split(",") if r.strip()}
+
+
+def qualname(node):
+    """Dotted display name of a Name/Attribute chain, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _modname_for(relpath: str) -> str:
+    """Dotted module name of a repo-relative posix path."""
+    p = relpath[:-3] if relpath.endswith(".py") else relpath
+    parts = [s for s in p.split("/") if s]
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
+class ModuleModel:
+    """One parsed module and its concurrency-relevant surface."""
+
+    def __init__(self, src: str, path: str, modname: str | None = None):
+        self.path = path
+        self.modname = modname if modname is not None else _modname_for(path)
+        self.package = self.modname.rsplit(".", 1)[0] \
+            if "." in self.modname else ""
+        self.src_lines = src.splitlines()
+        self.tree = ast.parse(src, filename=path)
+        self.parents: dict = {}
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                self.parents[child] = node
+        self.aliases = self._collect_aliases()
+        self.locks = self._collect_locks()
+        self.shared = self._collect_shared()
+        self.functions: dict[str, ast.FunctionDef] = {}
+        self.all_defs: list = []
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.all_defs.append(node)
+                # module-level functions are call-resolution targets;
+                # methods/nested defs stay reachable via all_defs
+                if isinstance(self.parents.get(node), ast.Module):
+                    self.functions[node.name] = node
+
+    # -- imports ------------------------------------------------------------
+
+    def _collect_aliases(self) -> dict:
+        """name -> absolute dotted target, relative imports resolved
+        against this module's package (function-local imports included —
+        the runtime layers import jax lazily)."""
+        out: dict = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    out[a.asname or a.name.split(".")[0]] = (
+                        a.name if a.asname else a.name.split(".")[0])
+            elif isinstance(node, ast.ImportFrom):
+                base = node.module or ""
+                if node.level:
+                    pkg = self.modname.split(".")
+                    # level 1 = this package, 2 = its parent, ...
+                    pkg = pkg[:len(pkg) - node.level]
+                    base = ".".join(pkg + ([base] if base else []))
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    tgt = f"{base}.{a.name}" if base else a.name
+                    out[a.asname or a.name] = tgt
+        return out
+
+    def expand(self, dotted: str | None) -> str | None:
+        """Alias-expand the head of a dotted display name
+        (``otrace.instant`` -> ``pkg.obs.trace.instant``)."""
+        if dotted is None:
+            return None
+        head, _, rest = dotted.partition(".")
+        tgt = self.aliases.get(head)
+        if tgt is None:
+            return dotted
+        return f"{tgt}.{rest}" if rest else tgt
+
+    # -- module-level concurrency surface -----------------------------------
+
+    def _collect_locks(self) -> dict:
+        """Module-level ``X = threading.Lock()/RLock()`` -> kind."""
+        out: dict = {}
+        for node in self.tree.body:
+            if not isinstance(node, ast.Assign) or \
+                    not isinstance(node.value, ast.Call):
+                continue
+            callee = self.expand(qualname(node.value.func))
+            if callee not in ("threading.Lock", "threading.RLock",
+                              "Lock", "RLock"):
+                continue
+            kind = "RLock" if callee.endswith("RLock") else "Lock"
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    out[t.id] = kind
+        return out
+
+    def _collect_shared(self) -> dict:
+        """Shared mutable module globals: name -> defining line."""
+        out: dict = {}
+        for node in self.tree.body:
+            targets, value = [], None
+            if isinstance(node, ast.Assign):
+                targets, value = node.targets, node.value
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                targets, value = [node.target], node.value
+            if not targets:
+                continue
+            mutable = isinstance(value, (ast.Dict, ast.List, ast.Set))
+            if isinstance(value, ast.Call):
+                callee = self.expand(qualname(value.func))
+                mutable = callee in _MUTABLE_CTORS
+            if not mutable:
+                continue
+            for t in targets:
+                if isinstance(t, ast.Name) and t.id not in self.locks:
+                    out[t.id] = node.lineno
+        # names rebound through ``global`` are shared process state even
+        # when scalar (flags, counters, the sink reference)
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Global):
+                for name in node.names:
+                    if name not in self.locks:
+                        out.setdefault(name, node.lineno)
+        return out
+
+    def global_names(self, fn) -> set:
+        """Names ``fn`` declares ``global`` (its own body only)."""
+        out: set = set()
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node is not fn:
+                continue
+            if isinstance(node, ast.Global):
+                out.update(node.names)
+        return out
+
+    def enclosing_class(self, node):
+        """Nearest enclosing ClassDef name, or None."""
+        cur = self.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, ast.ClassDef):
+                return cur.name
+            cur = self.parents.get(cur)
+        return None
+
+    def line(self, lineno: int) -> str:
+        if 0 < lineno <= len(self.src_lines):
+            return self.src_lines[lineno - 1]
+        return ""
+
+
+def body_statements(fn) -> list:
+    """The statement list of a def (excluding nested defs' bodies is the
+    walker's job; this is just the top-level list)."""
+    return list(fn.body)
+
+
+def walk_excluding_defs(node):
+    """``ast.walk`` over a function body that does not descend into
+    nested function/class definitions (defining is not calling)."""
+    stack = list(ast.iter_child_nodes(node)) if isinstance(
+        node, (ast.FunctionDef, ast.AsyncFunctionDef)) else [node]
+    while stack:
+        cur = stack.pop()
+        yield cur
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.Lambda, ast.ClassDef)):
+            continue
+        stack.extend(ast.iter_child_nodes(cur))
+
+
+class Corpus:
+    """All analyzed modules, indexed for cross-module call resolution."""
+
+    def __init__(self, modules: list):
+        self.modules = {m.modname: m for m in modules}
+        self.by_path = {m.path: m for m in modules}
+
+    def resolve_call(self, mod: ModuleModel, call: ast.Call):
+        """Resolve a call site to a corpus function when possible.
+
+        Returns ``("func", module, fndef, display)`` for a function
+        defined in the corpus, ``("external", dotted, None, display)``
+        for an alias-expanded external dotted name, or
+        ``("opaque", None, None, display)`` when the callee cannot be
+        named statically (method on a runtime object, subscript, ...).
+        """
+        display = qualname(call.func)
+        if display is None:
+            return ("opaque", None, None, None)
+        # bare local function name
+        if "." not in display and display in mod.functions:
+            return ("func", mod, mod.functions[display], display)
+        expanded = mod.expand(display)
+        # alias to another corpus module's function:
+        #   from . import telemetry; telemetry.incr(...)
+        #   from .telemetry import incr; incr(...)
+        if "." in expanded:
+            owner, _, fname = expanded.rpartition(".")
+            target = self.modules.get(owner)
+            if target is not None and fname in target.functions:
+                return ("func", target, target.functions[fname], display)
+        if expanded != display or "." in display:
+            return ("external", expanded, None, display)
+        return ("external", display, None, display)
+
+
+def build_corpus(sources: dict) -> Corpus:
+    """Corpus from ``{repo-relative-path: source}`` (test entry point)."""
+    return Corpus([ModuleModel(src, path) for path, src in sources.items()])
+
+
+def iter_py_files(paths):
+    for p in paths:
+        p = Path(p)
+        if p.is_dir():
+            yield from sorted(p.rglob("*.py"))
+        elif p.suffix == ".py":
+            yield p
+
+
+def load_corpus(paths, root: Path) -> Corpus:
+    """Corpus over the .py files under ``paths``; module names derive
+    from the path relative to the repo ``root``."""
+    mods = []
+    for f in iter_py_files(paths):
+        try:
+            rel = f.resolve().relative_to(root.resolve()).as_posix()
+        except ValueError:
+            rel = f.as_posix()
+        mods.append(ModuleModel(f.read_text(), rel))
+    return Corpus(mods)

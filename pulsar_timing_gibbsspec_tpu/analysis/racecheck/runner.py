@@ -1,0 +1,136 @@
+"""Pass orchestration, config, and the justified baseline ratchet.
+
+``contracts/racecheck.json`` pins everything reviewable about the
+auditor: the analyzed paths, the signal-safety allow/ban prefixes, and
+the declared state machines — widening any of them is a diff to a
+committed contract, mirroring how jaxprcheck pins budgets.
+
+``racecheck_baseline.json`` extends the shared :mod:`..baseline`
+ratchet with one extra obligation: every baselined ``(file, rule)``
+pair must carry a one-line justification under ``justifications``
+(key ``"<file> [<rule>]"``).  A count with a missing/empty/TODO
+justification fails the gate even when the ratchet itself is
+satisfied — accepted debt must say *why* it is acceptable (e.g.
+"main-thread-only by the CPython ``signal.signal`` constraint"), not
+just that it is old.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+from .donate import check_donate
+from .locks import check_locks
+from .model import (RULES, Corpus, Finding, build_corpus, load_corpus,
+                    pragma_rules)
+from .signals import check_signals
+from .states import check_states
+
+_REPO_ROOT = Path(__file__).resolve().parents[3]
+DEFAULT_CONFIG = _REPO_ROOT / "contracts" / "racecheck.json"
+BASELINE_NAME = "racecheck_baseline.json"
+
+#: analyzed when the config has no ``paths`` (repo-relative)
+DEFAULT_PATHS = ("pulsar_timing_gibbsspec_tpu/runtime",
+                 "pulsar_timing_gibbsspec_tpu/serve",
+                 "pulsar_timing_gibbsspec_tpu/obs")
+
+
+def load_config(path=None) -> dict:
+    p = Path(path) if path is not None else DEFAULT_CONFIG
+    if not p.exists():
+        return {}
+    return json.loads(p.read_text())
+
+
+def run_passes(corpus: Corpus, config: dict | None = None) -> list:
+    """All findings over a corpus, pragma-suppressed and sorted."""
+    config = config or {}
+    findings: list[Finding] = []
+    findings += check_locks(corpus)
+    findings += check_signals(corpus, config)
+    findings += check_donate(corpus)
+    findings += check_states(corpus, config)
+    out = []
+    for f in findings:
+        mod = corpus.by_path.get(f.path)
+        line = mod.line(f.line) if mod is not None else ""
+        disabled = pragma_rules(line)
+        if f.rule in disabled or "ALL" in disabled:
+            continue
+        out.append(f)
+    out.sort(key=lambda f: (f.path, f.line, f.rule))
+    return out
+
+
+def analyze_sources(sources: dict, config: dict | None = None) -> list:
+    """Findings over in-memory ``{path: source}`` modules (the test
+    fixture entry point — no filesystem, no config file)."""
+    return run_passes(build_corpus(sources), config)
+
+
+def analyze_repo(paths=None, config: dict | None = None,
+                 root: Path | None = None):
+    """(findings, analyzed_files) over on-disk paths; ``paths``
+    defaults to the config's ``paths`` (repo-relative)."""
+    root = root if root is not None else _REPO_ROOT
+    config = config if config is not None else load_config()
+    rels = paths if paths else config.get("paths", list(DEFAULT_PATHS))
+    abspaths = [root / p if not Path(p).is_absolute() else Path(p)
+                for p in rels]
+    corpus = load_corpus(abspaths, root)
+    return run_passes(corpus, config), sorted(corpus.by_path)
+
+
+# -- the justified baseline ---------------------------------------------------
+
+def _just_key(file: str, rule: str) -> str:
+    return f"{file} [{rule}]"
+
+
+def load_baseline_file(path) -> dict:
+    p = Path(path)
+    if not p.exists():
+        return {"violations": {}, "justifications": {}}
+    data = json.loads(p.read_text())
+    data.setdefault("violations", {})
+    data.setdefault("justifications", {})
+    return data
+
+
+def check_justifications(data: dict) -> list:
+    """Baselined (file, rule) pairs whose justification is missing,
+    empty, or a TODO stub — each fails the gate."""
+    bad = []
+    just = data.get("justifications", {})
+    for f, rules in sorted(data.get("violations", {}).items()):
+        for rule in sorted(rules):
+            text = str(just.get(_just_key(f, rule), "")).strip()
+            if not text or text.upper().startswith("TODO"):
+                bad.append((f, rule))
+    return bad
+
+
+def write_baseline_file(path, findings, root: Path) -> dict:
+    """Write counts; keep existing justifications, stub new pairs with
+    a TODO the justification gate will reject until a human fills it."""
+    from ..baseline import baseline_counts
+
+    old = load_baseline_file(path)
+    counts = baseline_counts(findings, root)
+    just = {}
+    for f, rules in counts.items():
+        for rule in rules:
+            key = _just_key(f, rule)
+            just[key] = old["justifications"].get(
+                key, "TODO: one-line justification for accepting this")
+    data = {"violations": counts, "justifications": just}
+    Path(path).write_text(json.dumps(data, indent=2, sort_keys=True) + "\n")
+    return data
+
+
+__all__ = ["RULES", "Finding", "analyze_repo", "analyze_sources",
+           "check_justifications", "load_baseline_file", "load_config",
+           "run_passes", "write_baseline_file", "BASELINE_NAME",
+           "DEFAULT_CONFIG", "DEFAULT_PATHS"]

@@ -1,0 +1,138 @@
+"""S1: the signal-handler call graph must stay async-signal-safe.
+
+CPython delivers signals on the *main thread between bytecodes* — the
+handler can preempt any point of the interpreter loop, including the
+middle of a ``with _lock:`` block the main thread itself holds.  Three
+thing are therefore banned anywhere reachable from a handler
+registered via ``signal.signal(sig, fn)``:
+
+1. acquiring a non-reentrant ``threading.Lock`` (``with`` or
+   ``.acquire()``): if the interrupted frame holds that lock the
+   handler deadlocks the process.  ``RLock`` acquisition is exempt —
+   reentry succeeds by construction (the cost is bounded: at worst a
+   racy registry update the owner re-does, never a wedge);
+2. any call whose alias-expanded dotted name matches a *banned prefix*
+   (``jax.`` dispatch, allocation-heavy ``numpy.``, ``subprocess.``,
+   blocking ``time.sleep`` ...) unless an *allow prefix* matches first
+   — the lists live in ``contracts/racecheck.json`` so widening the
+   escape hatch is a reviewed diff;
+3. transitively: the walk follows every corpus-resolvable call
+   (``request_drain`` -> ``telemetry.incr``), and each finding carries
+   the handler->...->site path so the fix target is obvious.
+
+Opaque calls (methods on runtime objects, ``_event.set()``) are
+skipped: resolving them would need type inference, and the registries
+those methods live on are already covered by the L-pass.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .model import Corpus, Finding, ModuleModel, qualname, \
+    walk_excluding_defs
+
+#: default dotted-prefix ban list (config ``signal.ban_calls`` replaces)
+DEFAULT_BAN = ("jax.", "jax.numpy.", "numpy.", "subprocess.",
+               "multiprocessing.", "time.sleep", "open", "print",
+               "logging.")
+#: default allow list, matched before the ban list
+DEFAULT_ALLOW = ("signal.", "time.monotonic", "os.getpid", "os.kill",
+                 "os.write", "sys.exit", "faulthandler.")
+
+
+def _handler_functions(mod: ModuleModel):
+    """(handler_fndef, registration_node) for every
+    ``signal.signal(sig, fn)`` whose ``fn`` is a Name bound to a def
+    in this module (nested defs included — ``install`` registers a
+    closure)."""
+    defs_by_name: dict = {}
+    for fn in mod.all_defs:
+        if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs_by_name.setdefault(fn.name, []).append(fn)
+    out = []
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call) or len(node.args) < 2:
+            continue
+        if mod.expand(qualname(node.func)) != "signal.signal":
+            continue
+        target = node.args[1]
+        if isinstance(target, ast.Name):
+            for fn in defs_by_name.get(target.id, ()):
+                out.append((fn, node))
+    return out
+
+
+def _matches(dotted: str, prefixes) -> bool:
+    return any(dotted == p or dotted.startswith(p) for p in prefixes)
+
+
+def _scan_function(mod: ModuleModel, corpus: Corpus, fn, path, allow, ban,
+                   findings, visited, queue):
+    """One function on the handler-reachable graph: flag unsafe sites,
+    enqueue corpus-resolvable callees."""
+    for node in walk_excluding_defs(fn):
+        if isinstance(node, ast.With):
+            for it in node.items:
+                name = qualname(it.context_expr)
+                if name in mod.locks and mod.locks[name] == "Lock":
+                    findings.append(Finding(
+                        mod.path, node.lineno, "S1",
+                        f"signal-handler path {' -> '.join(path)} "
+                        f"acquires non-reentrant lock '{name}' "
+                        f"({mod.modname}): a signal landing while the "
+                        "main thread holds it deadlocks the process — "
+                        "use threading.RLock or set a flag only"))
+        if not isinstance(node, ast.Call):
+            continue
+        if isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "acquire" and \
+                isinstance(node.func.value, ast.Name) and \
+                node.func.value.id in mod.locks and \
+                mod.locks[node.func.value.id] == "Lock":
+            findings.append(Finding(
+                mod.path, node.lineno, "S1",
+                f"signal-handler path {' -> '.join(path)} calls "
+                f"'{node.func.value.id}.acquire()' on a non-reentrant "
+                "lock — self-deadlock hazard"))
+            continue
+        res = corpus.resolve_call(mod, node)
+        kind, a, b, display = res
+        if kind == "func":
+            key = (a.modname, b.name, b.lineno)
+            if key not in visited:
+                visited.add(key)
+                queue.append((a, b, path + (f"{a.modname}.{b.name}",)))
+        elif kind == "external":
+            if _matches(a, allow):
+                continue
+            if _matches(a, ban):
+                findings.append(Finding(
+                    mod.path, node.lineno, "S1",
+                    f"signal-handler path {' -> '.join(path)} calls "
+                    f"'{display}' ({a}) — not async-signal-safe "
+                    "(allocation/dispatch inside a handler); defer to "
+                    "the drain flag or extend signal.allow_calls with "
+                    "a justification"))
+
+
+def check_signals(corpus: Corpus, config: dict | None = None) -> list:
+    """All S1 findings: walk the call graph from every registered
+    handler."""
+    cfg = (config or {}).get("signal", {})
+    allow = tuple(cfg.get("allow_calls", DEFAULT_ALLOW))
+    ban = tuple(cfg.get("ban_calls", DEFAULT_BAN))
+    findings: list = []
+    visited: set = set()
+    queue: list = []
+    for mod in corpus.modules.values():
+        for fn, _reg in _handler_functions(mod):
+            key = (mod.modname, fn.name, fn.lineno)
+            if key not in visited:
+                visited.add(key)
+                queue.append((mod, fn, (f"{mod.modname}.{fn.name}",)))
+    while queue:
+        mod, fn, path = queue.pop(0)
+        _scan_function(mod, corpus, fn, path, allow, ban,
+                       findings, visited, queue)
+    return findings

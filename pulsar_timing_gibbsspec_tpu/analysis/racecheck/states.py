@@ -1,0 +1,283 @@
+"""M1/M2/M3: declared state machines vs the actual transition sites.
+
+The config (``contracts/racecheck.json``) declares each machine: its
+states, initial state(s), and the legal transition table — the job
+lifecycle (``queued -> warming -> sampling -> draining -> done/
+failed/quarantined``) and the tenant circuit breaker (``closed/open/
+half_open``).  The pass finds every literal transition *site* in the
+machine's files:
+
+- ``setter`` machines: ``recv.set_state("lit")`` calls;
+- ``attr`` machines: ``recv.state = "lit"`` assigns (optionally
+  restricted to one class, so ``CircuitBreaker.state`` does not absorb
+  unrelated ``.state`` attributes).
+
+and checks three things.  **M1**: a state literal (or a ``states_const``
+tuple like ``serve/jobs.py:JOB_STATES``) outside the declared set — a
+new state cannot land without updating the table.  **M2**: a declared
+non-initial state with no site assigning it — dead lifecycle states
+rot into lies.  **M3**: where a site's *source* state is statically
+known, the edge must be declared.  Sources are inferred two ways, both
+local and deliberately conservative: an earlier site on the same
+receiver in the same straight-line suite (``set_state("warming") ...
+set_state("sampling")``), or an enclosing ``if recv.state == "lit":``
+guard.  Branch joins keep a source only when every surviving arm
+agrees (a ``return`` arm drops out); loop bodies are walked once with
+the loop target cleared, so per-iteration rebinding cannot fabricate a
+cross-iteration edge.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .model import Corpus, Finding, qualname
+
+
+def _literal_str(node):
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+class _Machine:
+    def __init__(self, cfg: dict, config_path: str):
+        self.name = cfg.get("name", "?")
+        self.files = list(cfg.get("files", ()))
+        self.setter = cfg.get("setter")
+        self.attr = cfg.get("attr")
+        self.klass = cfg.get("class")
+        self.state_attr = cfg.get("state_attr", "state")
+        self.states = set(cfg.get("states", ()))
+        self.initial = set(cfg.get("initial", ()))
+        self.transitions = {tuple(t) for t in cfg.get("transitions", ())}
+        self.states_const = cfg.get("states_const")
+        self.config_path = config_path
+
+
+def _site_of(machine: _Machine, mod, stmt):
+    """(receiver, dst, node) when ``stmt`` is a transition site."""
+    if machine.setter is not None and isinstance(stmt, ast.Expr) and \
+            isinstance(stmt.value, ast.Call):
+        call = stmt.value
+        if isinstance(call.func, ast.Attribute) and \
+                call.func.attr == machine.setter and call.args:
+            dst = _literal_str(call.args[0])
+            if dst is not None:
+                recv = qualname(call.func.value)
+                return recv, dst, call
+    if machine.attr is not None and isinstance(stmt, ast.Assign) and \
+            len(stmt.targets) == 1:
+        t = stmt.targets[0]
+        if isinstance(t, ast.Attribute) and t.attr == machine.attr:
+            dst = _literal_str(stmt.value)
+            if dst is not None:
+                if machine.klass is not None and \
+                        mod.enclosing_class(stmt) != machine.klass:
+                    return None
+                recv = qualname(t.value)
+                return recv, dst, stmt
+    return None
+
+
+def _guard_state(machine: _Machine, test):
+    """(receiver, state) from an ``if recv.state == "lit":`` test."""
+    if isinstance(test, ast.Compare) and len(test.ops) == 1 and \
+            isinstance(test.ops[0], ast.Eq):
+        left = qualname(test.left)
+        lit = _literal_str(test.comparators[0])
+        if left is not None and lit is not None and \
+                left.endswith("." + machine.state_attr):
+            recv = left[:-(len(machine.state_attr) + 1)]
+            return recv, lit
+    return None
+
+
+def _terminates(stmts) -> bool:
+    return bool(stmts) and isinstance(
+        stmts[-1], (ast.Return, ast.Raise, ast.Break, ast.Continue))
+
+
+def _merge(entry: dict, arms) -> dict:
+    """Join of branch-local source maps: keep a receiver only when
+    every surviving arm agrees on its state."""
+    alive = [a for a, terminated in arms if not terminated]
+    if not alive:
+        return dict(entry)
+    out = {}
+    for recv, state in alive[0].items():
+        if all(a.get(recv) == state for a in alive[1:]):
+            out[recv] = state
+    return out
+
+
+class _MachineScan:
+    def __init__(self, machine: _Machine, mod, report):
+        self.m = machine
+        self.mod = mod
+        self.report = report
+        self.seen_dsts: set = set()
+
+    def _visit_site(self, site, last: dict):
+        recv, dst, node = site
+        self.seen_dsts.add(dst)
+        if dst not in self.m.states:
+            self.report(self.mod.path, node.lineno, "M1",
+                        f"machine '{self.m.name}': state {dst!r} is not "
+                        f"in the declared set {sorted(self.m.states)}")
+            return
+        src = last.get(recv) if recv is not None else None
+        if src is not None and (src, dst) not in self.m.transitions:
+            self.report(self.mod.path, node.lineno, "M3",
+                        f"machine '{self.m.name}': transition "
+                        f"{src!r} -> {dst!r} is not in the declared "
+                        "table — declare it in contracts/racecheck.json "
+                        "or fix the lifecycle")
+        if recv is not None:
+            last[recv] = dst
+
+    def walk(self, stmts, last: dict):
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.walk(stmt.body, {})
+                continue
+            if isinstance(stmt, ast.ClassDef):
+                self.walk(stmt.body, {})
+                continue
+            site = _site_of(self.m, self.mod, stmt)
+            if site is not None:
+                self._visit_site(site, last)
+                continue
+            if isinstance(stmt, ast.If):
+                entry = dict(last)
+                a = dict(last)
+                guard = _guard_state(self.m, stmt.test)
+                if guard is not None and guard[1] in self.m.states:
+                    a[guard[0]] = guard[1]
+                b = dict(last)
+                self.walk(stmt.body, a)
+                self.walk(stmt.orelse, b)
+                merged = _merge(entry, [
+                    (a, _terminates(stmt.body)),
+                    (b, _terminates(stmt.orelse) if stmt.orelse
+                     else False)])
+                last.clear()
+                last.update(merged)
+            elif isinstance(stmt, ast.For):
+                body_entry = dict(last)
+                for tok in _for_targets(stmt):
+                    for k in [k for k in body_entry
+                              if k == tok or k.startswith(tok + ".")]:
+                        del body_entry[k]
+                a = dict(body_entry)
+                self.walk(stmt.body, a)
+                self.walk(stmt.orelse, a)
+                last.clear()
+                last.update({k: v for k, v in body_entry.items()
+                             if a.get(k) == v})
+            elif isinstance(stmt, ast.While):
+                entry = dict(last)
+                a = dict(last)
+                self.walk(stmt.body, a)
+                self.walk(stmt.orelse, a)
+                last.clear()
+                last.update({k: v for k, v in entry.items()
+                             if a.get(k) == v})
+            elif isinstance(stmt, ast.With):
+                self.walk(stmt.body, last)
+            elif isinstance(stmt, ast.Try):
+                a = dict(last)
+                self.walk(stmt.body, a)
+                self.walk(stmt.orelse, a)
+                arms = [(a, _terminates(stmt.body))]
+                for h in stmt.handlers:
+                    b = dict(last)
+                    self.walk(h.body, b)
+                    arms.append((b, _terminates(h.body)))
+                merged = _merge(last, arms)
+                last.clear()
+                last.update(merged)
+                self.walk(stmt.finalbody, last)
+
+
+def _for_targets(stmt: ast.For):
+    def flat(t):
+        if isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                yield from flat(e)
+        else:
+            q = qualname(t)
+            if q is not None:
+                yield q
+    return list(flat(stmt.target))
+
+
+def _check_states_const(machine: _Machine, corpus: Corpus, report):
+    spec = machine.states_const
+    mod = corpus.by_path.get(spec["file"])
+    if mod is None:
+        report(spec["file"], 0, "M1",
+               f"machine '{machine.name}': states_const file "
+               f"{spec['file']!r} is not in the analyzed corpus")
+        return
+    for stmt in mod.tree.body:
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 and \
+                isinstance(stmt.targets[0], ast.Name) and \
+                stmt.targets[0].id == spec["name"]:
+            if isinstance(stmt.value, (ast.Tuple, ast.List)):
+                got = {e.value for e in stmt.value.elts
+                       if isinstance(e, ast.Constant)
+                       and isinstance(e.value, str)}
+                if got != machine.states:
+                    report(mod.path, stmt.lineno, "M1",
+                           f"machine '{machine.name}': {spec['name']} = "
+                           f"{sorted(got)} does not match the declared "
+                           f"states {sorted(machine.states)} — update "
+                           "both together")
+                return
+    report(mod.path, 0, "M1",
+           f"machine '{machine.name}': states_const {spec['name']!r} "
+           f"not found at module level of {spec['file']}")
+
+
+def check_states(corpus: Corpus, config: dict | None = None,
+                 config_path: str = "contracts/racecheck.json") -> list:
+    """All M1/M2/M3 findings for the configured machines."""
+    findings: list = []
+
+    def report(path, line, rule, msg):
+        findings.append(Finding(path, line, rule, msg))
+
+    for cfg in (config or {}).get("machines", ()):
+        machine = _Machine(cfg, config_path)
+        if machine.files and \
+                not any(p in corpus.by_path for p in machine.files):
+            # subset run (explicit paths on the CLI): none of this
+            # machine's files are in scope, so there is no evidence to
+            # audit — skip rather than report every state unreachable
+            continue
+        for src, dst in sorted(machine.transitions):
+            for s in (src, dst):
+                if s not in machine.states:
+                    report(machine.files[0] if machine.files
+                           else config_path, 0, "M1",
+                           f"machine '{machine.name}': declared "
+                           f"transition references unknown state {s!r}")
+        if machine.states_const:
+            _check_states_const(machine, corpus, report)
+        seen: set = set()
+        for path in machine.files:
+            mod = corpus.by_path.get(path)
+            if mod is None:
+                continue
+            scan = _MachineScan(machine, mod, report)
+            scan.walk(mod.tree.body, {})
+            seen |= scan.seen_dsts
+        for state in sorted(machine.states - machine.initial - seen):
+            report(machine.files[0] if machine.files else config_path,
+                   0, "M2",
+                   f"machine '{machine.name}': declared state {state!r} "
+                   "has no transition site in "
+                   f"{machine.files or '(no files)'} — unreachable "
+                   "(remove it from the table or wire the transition)")
+    return findings

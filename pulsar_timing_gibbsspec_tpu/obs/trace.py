@@ -104,10 +104,14 @@ def dropped() -> int:
 
 
 def _tid() -> int:
+    # spans finish on the watchdog worker thread as well as the main
+    # thread (the dispatch closure runs inside DispatchWatchdog.call),
+    # so the id registry needs the same lock as the ring buffer
     ident = threading.get_ident()
-    t = _tids.get(ident)
-    if t is None:
-        t = _tids[ident] = len(_tids) + 1
+    with _lock:
+        t = _tids.get(ident)
+        if t is None:
+            t = _tids[ident] = len(_tids) + 1
     return t
 
 

@@ -61,7 +61,11 @@ class Preempted(RuntimeError):
         self.rolled_back = bool(rolled_back)
 
 
-_lock = threading.Lock()
+# RLock, not Lock: request_drain runs in signal context on the main
+# thread, which can interrupt the main thread *inside* deadline_remaining
+# / drain_info's own ``with _lock`` — a non-reentrant lock would
+# self-deadlock the process right when the scheduler wants it gone
+_lock = threading.RLock()
 _event = threading.Event()
 _state = {"reason": None, "requested_at": None, "deadline_s": None}
 _prev_handlers: dict[int, object] = {}

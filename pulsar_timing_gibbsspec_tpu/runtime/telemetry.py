@@ -58,7 +58,12 @@ from __future__ import annotations
 
 import threading
 
-_lock = threading.Lock()
+# RLock, not Lock: ``incr`` is reachable from the preemption signal
+# handler (request_drain -> incr), which can land while the main thread
+# holds this lock in another telemetry call.  Reentry on an RLock costs
+# at worst a racy re-read the owner re-does; a plain Lock costs the
+# process (self-deadlock inside the handler).
+_lock = threading.RLock()
 _counts: dict[str, int] = {}
 _gauges: dict[str, float] = {}
 

@@ -295,6 +295,38 @@ def test_contract_hashes_cover_all_contracts():
     assert all(len(h) == 64 for h in hashes.values())
 
 
+@pytest.mark.lint
+def test_every_entry_builder_has_a_committed_contract():
+    """Coverage gate: a jit entry builder in entries.py without a
+    pinned contracts/*.json is a compiled program shipping unaudited."""
+    assert runner.check_contract_coverage() == []
+
+
+def test_contract_coverage_names_the_missing_entries(tmp_path):
+    # a contracts dir pinning only the gram entry: every other builder
+    # must surface as its own coverage violation
+    (tmp_path / "only_gram.json").write_text(json.dumps(
+        {"name": "only_gram", "entry": {"entry": "gram"}, "checks": []}))
+    v = runner.check_contract_coverage(tmp_path)
+    from pulsar_timing_gibbsspec_tpu.analysis.jaxprcheck.entries import (
+        _ENTRIES)
+
+    missing = {x.path.split("/")[-1] for x in v}
+    assert missing == set(_ENTRIES) - {"gram"}
+    assert all(x.rule == "coverage" for x in v)
+
+
+def test_discover_contracts_skips_entry_less_configs(tmp_path):
+    # contracts/ also holds racecheck's config — an entry-less JSON
+    # must not crash or pollute a full jaxprcheck run
+    (tmp_path / "racecheckish.json").write_text(json.dumps(
+        {"name": "not-a-contract", "paths": ["x"]}))
+    (tmp_path / "real.json").write_text(json.dumps(
+        {"name": "real", "entry": {"entry": "gram"}, "checks": []}))
+    got = runner.discover_contracts(tmp_path)
+    assert [c["name"] for c in got] == ["real"]
+
+
 def test_runner_reports_broken_contract_as_error_violation():
     v, f = runner.run_contracts([{"name": "nope",
                                   "entry": {"entry": "no-such"},

@@ -1,0 +1,552 @@
+"""racecheck: the static concurrency / signal-safety / buffer-lifetime
+auditor (``analysis/racecheck``).
+
+Per-pass positive AND negative fixtures (every rule has a violation it
+detects and a disciplined twin it stays quiet on), the PR 13
+``serve/engine.make_mux`` donation regression, pragma suppression, the
+justified-baseline gate, and the whole-repo ratchet — all pure AST,
+no jax import, milliseconds.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from pulsar_timing_gibbsspec_tpu.analysis.baseline import baseline_counts
+from pulsar_timing_gibbsspec_tpu.analysis.racecheck import (
+    RULES, analyze_repo, analyze_sources, check_justifications,
+    load_baseline_file, load_config)
+
+ROOT = Path(__file__).resolve().parents[1]
+
+
+def rules_of(findings):
+    return [f.rule for f in findings]
+
+
+def run(src, config=None, path="m.py"):
+    return analyze_sources({path: textwrap.dedent(src)}, config)
+
+
+# ---------------------------------------------------------------------------
+# L1: unguarded shared writes
+# ---------------------------------------------------------------------------
+
+L1_BASE = """
+    import threading
+    _lock = threading.Lock()
+    _reg: dict = {}
+"""
+
+
+def test_l1_flags_unguarded_subscript_write():
+    f = run(L1_BASE + """
+    def hit(k):
+        _reg[k] = 1
+    """)
+    assert rules_of(f) == ["L1"] and "_reg" in f[0].msg
+
+
+def test_l1_flags_unguarded_mutator_call_and_global_rebind():
+    f = run(L1_BASE + """
+    _flag = False
+    def wipe():
+        global _flag
+        _reg.clear()
+        _flag = True
+    """)
+    assert rules_of(f) == ["L1", "L1"]
+
+
+def test_l1_quiet_under_the_lock():
+    assert run(L1_BASE + """
+    def hit(k):
+        with _lock:
+            _reg[k] = 1
+    """) == []
+
+
+def test_l1_local_shadow_is_not_shared_state():
+    # a parameter / local / nested def named like the global shadows it
+    assert run(L1_BASE + """
+    def fine(_reg):
+        _reg["x"] = 1
+    def also_fine():
+        _reg = {}
+        _reg["x"] = 1
+    """) == []
+
+
+def test_l1_unguarded_read_is_out_of_scope():
+    # GIL-atomic reference loads are the documented fast path
+    assert run(L1_BASE + """
+    def peek(k):
+        return _reg.get(k)
+    """) == []
+
+
+def test_pragma_suppresses_a_finding():
+    f = run(L1_BASE + """
+    def hit(k):
+        _reg[k] = 1  # racecheck: disable=L1
+    """)
+    assert f == []
+
+
+# ---------------------------------------------------------------------------
+# L2: lock ordering
+# ---------------------------------------------------------------------------
+
+L2_BASE = """
+    import threading
+    _a = threading.Lock()
+    _b = threading.Lock()
+"""
+
+
+def test_l2_flags_opposite_acquisition_orders():
+    f = run(L2_BASE + """
+    def f():
+        with _a:
+            with _b:
+                pass
+    def g():
+        with _b:
+            with _a:
+                pass
+    """)
+    assert "L2" in rules_of(f)
+    assert any("cycle" in x.msg for x in f)
+
+
+def test_l2_quiet_on_consistent_order():
+    assert run(L2_BASE + """
+    def f():
+        with _a:
+            with _b:
+                pass
+    def g():
+        with _a:
+            with _b:
+                pass
+    """) == []
+
+
+def test_l2_flags_self_reacquire_through_a_call():
+    f = run(L2_BASE + """
+    def helper():
+        with _a:
+            pass
+    def f():
+        with _a:
+            helper()
+    """)
+    assert rules_of(f) == ["L2"] and "re-acquire" in f[0].msg
+
+
+def test_l2_rlock_reentry_is_safe():
+    assert run("""
+    import threading
+    _a = threading.RLock()
+    def helper():
+        with _a:
+            pass
+    def f():
+        with _a:
+            helper()
+    """) == []
+
+
+# ---------------------------------------------------------------------------
+# S1: signal-handler safety
+# ---------------------------------------------------------------------------
+
+def test_s1_flags_handler_calling_jax_numpy_and_taking_a_lock():
+    f = run("""
+    import signal
+    import threading
+    import jax.numpy as jnp
+    _lock = threading.Lock()
+    def _handler(signum, frame):
+        with _lock:
+            pass
+        jnp.zeros(3)
+    def install():
+        signal.signal(signal.SIGTERM, _handler)
+    """)
+    assert sorted(rules_of(f)) == ["S1", "S1"]
+    assert any("jnp" in x.msg or "jax" in x.msg for x in f)
+
+
+def test_s1_follows_the_call_graph_with_a_path():
+    f = run("""
+    import signal
+    import time
+    def _handler(signum, frame):
+        helper()
+    def helper():
+        time.sleep(1)
+    def install():
+        signal.signal(signal.SIGINT, _handler)
+    """)
+    assert rules_of(f) == ["S1"]
+    assert "_handler -> " in f[0].msg and "helper" in f[0].msg
+
+
+def test_s1_rlock_and_allowlisted_calls_are_clean():
+    src = """
+    import signal
+    import threading
+    import time
+    _lock = threading.RLock()
+    def _handler(signum, frame):
+        with _lock:
+            pass
+        time.monotonic()
+    def install():
+        signal.signal(signal.SIGTERM, _handler)
+    """
+    assert run(src) == []
+
+
+def test_s1_config_allowlist_is_the_escape_hatch():
+    src = """
+    import signal
+    import time
+    def _handler(signum, frame):
+        time.sleep(0)
+    def install():
+        signal.signal(signal.SIGTERM, _handler)
+    """
+    assert rules_of(run(src)) == ["S1"]
+    cfg = {"signal": {"allow_calls": ["time.sleep"], "ban_calls": ["jax."]}}
+    assert run(src, cfg) == []
+
+
+# ---------------------------------------------------------------------------
+# C6: use-after-donate
+# ---------------------------------------------------------------------------
+
+def test_c6_flags_read_of_donated_name():
+    f = run("""
+    import jax
+    def go(step, x, b):
+        mux = jax.jit(step, donate_argnums=(1, 2))
+        y = mux(0, x, b)
+        return x + y
+    """)
+    assert rules_of(f) == ["C6"] and "'x'" in f[0].msg
+
+
+def test_c6_rebinding_from_outputs_is_the_fix():
+    assert run("""
+    import jax
+    def go(step, x, b):
+        mux = jax.jit(step, donate_argnums=(1, 2))
+        x, b = mux(0, x, b)
+        return x + b
+    """) == []
+
+
+def test_c6_copy_before_the_call_is_clean():
+    assert run("""
+    import jax
+    import numpy as np
+    def go(step, x, b):
+        mux = jax.jit(step, donate_argnums=(1,))
+        kept = np.array(x)
+        y = mux(x, b)
+        return kept + y
+    """) == []
+
+
+def test_c6_branch_join_keeps_the_name_dead():
+    f = run("""
+    import jax
+    def go(step, x, cold):
+        mux = jax.jit(step, donate_argnums=(0,))
+        if cold:
+            y = mux(x)
+        else:
+            y = x
+        return x + y
+    """)
+    assert rules_of(f) == ["C6"]
+
+
+def test_c6_regression_pr13_make_mux_donation_pattern():
+    """The PR 13 bug, reduced: ``serve/engine.make_mux`` returns a
+    donating jit; the scheduler called it and then touched the stale
+    ``b`` carry (host heap corruption on the CPU backend).  The factory
+    return must make the binding a donating callable and the stale read
+    must be flagged."""
+    f = run("""
+    import jax
+    import numpy as np
+
+    def mux_body(chunk):
+        def mux(cm_stack, x, b, tkeys, it0):
+            return x, b, x, b, x
+        return mux
+
+    def make_mux(chunk):
+        if jax.default_backend() == "cpu":
+            return jax.jit(mux_body(chunk))
+        return jax.jit(mux_body(chunk), donate_argnums=(1, 2))
+
+    def dispatch(stack, x, b, tkeys, it0):
+        mux = make_mux(2)
+        X, B, xs, bs, health = mux(stack, x, b, tkeys, it0)
+        return np.asarray(b)
+    """)
+    assert rules_of(f) == ["C6"]
+    assert "'b'" in f[0].msg and "donated" in f[0].msg
+
+
+def test_c6_pr13_fix_pattern_is_clean():
+    # the shipped fix: carries re-bound from the call's outputs
+    assert run("""
+    import jax
+    import numpy as np
+
+    def make_mux(chunk):
+        return jax.jit(lambda s, x, b: (x, b), donate_argnums=(1, 2))
+
+    def dispatch(stack, x, b):
+        mux = make_mux(2)
+        x, b = mux(stack, x, b)
+        return np.asarray(b)
+    """) == []
+
+
+# ---------------------------------------------------------------------------
+# M: state-machine exhaustiveness
+# ---------------------------------------------------------------------------
+
+def m_cfg(**over):
+    cfg = {"name": "m", "files": ["m.py"], "setter": "set_state",
+           "states": ["a", "b", "c"], "initial": ["a"],
+           "transitions": [["a", "b"], ["b", "c"]]}
+    cfg.update(over)
+    return {"machines": [cfg]}
+
+
+def test_m1_unknown_state_literal():
+    f = run("""
+    def go(job):
+        job.set_state("z")
+    """, m_cfg())
+    assert "M1" in rules_of(f)
+
+
+def test_m2_declared_but_unreachable_state():
+    f = run("""
+    def go(job):
+        job.set_state("b")
+        job.set_state("c")
+    """, m_cfg(states=["a", "b", "c", "paused"]))
+    assert [x.rule for x in f if x.rule == "M2"] == ["M2"]
+    assert "paused" in [x for x in f if x.rule == "M2"][0].msg
+
+
+def test_m3_consecutive_pair_must_be_declared():
+    clean = run("""
+    def go(job):
+        job.set_state("b")
+        job.set_state("c")
+    """, m_cfg())
+    assert clean == []
+    f = run("""
+    def go(job):
+        job.set_state("c")
+        job.set_state("b")
+    """, m_cfg())
+    assert [x.rule for x in f] == ["M3"]
+    assert "'c' -> 'b'" in f[0].msg
+
+
+def test_m3_guard_inference_from_if_state_eq():
+    # the fixtures leave some declared states unset on purpose, so
+    # compare the M3 surface alone (M2 is covered above)
+    f = run("""
+    def go(job):
+        if job.state == "a":
+            job.set_state("c")
+    """, m_cfg())
+    assert [x.rule for x in f if x.rule == "M3"] == ["M3"]
+    clean = run("""
+    def go(job):
+        if job.state == "a":
+            job.set_state("b")
+    """, m_cfg())
+    assert [x for x in clean if x.rule == "M3"] == []
+
+
+def test_m3_terminating_branch_drops_out_of_the_join():
+    # the serve._quarantine shape: both arms assign, the first returns —
+    # no cross-arm edge may be fabricated
+    f = run("""
+    def go(job):
+        if job.bad():
+            job.set_state("b")
+            return
+        job.set_state("b")
+    """, m_cfg())
+    assert [x for x in f if x.rule == "M3"] == []
+
+
+def test_m3_loop_target_rebinding_is_not_an_edge():
+    # the serve._drain shape: per-iteration job, b -> c inside, no
+    # c -> b edge across iterations
+    assert run("""
+    def go(jobs):
+        for job in jobs:
+            job.set_state("b")
+            job.set_state("c")
+    """, m_cfg()) == []
+
+
+def test_m_attr_machine_with_class_restriction():
+    cfg = {"machines": [{
+        "name": "breaker", "files": ["m.py"], "attr": "state",
+        "class": "Breaker", "states": ["closed", "open"],
+        "initial": ["closed"],
+        "transitions": [["closed", "open"], ["open", "closed"]]}]}
+    f = run("""
+    class Breaker:
+        def trip(self):
+            if self.state == "closed":
+                self.state = "open"
+        def reset(self):
+            self.state = "closed"
+    class Other:
+        def set(self):
+            self.state = "weird"
+    """, cfg)
+    assert f == []      # Other.state is not the breaker's machine
+
+
+def test_m1_states_const_must_match_the_table():
+    cfg = m_cfg()
+    cfg["machines"][0]["states_const"] = {"file": "m.py",
+                                          "name": "STATES"}
+    f = run("""
+    STATES = ("a", "b", "c", "d")
+    def go(job):
+        job.set_state("b")
+        job.set_state("c")
+    """, cfg)
+    assert "M1" in rules_of(f)
+    assert "STATES" in [x for x in f if x.rule == "M1"][0].msg
+
+
+# ---------------------------------------------------------------------------
+# repo gate: committed config, baseline, justifications
+# ---------------------------------------------------------------------------
+
+@pytest.mark.lint
+def test_repo_findings_match_the_committed_baseline():
+    findings, _ = analyze_repo()
+    data = load_baseline_file(ROOT / "racecheck_baseline.json")
+    current = baseline_counts(findings, ROOT)
+    # exact equality: new findings must be fixed (or justified into the
+    # baseline), fixed ones must ratchet the baseline down
+    assert current == data["violations"], (
+        "racecheck findings diverged from racecheck_baseline.json "
+        f"(current={current})")
+
+
+@pytest.mark.lint
+def test_every_baselined_pair_is_justified():
+    data = load_baseline_file(ROOT / "racecheck_baseline.json")
+    assert check_justifications(data) == []
+
+
+@pytest.mark.lint
+def test_repo_is_clean_outside_the_baselined_rule():
+    # S1/C6/L2/M* carry no baseline allowance at all: the runtime's
+    # signal path, donation protocol, lock graph and state machines
+    # audit clean outright
+    findings, _ = analyze_repo()
+    hard = [f for f in findings if f.rule != "L1"]
+    assert hard == [], "\n".join(str(f) for f in hard)
+
+
+def test_committed_config_declares_the_serving_machines():
+    cfg = load_config()
+    names = {m["name"] for m in cfg["machines"]}
+    assert {"job", "breaker"} <= names
+    job = next(m for m in cfg["machines"] if m["name"] == "job")
+    assert ["warming", "sampling"] in job["transitions"]
+    assert ["draining", "queued"] in job["transitions"]
+
+
+def test_rule_table_is_closed():
+    assert set(RULES) == {"L1", "L2", "S1", "C6", "M1", "M2", "M3"}
+
+
+# ---------------------------------------------------------------------------
+# CLI / wrappers
+# ---------------------------------------------------------------------------
+
+def _run_cli(*args, cwd=ROOT):
+    env = dict(os.environ, PYTHONPATH=str(ROOT))
+    return subprocess.run(
+        [sys.executable, "-m",
+         "pulsar_timing_gibbsspec_tpu.analysis.racecheck", *args],
+        cwd=cwd, env=env, capture_output=True, text=True)
+
+
+@pytest.mark.lint
+def test_cli_exits_zero_on_head_with_committed_baseline():
+    r = _run_cli()
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "OK" in r.stdout
+
+
+def test_cli_fails_on_unjustified_baseline(tmp_path):
+    bl = tmp_path / "bl.json"
+    bl.write_text(json.dumps({
+        "violations": {
+            "pulsar_timing_gibbsspec_tpu/runtime/preemption.py":
+                {"L1": 2}},
+        "justifications": {}}))
+    r = _run_cli("--baseline", str(bl))
+    assert r.returncode == 1
+    assert "without justification" in r.stdout
+
+
+def test_cli_write_baseline_stubs_todo_justifications(tmp_path):
+    f = tmp_path / "mod.py"
+    f.write_text(textwrap.dedent("""
+        import threading
+        _lock = threading.Lock()
+        _reg: dict = {}
+        def hit(k):
+            _reg[k] = 1
+    """))
+    bl = tmp_path / "bl.json"
+    r = _run_cli(str(f), "--baseline", str(bl), "--write-baseline")
+    assert r.returncode == 0
+    data = json.loads(bl.read_text())
+    assert list(data["violations"].values()) == [{"L1": 1}]
+    just = list(data["justifications"].values())
+    assert len(just) == 1 and just[0].startswith("TODO")
+    # the stub is not a justification: the gate refuses it
+    r2 = _run_cli(str(f), "--baseline", str(bl))
+    assert r2.returncode == 1
+    assert "without justification" in r2.stdout
+
+
+def test_tools_racecheck_wrapper_importable():
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "tools_racecheck", ROOT / "tools" / "racecheck.py")
+    m = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(m)        # no side effects on import
+    assert callable(m.main)

@@ -7,14 +7,20 @@ status — the same set the ``lint`` pytest marker covers:
                  skipped with a note when not installed;
 2. jaxlint     — AST-level JAX discipline (rules R1-R7), ratcheted
                  against ``jaxlint_baseline.json``;
-3. jaxprcheck  — jaxpr/HLO contract audit of the fast (CPU-traceable)
+3. racecheck   — static concurrency / signal-safety / use-after-donate
+                 / state-machine audit of the runtime and serving
+                 layers (pure AST, the checked modules are never
+                 imported), ratcheted against
+                 ``racecheck_baseline.json``;
+4. jaxprcheck  — jaxpr/HLO contract audit of the fast (CPU-traceable)
                  contracts in ``contracts/``, ratcheted against
-                 ``jaxprcheck_baseline.json``;
-4. perfwatch   — the perf-ledger regression gate over
+                 ``jaxprcheck_baseline.json``; also fails when a jit
+                 entry builder has no pinned contract (coverage);
+5. perfwatch   — the perf-ledger regression gate over
                  ``PERF_LEDGER.jsonl`` plus the static cost-model
                  self-check (CPU tracing only, no device execution).
 
-With ``--chaos`` an optional fifth layer runs the quick seeded chaos
+With ``--chaos`` an optional sixth layer runs the quick seeded chaos
 campaign (``tools/chaos_campaign.py --quick --seeds 5``) — the serving
 tier's blast-radius invariants under randomized fault schedules.  It
 executes real (CPU) sampling, so it is opt-in rather than part of the
@@ -48,6 +54,9 @@ def main(argv=None) -> int:
     layers.append(("jaxlint",
                    [sys.executable, "-m",
                     "pulsar_timing_gibbsspec_tpu.analysis"]))
+    layers.append(("racecheck",
+                   [sys.executable, "-m",
+                    "pulsar_timing_gibbsspec_tpu.analysis.racecheck"]))
     layers.append(("jaxprcheck",
                    [sys.executable, "-m",
                     "pulsar_timing_gibbsspec_tpu.analysis.jaxprcheck",
