@@ -1,4 +1,4 @@
-"""Baseline ratchet for :mod:`.jaxlint`.
+"""Baseline ratchet shared by every analysis layer.
 
 ``jaxlint_baseline.json`` (repo root) records the accepted pre-existing
 violations as per-file, per-rule counts::
@@ -11,6 +11,13 @@ new debt is rejected.  ``tests/test_jaxlint.py`` asserts *equality*, so
 fixing a baselined violation forces the baseline file down with it: the
 count can only shrink.  Regenerate after fixes with
 ``python -m pulsar_timing_gibbsspec_tpu.analysis --write-baseline``.
+
+The *justified* variant (racecheck, numcheck) adds one obligation:
+every baselined ``(file, rule)`` pair must carry a one-line
+justification under ``justifications`` (key ``"<file> [<rule>]"``);
+missing/empty/TODO text fails the gate even when the ratchet itself is
+satisfied — accepted debt must say *why* it is acceptable, not just
+that it is old.
 """
 
 from __future__ import annotations
@@ -81,3 +88,48 @@ def compare_to_baseline(violations, baseline: dict, root: Path,
             if cur < n:
                 stale.append((f, rule, n, cur))
     return new, stale
+
+
+# -- the justified baseline (racecheck, numcheck) -----------------------------
+
+def justification_key(file: str, rule: str) -> str:
+    return f"{file} [{rule}]"
+
+
+def load_justified_baseline(path) -> dict:
+    p = Path(path)
+    if not p.exists():
+        return {"violations": {}, "justifications": {}}
+    data = json.loads(p.read_text())
+    data.setdefault("violations", {})
+    data.setdefault("justifications", {})
+    return data
+
+
+def check_justifications(data: dict) -> list:
+    """Baselined (file, rule) pairs whose justification is missing,
+    empty, or a TODO stub — each fails the gate."""
+    bad = []
+    just = data.get("justifications", {})
+    for f, rules in sorted(data.get("violations", {}).items()):
+        for rule in sorted(rules):
+            text = str(just.get(justification_key(f, rule), "")).strip()
+            if not text or text.upper().startswith("TODO"):
+                bad.append((f, rule))
+    return bad
+
+
+def write_justified_baseline(path, findings, root: Path) -> dict:
+    """Write counts; keep existing justifications, stub new pairs with
+    a TODO the justification gate will reject until a human fills it."""
+    old = load_justified_baseline(path)
+    counts = baseline_counts(findings, root)
+    just = {}
+    for f, rules in counts.items():
+        for rule in rules:
+            key = justification_key(f, rule)
+            just[key] = old["justifications"].get(
+                key, "TODO: one-line justification for accepting this")
+    data = {"violations": counts, "justifications": just}
+    Path(path).write_text(json.dumps(data, indent=2, sort_keys=True) + "\n")
+    return data

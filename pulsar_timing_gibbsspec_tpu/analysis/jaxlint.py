@@ -25,10 +25,11 @@ Rules
   bodies, or (transitively) called by name from such a function in the
   same module.
 - **R3 implicit-dtype-in-device-code** — ``jnp.zeros/ones/full/empty/
-  asarray/array/eye/linspace`` in traced code without an explicit dtype
-  (keyword or positional) and without an immediate ``.astype(...)``:
-  the TWO_FLOAT contract requires every device allocation to state its
-  precision.
+  asarray/array/eye/linspace/arange`` in traced code without an
+  explicit dtype (keyword or positional) and without an immediate
+  ``.astype(...)``: the TWO_FLOAT contract requires every device
+  allocation to state its precision (``arange`` is the classic
+  offender — its dtype flips int/float with the argument types).
 - **R4 retrace-hazard** — (a) a ``jax.jit``-wrapped callable created and
   invoked in one expression (fresh jit cache entry — and so a fresh
   trace/compile — per call); (b) a Python scalar / dict literal passed
@@ -106,6 +107,9 @@ _RANDOMISH_BASES = {"jr", "random", "jrandom"}
 _DTYPE_CTORS = {
     "zeros": 1, "ones": 1, "empty": 1, "full": 2,
     "asarray": 1, "array": 1, "eye": None, "linspace": None,
+    # arange(start, stop, step, dtype): dtype is positional index 3;
+    # without it the result dtype flips int/float with the arguments
+    "arange": 3,
 }
 #: np attributes that are compile-time constants, not host-array leaks
 _NP_CONST_ATTRS = {"pi", "e", "inf", "nan", "euler_gamma", "newaxis",

@@ -15,6 +15,9 @@ Entry kinds (the ``entry`` field of a contract):
 - ``chunk`` — a full compiled sweep chunk through the driver
   (:func:`..sampler.jax_backend.sweep_chunk_entry`): key lineage,
   dtype islands, donation.
+- ``hd_chunk`` — the same chunk under a Hellings-Downs ORF: the
+  structured joint b-draw, its two-float kernels and the
+  ``joint_mixed`` path (numcheck's ``numerics_hd_joint`` pin).
 - ``megachunk`` — the device-resident mega-chunk steady dispatch
   (:func:`..sampler.jax_backend.megachunk_sweep_chunk_entry`): the
   ``chunk`` program scanned ``megachunk`` sub-chunks deep, carries
@@ -68,14 +71,17 @@ def synthetic_pulsars(n_psr, ntoa, tm_cols=3, seed=0):
     return out
 
 
-def build_model(psrs, nmodes, red=True):
-    """The CRN free-spectrum model the MULTICHIP/bench entries audit."""
+def build_model(psrs, nmodes, red=True, orf=None):
+    """The CRN free-spectrum model the MULTICHIP/bench entries audit;
+    ``orf`` switches the common block to a correlated ORF (``"hd"``
+    exercises the structured joint b-draw and its two-float kernels)."""
     from ...models.factory import model_general
 
     return model_general(
         psrs, tm_svd=True, white_vary=True,
         common_psd="spectrum", common_components=int(nmodes),
-        red_var=red, red_psd="spectrum", red_components=int(nmodes))
+        red_var=red, red_psd="spectrum", red_components=int(nmodes),
+        orf=orf or "crn")
 
 
 def _gram_entry(spec):
@@ -98,6 +104,25 @@ def _chunk_entry(spec):
                              tm_cols=spec.get("tm_cols", 3),
                              seed=spec.get("seed", 0))
     pta = build_model(psrs, spec.get("nmodes", 3))
+    fn, args, drv = jb.sweep_chunk_entry(
+        pta, spec.get("nchains", 4), chunk=spec.get("chunk", 2),
+        pad_pulsars=spec.get("pad_pulsars"), seed=spec.get("seed", 0))
+    return fn, args, {"driver": drv}
+
+
+def _hd_chunk_entry(spec):
+    """The correlated-ORF (Hellings-Downs) steady chunk: the same
+    driver path as ``chunk`` but through the structured joint b-draw —
+    two-float Cholesky/matmul kernels, Schur block grid, the
+    ``joint_mixed`` guard.  The numcheck contract
+    (``numerics_hd_joint``) pins this program's precision topology."""
+    from ...sampler import jax_backend as jb
+
+    psrs = synthetic_pulsars(spec.get("n_psr", 3), spec.get("ntoa", 40),
+                             tm_cols=spec.get("tm_cols", 3),
+                             seed=spec.get("seed", 0))
+    pta = build_model(psrs, spec.get("nmodes", 3),
+                      orf=spec.get("orf", "hd"))
     fn, args, drv = jb.sweep_chunk_entry(
         pta, spec.get("nchains", 4), chunk=spec.get("chunk", 2),
         pad_pulsars=spec.get("pad_pulsars"), seed=spec.get("seed", 0))
@@ -291,6 +316,7 @@ def _ensemble_chunk_entry(spec):
 
 
 _ENTRIES = {"gram": _gram_entry, "chunk": _chunk_entry,
+            "hd_chunk": _hd_chunk_entry,
             "megachunk": _megachunk_entry,
             "obs_chunk": _obs_chunk_entry,
             "sharded_step": _sharded_step_entry,

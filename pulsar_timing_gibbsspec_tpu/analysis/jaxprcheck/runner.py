@@ -87,6 +87,10 @@ def discover_contracts(root=None, fast_only=False) -> list:
             # allowlists live there); only entry-bearing files are
             # traceable contracts
             continue
+        if c.get("tool") not in (None, "jaxprcheck"):
+            # entry-bearing contracts of sibling auditors (numcheck)
+            # run under their own CLI; coverage still counts them
+            continue
         if fast_only and not c.get("fast", False):
             continue
         out.append(c)
@@ -309,12 +313,15 @@ def run_contract(contract: dict):
 def check_contract_coverage(root=None) -> list:
     """One ``coverage`` violation per jit entry builder in
     :mod:`.entries` that no committed contract pins — a new compiled
-    program cannot land unaudited.  Enumerates ALL contracts (not just
-    the fast subset): a slow contract still covers its entry."""
+    program cannot land unaudited.  Enumerates ALL entry-bearing
+    contracts (not just the fast subset, and including sibling-tool
+    contracts like numcheck's): a slow or foreign-tool contract still
+    covers its entry."""
     from .entries import _ENTRIES
 
-    covered = {c["entry"].get("entry")
-               for c in discover_contracts(root)}
+    rootdir = Path(root) if root is not None else CONTRACT_DIR
+    covered = {load_contract(p).get("entry", {}).get("entry")
+               for p in sorted(rootdir.glob("*.json"))}
     out = []
     for kind in sorted(set(_ENTRIES) - covered):
         out.append(Violation(

@@ -1,0 +1,43 @@
+"""numcheck — static precision-flow auditor over traced jaxprs.
+
+jaxprcheck's C3 counts dots per dtype; numcheck tracks *flow*: where
+every f64-born value is narrowed to f32, and whether the narrowed value
+later feeds a reduction, a factorization, or a matmul accumulation.
+Five rules over the committed entry builders
+(:mod:`..jaxprcheck.entries`):
+
+- **N1 silent-downcast-into-accumulation** — a ``convert_element_type``
+  f64→f32 outside every declared mixed-precision island whose result
+  reaches a reduce/Cholesky/solve/dot-contraction sink (the one-line
+  ``.astype`` that silently biases a posterior).
+- **N2 unpinned-reassociation** — a reassociation-sensitive reduction
+  (``reduce_sum``-class over fp, or a scan-carried fp accumulation)
+  whose summation order is not pinned by a ``declared_orders`` contract
+  entry (the PR 8 segmented-Gram order note, machine-checked).
+- **N3 tf32-hazard** — an f32 ``dot_general`` with default precision
+  consuming data that was ever f64 (on GPU the MXU would run it in
+  tf32, 10-bit mantissa, silently).
+- **N4 missing-exact-body** — every f32 steady sweep body must have a
+  registered paired f64 exact body with an identical shape signature,
+  and the refresh cadence must be declared in-contract (the PR 3
+  ``_chunk_fn`` pair, promoted from convention to checked property).
+- **N5 error-ledger drift** — the first-order op-count ULP bound per
+  source block (joined with the cost model's FLOP attribution) drifted
+  past the contract pin: mixed-precision changes must re-pin the
+  ledger, not assert safety in prose.
+
+Contracts are ``contracts/*.json`` files with ``"tool": "numcheck"``;
+findings ratchet against ``numcheck_baseline.json`` with racecheck's
+justified-baseline semantics (TODO stubs rejected).  A trailing
+``# numcheck: disable=N1`` comment on the flagged source line
+suppresses a finding.  Everything is host-side tracing on the CPU
+backend — nothing executes on a device.
+"""
+
+from .provenance import ProvReport, analyze_provenance
+from .rules import check_rules
+from .runner import (Violation, discover_contracts, run_contract,
+                     run_contracts)
+
+__all__ = ["ProvReport", "Violation", "analyze_provenance", "check_rules",
+           "discover_contracts", "run_contract", "run_contracts"]

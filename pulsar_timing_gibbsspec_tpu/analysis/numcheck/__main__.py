@@ -1,0 +1,132 @@
+"""CLI: audit the numcheck contracts, ratchet against the baseline.
+
+Usage::
+
+    python -m pulsar_timing_gibbsspec_tpu.analysis.numcheck [opts]
+
+    --fast             only contracts marked "fast": true (the ci_lint
+                       subset)
+    --contracts DIR    contract directory (default <repo>/contracts)
+    --json             machine-readable facts (incl. the N5 error
+                       ledger) + violations on stdout
+    --ledger PATH      also write the per-contract error ledgers to a
+                       JSON file
+    --baseline PATH    ratchet file (default <repo>/numcheck_baseline.json)
+    --no-baseline      report every finding, ignore the ratchet
+    --write-baseline   accept current findings as the new baseline
+                       (existing justifications kept; new pairs get a
+                       TODO stub the gate rejects until filled in)
+
+Exit status 1 when findings beyond the baseline exist or any baselined
+pair lacks a one-line justification.  Everything is host-side tracing
+on the CPU backend — nothing executes on a device.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from pathlib import Path
+
+_REPO_ROOT = Path(__file__).resolve().parents[3]
+
+
+def _bootstrap_cpu():
+    """Force the CPU backend with enough host devices for the sharded
+    entries, before any backend initializes."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="numcheck",
+        description="static precision-flow / reassociation / exact-body "
+                    "auditor over the traced entry builders (CPU "
+                    "tracing only, no device execution)")
+    ap.add_argument("--fast", action="store_true",
+                    help="only contracts marked fast")
+    ap.add_argument("--contracts", default=None, metavar="DIR")
+    ap.add_argument("--json", action="store_true", dest="as_json")
+    ap.add_argument("--ledger", default=None, metavar="PATH",
+                    help="write the per-contract error ledgers here")
+    ap.add_argument("--baseline",
+                    default=str(_REPO_ROOT / "numcheck_baseline.json"))
+    ap.add_argument("--no-baseline", action="store_true")
+    ap.add_argument("--write-baseline", action="store_true")
+    args = ap.parse_args(argv)
+
+    _bootstrap_cpu()
+
+    from ..baseline import (check_justifications, compare_to_baseline,
+                            load_justified_baseline,
+                            write_justified_baseline)
+    from .runner import discover_contracts, run_contracts
+
+    contracts = discover_contracts(args.contracts, fast_only=args.fast)
+    if not contracts:
+        print("numcheck: no contracts found", file=sys.stderr)
+        return 2
+    violations, facts = run_contracts(contracts)
+
+    if args.ledger:
+        ledgers = {name: f.get("ledger") for name, f in facts.items()}
+        out = Path(args.ledger)
+        if out.is_dir():
+            out = out / "numcheck_ledger.json"
+        out.write_text(
+            json.dumps(ledgers, indent=2, sort_keys=True) + "\n")
+
+    if args.write_baseline:
+        data = write_justified_baseline(args.baseline, violations,
+                                        _REPO_ROOT)
+        todo = check_justifications(data)
+        print(f"numcheck: baseline written to {args.baseline} "
+              f"({len(violations)} finding(s), {len(todo)} "
+              "justification(s) to fill in)")
+        return 0
+
+    if args.no_baseline:
+        new, stale, missing = list(violations), [], []
+    else:
+        data = load_justified_baseline(args.baseline)
+        new, stale = compare_to_baseline(violations, data["violations"],
+                                         _REPO_ROOT)
+        missing = check_justifications(data)
+
+    if args.as_json:
+        print(json.dumps(
+            {"contracts": [c.get("name") for c in contracts],
+             "facts": facts,
+             "violations": [
+                 {"path": v.path, "rule": v.rule, "message": v.message}
+                 for v in violations],
+             "new": len(new),
+             "missing_justifications": [list(m) for m in missing]},
+            indent=2, sort_keys=True))
+    else:
+        for v in new:
+            print(str(v))
+        for f, rule, base, cur in stale:
+            print(f"stale baseline entry: {f} [{rule}] baseline {base} "
+                  f"> current {cur}; ratchet the baseline down")
+        for f, rule in missing:
+            print(f"baselined without justification: {f} [{rule}] — add "
+                  f"a one-line reason under justifications in "
+                  f"{Path(args.baseline).name}")
+        ok = "OK" if not new and not missing else "FAIL"
+        print(f"numcheck: {len(contracts)} contract(s), "
+              f"{len(violations)} finding(s), {len(new)} new — {ok}")
+    return 1 if (new or missing) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,166 @@
+"""N5 — the first-order error ledger.
+
+Per source block (``file.py:fn``, the attribution ``source_of`` gives
+every leaf equation), accumulate scan-scaled op counts by fp dtype, the
+longest accumulation chain (dot contraction size, reduce length, or
+Cholesky order — the ``n`` of the classic ``n·eps`` forward-error
+bound), and the cost model's FLOP attribution.  The block's
+``ulp_bound_rel`` is ``max_chain · eps(dtype)`` — the standard
+first-order relative rounding bound for a length-``n`` recursive
+sum/contraction (Higham, *Accuracy and Stability of Numerical
+Algorithms*, §4.2, dropping the O(eps²) terms).
+
+The ledger is machine-readable JSON: a mixed-precision PR that moves a
+block's chain length or dtype *must* re-pin the contract's
+``ledger.max_ulp_rel`` instead of asserting safety in prose — that is
+the whole point of N5.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ..jaxprcheck.cost import (_ELEMENTWISE, _REDUCTIONS,
+                               _dot_general_flops, _linalg_flops)
+from ..jaxprcheck.walk import source_of, subjaxprs
+from .provenance import _FP, _dot_k, _reduce_length
+
+_EPS = {"float16": float(np.finfo(np.float16).eps),
+        "bfloat16": 2.0 ** -7,
+        "float32": float(np.finfo(np.float32).eps),
+        "float64": float(np.finfo(np.float64).eps)}
+
+_FACTOR = {"cholesky", "triangular_solve"}
+
+
+def _dtype(v):
+    aval = getattr(v, "aval", None)
+    dt = getattr(aval, "dtype", None)
+    return None if dt is None else str(dt)
+
+
+def _nelems(v) -> int:
+    n = 1
+    for s in getattr(getattr(v, "aval", None), "shape", ()) or ():
+        n *= int(s)
+    return n
+
+
+class _Block:
+    __slots__ = ("flops", "dot_flops", "ops", "max_chain")
+
+    def __init__(self):
+        self.flops = 0.0
+        self.dot_flops = 0.0
+        self.ops = {}
+        self.max_chain = {}
+
+    def charge(self, dtype, elems, flops, chain, scale, is_dot=False):
+        self.flops += flops * scale
+        if is_dot:
+            self.dot_flops += flops * scale
+        if dtype in _FP:
+            self.ops[dtype] = self.ops.get(dtype, 0.0) + elems * scale
+            if chain > self.max_chain.get(dtype, 0):
+                self.max_chain[dtype] = int(chain)
+
+
+def _walk(jaxpr, blocks, scale):
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        if name == "scan":
+            length = float(eqn.params.get("length", 1) or 1)
+            sub = eqn.params["jaxpr"]
+            _walk(getattr(sub, "jaxpr", sub), blocks, scale * length)
+            continue
+        subs = subjaxprs(eqn)
+        if subs:
+            for sub in subs:
+                _walk(sub, blocks, scale)
+            continue
+        dt = _dtype(eqn.outvars[0]) if eqn.outvars else None
+        f, ln, fn = source_of(eqn)
+        key = f"{os.path.basename(f)}:{fn}"
+        blk = blocks.get(key)
+        if blk is None:
+            blk = blocks[key] = _Block()
+        if name == "dot_general":
+            blk.charge(dt, sum(_nelems(o) for o in eqn.outvars),
+                       _dot_general_flops(eqn), _dot_k(eqn), scale,
+                       is_dot=True)
+        elif name in _FACTOR:
+            shape = getattr(eqn.invars[0].aval, "shape", ())
+            n = int(shape[-1]) if shape else 1
+            blk.charge(dt, sum(_nelems(o) for o in eqn.outvars),
+                       _linalg_flops(name, eqn), n, scale)
+        elif name in _REDUCTIONS:
+            dt_in = _dtype(eqn.invars[0])
+            blk.charge(dt_in, sum(_nelems(v) for v in eqn.invars),
+                       float(sum(_nelems(v) for v in eqn.invars)),
+                       _reduce_length(eqn), scale)
+        elif name in _ELEMENTWISE:
+            n = sum(_nelems(o) for o in eqn.outvars)
+            blk.charge(dt, n, float(n), 1, scale)
+
+
+def error_ledger(closed_jaxpr) -> dict:
+    """The full machine-readable ledger for one traced program."""
+    blocks: dict = {}
+    _walk(closed_jaxpr.jaxpr, blocks, 1.0)
+    out_blocks = []
+    max_ulp: dict = {}
+    for key in sorted(blocks):
+        blk = blocks[key]
+        if not blk.ops:
+            continue
+        ulp = {d: blk.max_chain.get(d, 1) * _EPS[d] for d in blk.ops}
+        for d, v in ulp.items():
+            if v > max_ulp.get(d, 0.0):
+                max_ulp[d] = v
+        out_blocks.append({
+            "block": key,
+            "flops": blk.flops,
+            "dot_flops": blk.dot_flops,
+            "ops": {d: blk.ops[d] for d in sorted(blk.ops)},
+            "max_chain": {d: blk.max_chain.get(d, 1)
+                          for d in sorted(blk.ops)},
+            "ulp_bound_rel": {d: ulp[d] for d in sorted(ulp)},
+        })
+    return {"blocks": out_blocks,
+            "max_ulp_rel": {d: max_ulp[d] for d in sorted(max_ulp)}}
+
+
+def check_ledger(ledger: dict, contract: dict) -> list:
+    """``[(rule, message, file, line)]`` — N5 drift of the program-wide
+    per-dtype ULP bound beyond the contract pin."""
+    spec = contract.get("ledger")
+    if not spec:
+        return []
+    out = []
+    tol = float(spec.get("tolerance_rel", 0.25))
+    want = spec.get("max_ulp_rel", {})
+    got = ledger.get("max_ulp_rel", {})
+    for d in sorted(set(want) | set(got)):
+        w, g = want.get(d), got.get(d)
+        if w is None:
+            out.append((
+                "N5",
+                f"error ledger grew a {d} accumulation chain "
+                f"(ulp_bound_rel={g:.3g}) the contract does not pin — "
+                "re-pin ledger.max_ulp_rel", None, None))
+        elif g is None:
+            out.append((
+                "N5",
+                f"contract pins a {d} ulp bound ({w:.3g}) but the "
+                f"program no longer has {d} accumulations — ratchet "
+                "the pin out", None, None))
+        elif abs(g - w) > tol * w:
+            out.append((
+                "N5",
+                f"error-ledger drift on {d}: measured max ulp_bound_rel "
+                f"{g:.6g}, contract pins {w:.6g} (±{tol:.0%}) — a chain "
+                "length or dtype moved; re-pin the ledger deliberately",
+                None, None))
+    return out

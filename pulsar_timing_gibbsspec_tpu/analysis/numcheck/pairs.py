@@ -1,0 +1,106 @@
+"""N4 — the f32-steady / f64-exact body pairing, checked.
+
+PR 3 established the convention: every mixed-precision steady sweep
+body (``_sweep_body("mh")``) is paired with an f64 exact body
+(``_sweep_body("exact")``) of identical shape signature, and the
+chunk's iteration-level ``lax.cond`` refreshes through the exact body
+every ``exact_every`` sweeps.  Until now nothing checked it — deleting
+the pairing (or letting the signatures drift so the cond could no
+longer select between them) would only surface as a distant KS
+failure.
+
+``check_pair`` proves, for a live driver:
+
+1. a paired f64 exact body exists (building it must not raise),
+2. both bodies trace to the *same* abstract output signature under
+   identical abstract inputs (``jax.eval_shape`` — nothing executes),
+3. the refresh cadence is declared in-contract and matches the
+   driver's ``exact_every``.
+
+``body_signature`` / ``compare_signatures`` are the unit surface the
+mutation self-test drives with seeded defects.
+"""
+
+from __future__ import annotations
+
+
+def body_signature(drv, bdraw: str):
+    """Flat ``[(shape, dtype), ...]`` abstract output signature of one
+    sweep body, traced with the driver's own carry/aux avals."""
+    import jax
+    import jax.numpy as jnp
+    import jax.random as jr
+    import numpy as np
+
+    body = drv._sweep_body(bdraw)
+    cm = drv.cm
+    x = jax.ShapeDtypeStruct((cm.nx,), cm.dtype)
+    b = jax.ShapeDtypeStruct((cm.P, cm.Bmax), cm.cdtype)
+    u = jax.ShapeDtypeStruct(np.shape(cm.y), cm.dtype)
+    # the chunk vmaps the body over chains with every aux leaf mapped
+    # at axis 0 (_make_chunk: in_axes=(0, 0, 0, None)) — the
+    # single-chain body sees aux with the chain axis stripped
+    aux = jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct(np.shape(a)[1:], a.dtype),
+        drv._aux())
+    out = jax.eval_shape(lambda c, k, a, t: body(c, k, a, t),
+                         (x, b, u), jr.key(0), aux, jnp.int32(0))
+    leaves = jax.tree_util.tree_leaves(out)
+    return [(tuple(getattr(leaf, "shape", ())),
+             str(getattr(leaf, "dtype", "?"))) for leaf in leaves]
+
+
+def compare_signatures(sig_mh, sig_exact) -> list:
+    """Human-readable mismatches between two body signatures."""
+    out = []
+    if len(sig_mh) != len(sig_exact):
+        out.append(
+            f"body pair output arity differs: steady has {len(sig_mh)} "
+            f"leaves, exact has {len(sig_exact)}")
+        return out
+    for i, (a, b) in enumerate(zip(sig_mh, sig_exact)):
+        if a != b:
+            out.append(
+                f"body pair signature mismatch at leaf {i}: steady "
+                f"{a[0]}/{a[1]} vs exact {b[0]}/{b[1]}")
+    return out
+
+
+def check_pair(drv, contract: dict) -> list:
+    """``[(rule, message, file, line)]`` N4 findings for one driver."""
+    out = []
+    cadence = contract.get("exact_every")
+    if drv is None:
+        return out
+    if cadence is None:
+        out.append((
+            "N4",
+            "the contract declares no exact_every cadence — the f64 "
+            "refresh cadence must be pinned in-contract, not implied "
+            "by the driver default", None, None))
+    elif int(cadence) != int(drv.exact_every):
+        out.append((
+            "N4",
+            f"declared cadence exact_every={int(cadence)} does not "
+            f"match the driver's exact_every={int(drv.exact_every)} — "
+            "re-pin the contract or fix the driver", None, None))
+    if getattr(drv.cm, "has_ke", False):
+        # kernel ECORR runs the exact body only — no pair to check
+        return out
+    try:
+        sig_exact = body_signature(drv, "exact")
+    except Exception as e:      # noqa: BLE001 - the finding IS the report
+        out.append((
+            "N4",
+            f"no registered f64 exact body pairs the f32 steady body "
+            f"(building/tracing it failed: {type(e).__name__}: {e})",
+            None, None))
+        return out
+    sig_mh = body_signature(drv, "mh")
+    for msg in compare_signatures(sig_mh, sig_exact):
+        out.append((
+            "N4",
+            msg + " — the chunk's lax.cond cannot alternate bodies "
+            "whose signatures differ; the pairing contract is broken",
+            None, None))
+    return out

@@ -1,0 +1,440 @@
+"""The precision-provenance dataflow machine.
+
+A forward taint walk over the traced jaxpr (the same traversal scheme
+as jaxprcheck's key-lineage machine): every ``convert_element_type``
+f64→f32 creates a :class:`Narrow` taint that propagates through all
+floating-point dataflow — across pjit/scan/cond/while boundaries via
+the tail-aligned invar mapping, loop bodies iterated to a fixed point
+(taint sets only grow, so the iteration is monotone and converges) —
+and is recorded as a :class:`SinkHit` when it reaches an accumulation
+sink (reduce_sum-class over enough elements, a Cholesky/solve, or a
+dot_general contraction).
+
+The walk also collects the raw material of the N2/N3 rules: every
+reassociation-sensitive reduction (including scan-carried fp
+accumulations, found structurally: an fp carry whose output is an
+add-chain over its input) and every dot_general with its precision
+parameter and input-taint status.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+from ..jaxprcheck.walk import source_of, subjaxprs
+
+#: reduce-class primitives that are reassociation-sensitive over fp
+_REDUCE_SINKS = {"reduce_sum", "reduce_prod", "cumsum", "cumprod"}
+
+#: factorization / solve sinks — error there multiplies through the
+#: whole conditional draw, so any tainted input counts regardless of size
+_FACTOR_SINKS = {"cholesky", "triangular_solve"}
+
+#: additive primitives an accumulation chain is made of
+_ADDITIVE = {"add", "add_any"}
+
+#: movement primitives an accumulation chain may pass through unchanged
+_CHAIN_PASS = {
+    "convert_element_type", "reshape", "broadcast_in_dim", "transpose",
+    "squeeze", "slice", "dynamic_slice", "dynamic_update_slice",
+    "select_n", "copy", "expand_dims", "concatenate", "rev",
+}
+
+_FP = {"float16", "bfloat16", "float32", "float64"}
+
+
+def _is_var(v) -> bool:
+    import jax
+
+    return isinstance(v, jax.core.Var)
+
+
+def _dtype(v):
+    aval = getattr(v, "aval", None)
+    dt = getattr(aval, "dtype", None)
+    return None if dt is None else str(dt)
+
+
+def _is_fp(v) -> bool:
+    return _dtype(v) in _FP
+
+
+@dataclasses.dataclass(frozen=True)
+class Site:
+    """A source location a finding anchors to."""
+
+    file: str
+    line: int
+    fn: str
+
+    @property
+    def block(self) -> str:
+        return f"{os.path.basename(self.file)}:{self.fn}"
+
+    def __str__(self):
+        return f"{self.fn} at {os.path.basename(self.file)}:{self.line}"
+
+
+@dataclasses.dataclass(frozen=True)
+class Narrow:
+    """One f64→f32 ``convert_element_type`` site (a taint source)."""
+
+    site: Site
+    islanded: bool          # inside a declared mixed-precision island
+
+
+@dataclasses.dataclass(frozen=True)
+class Reduction:
+    """A reassociation-sensitive fp reduction."""
+
+    site: Site
+    kind: str               # reduce_sum / cumsum / ... / scan_carry
+    dtype: str
+    length: int             # elements folded into one result
+
+
+@dataclasses.dataclass(frozen=True)
+class Dot:
+    """A dot_general with its precision and input-taint status."""
+
+    site: Site
+    out_dtype: str
+    highest: bool
+    k: int                  # contraction size
+    tainted: bool           # any input was ever f64 (islanded or not)
+
+
+@dataclasses.dataclass(frozen=True)
+class SinkHit:
+    """A narrowed value reaching an accumulation/factorization sink."""
+
+    narrow: Narrow
+    sink_kind: str
+    sink: Site
+
+
+@dataclasses.dataclass
+class ProvReport:
+    """Everything the rules need, plus the census that pins topology."""
+
+    narrows: list = dataclasses.field(default_factory=list)
+    reductions: list = dataclasses.field(default_factory=list)
+    dots: list = dataclasses.field(default_factory=list)
+    sink_hits: list = dataclasses.field(default_factory=list)
+
+    def narrow_census(self) -> dict:
+        """``{"file.py:fn": count}`` over every f64→f32 narrow — the
+        committed fingerprint of the program's precision topology."""
+        out: dict = {}
+        for n in self.narrows:
+            out[n.site.block] = out.get(n.site.block, 0) + 1
+        return dict(sorted(out.items()))
+
+
+def _in_island(site: Site, islands) -> bool:
+    from ..jaxprcheck.dtypes import _in_island as impl
+
+    return impl(site.fn, site.file, islands)
+
+
+def _is_highest(precision) -> bool:
+    from ..jaxprcheck.dtypes import _is_highest as impl
+
+    return impl(precision)
+
+
+def _reduce_length(eqn) -> int:
+    axes = eqn.params.get("axes", ())
+    shape = getattr(eqn.invars[0].aval, "shape", ())
+    n = 1
+    for a in axes:
+        n *= int(shape[a])
+    if eqn.primitive.name in ("cumsum", "cumprod"):
+        ax = eqn.params.get("axis", 0)
+        n = int(shape[ax]) if shape else 1
+    return n
+
+
+def _dot_k(eqn) -> int:
+    (lc, _rc), _b = eqn.params["dimension_numbers"]
+    ls = getattr(eqn.invars[0].aval, "shape", ())
+    k = 1
+    for i in lc:
+        k *= int(ls[i])
+    return k
+
+
+class _Walker:
+    """Forward taint propagation; ``state``: var -> frozenset[Narrow]."""
+
+    def __init__(self, report: ProvReport, islands, min_reduce: int):
+        self.r = report
+        self.islands = set(islands)
+        self.min_reduce = int(min_reduce)
+        self._mute = 0          # >0 during loop fixed-point pre-passes
+        self._seen_hits = set()
+
+    # -- recording (suppressed during fixed-point pre-passes) -------------
+    def _rec_narrow(self, n: Narrow):
+        if not self._mute:
+            self.r.narrows.append(n)
+
+    def _rec_reduce(self, red: Reduction):
+        if not self._mute:
+            self.r.reductions.append(red)
+
+    def _rec_dot(self, d: Dot):
+        if not self._mute:
+            self.r.dots.append(d)
+
+    def _rec_hits(self, taint, kind, sink: Site):
+        if self._mute:
+            return
+        for nv in taint:
+            key = (nv.site, kind, sink.block)
+            if key not in self._seen_hits:
+                self._seen_hits.add(key)
+                self.r.sink_hits.append(SinkHit(nv, kind, sink))
+
+    # -- the machine -------------------------------------------------------
+    def walk(self, jaxpr, state):
+        for eqn in jaxpr.eqns:
+            self._eqn(eqn, state)
+        return [state.get(v, frozenset()) if _is_var(v) else frozenset()
+                for v in jaxpr.outvars]
+
+    def _taint_in(self, eqn, state):
+        t = frozenset()
+        for v in eqn.invars:
+            if _is_var(v) and v in state:
+                t = t | state[v]
+        return t
+
+    def _propagate(self, eqn, state, taint):
+        if not taint:
+            return
+        for o in eqn.outvars:
+            if _is_fp(o):
+                state[o] = state.get(o, frozenset()) | taint
+
+    def _eqn(self, eqn, state):
+        name = eqn.primitive.name
+        subs = subjaxprs(eqn)
+        if subs:
+            self._call(eqn, subs, state)
+            return
+        taint = self._taint_in(eqn, state)
+        if name == "convert_element_type":
+            if _dtype(eqn.invars[0]) == "float64" and \
+                    _dtype(eqn.outvars[0]) == "float32":
+                site = Site(*source_of(eqn))
+                nv = Narrow(site, _in_island(site, self.islands))
+                self._rec_narrow(nv)
+                taint = taint | {nv}
+        elif name in _REDUCE_SINKS:
+            if _is_fp(eqn.invars[0]):
+                n = _reduce_length(eqn)
+                if n >= self.min_reduce:
+                    site = Site(*source_of(eqn))
+                    self._rec_reduce(Reduction(
+                        site, name, _dtype(eqn.invars[0]), n))
+                    self._rec_hits(taint, name, site)
+        elif name == "dot_general":
+            site = Site(*source_of(eqn))
+            k = _dot_k(eqn)
+            self._rec_dot(Dot(site, _dtype(eqn.outvars[0]),
+                              _is_highest(eqn.params.get("precision")),
+                              k, bool(taint)))
+            if k >= self.min_reduce:
+                self._rec_hits(taint, name, site)
+        elif name in _FACTOR_SINKS:
+            site = Site(*source_of(eqn))
+            self._rec_hits(taint, name, site)
+        self._propagate(eqn, state, taint)
+
+    # -- call boundaries ---------------------------------------------------
+    def _map_in(self, eqn, sub, state):
+        """Outer args onto the body's trailing invars: pjit is exactly
+        1:1; scan's invars = consts + carry + xs match the body; cond
+        prepends only the predicate; while prepends consts the body
+        never sees — every convention here tail-aligns."""
+        sub_state = {}
+        args = list(eqn.invars)
+        for bv, ov in zip(reversed(sub.invars), reversed(args)):
+            if _is_var(ov) and ov in state:
+                sub_state[bv] = state[ov]
+        return sub_state
+
+    def _map_out(self, eqn, state, out_states):
+        """Body out-states back onto the outer outvars, 1:1 from the
+        front; also conservatively forward outer input taint through
+        the call result (a tainted operand feeding any body path may
+        surface in any output)."""
+        for o, st in zip(eqn.outvars, out_states or []):
+            if st and _is_fp(o):
+                state[o] = state.get(o, frozenset()) | st
+        self._propagate(eqn, state, self._taint_in(eqn, state))
+
+    def _call(self, eqn, subs, state):
+        name = eqn.primitive.name
+        if name == "scan":
+            self._scan(eqn, state)
+            return
+        if name == "while":
+            self._while(eqn, state)
+            return
+        # pjit / cond / custom_* — walk each body once; cond branches
+        # are alternatives, so out-states union per position
+        out_states = None
+        for sub in subs:
+            outs = self.walk(sub, self._map_in(eqn, sub, state))
+            if out_states is None:
+                out_states = outs
+            else:
+                out_states = [a | b for a, b in zip(out_states, outs)]
+        self._map_out(eqn, state, out_states)
+
+    def _scan(self, eqn, state):
+        closed = eqn.params["jaxpr"]
+        sub = getattr(closed, "jaxpr", closed)
+        nc = int(eqn.params.get("num_consts", 0))
+        ncar = int(eqn.params.get("num_carry", 0))
+        sub_state = self._map_in(eqn, sub, state)
+        outs = self._fixpoint(sub, sub_state,
+                              sub.invars[nc:nc + ncar], range(ncar))
+        self._scan_carry_accums(eqn)
+        self._map_out(eqn, state, outs)
+
+    def _while(self, eqn, state):
+        body = eqn.params["body_jaxpr"]
+        body = getattr(body, "jaxpr", body)
+        cond = eqn.params["cond_jaxpr"]
+        cond = getattr(cond, "jaxpr", cond)
+        ncar = len(body.outvars)
+        sub_state = self._map_in(eqn, body, state)
+        outs = self._fixpoint(body, sub_state,
+                              body.invars[len(body.invars) - ncar:],
+                              range(ncar))
+        # the predicate jaxpr only decides the trip count — walk it for
+        # event recording, discard its out-state
+        self.walk(cond, self._map_in(eqn, cond, state))
+        self._map_out(eqn, state, outs)
+
+    def _fixpoint(self, sub, sub_state, carry_in, carry_out_ix):
+        """Iterate a loop body to a taint fixed point (recording muted),
+        then one final recorded pass.  Taint sets only grow, so the
+        iteration is monotone; the cap is a safety net."""
+        self._mute += 1
+        try:
+            for _ in range(4):
+                outs = self.walk(sub, dict(sub_state))
+                grew = False
+                for bv, oi in zip(carry_in, carry_out_ix):
+                    cur = sub_state.get(bv, frozenset())
+                    new = cur | outs[oi]
+                    if new != cur:
+                        sub_state[bv] = new
+                        grew = True
+                if not grew:
+                    break
+        finally:
+            self._mute -= 1
+        return self.walk(sub, dict(sub_state))
+
+    def _scan_carry_accums(self, eqn):
+        """Structural N2 source: an fp scan carry whose output is an
+        add-chain over its own input is a carried accumulation — its
+        effective summation length is the scan trip count."""
+        if self._mute:
+            return
+        closed = eqn.params.get("jaxpr")
+        body = getattr(closed, "jaxpr", closed)
+        if body is None:
+            return
+        nc = int(eqn.params.get("num_consts", 0))
+        ncar = int(eqn.params.get("num_carry", 0))
+        length = int(eqn.params.get("length", 0) or 0)
+        if length < self.min_reduce:
+            return
+        eqns, resolve = _flatten_pjit(body)
+        producer = {}
+        for e in eqns:
+            for o in e.outvars:
+                producer[resolve(o)] = e
+        for ci, co in zip(body.invars[nc:nc + ncar], body.outvars[:ncar]):
+            if not _is_fp(ci):
+                continue
+            site = self._find_add_chain(resolve(co), resolve(ci),
+                                        producer, resolve)
+            if site is not None:
+                self._rec_reduce(Reduction(site, "scan_carry",
+                                           _dtype(ci), length))
+
+    def _find_add_chain(self, start, target, producer, resolve):
+        """Backward reachability ``start -> target`` through additive and
+        movement primitives; returns the Site of an add on the path."""
+        stack = [(start, None)]
+        seen = set()
+        while stack:
+            v, add_site = stack.pop()
+            if v is target and add_site is not None:
+                return add_site
+            if id(v) in seen:
+                continue
+            seen.add(id(v))
+            e = producer.get(v)
+            if e is None:
+                continue
+            name = e.primitive.name
+            if name in _ADDITIVE:
+                site = Site(*source_of(e))
+                for iv in e.invars:
+                    if _is_var(iv):
+                        stack.append((resolve(iv), site))
+            elif name in _CHAIN_PASS:
+                for iv in e.invars:
+                    if _is_var(iv):
+                        stack.append((resolve(iv), add_site))
+        return None
+
+
+def _flatten_pjit(jaxpr):
+    """``(leaf_eqns, resolve)`` with pjit bodies inlined: traversals see
+    through nested jit boundaries by resolving a pjit outvar to the
+    body outvar that produced it and a body invar back to the outer
+    argument feeding it."""
+    eqns, alias = [], {}
+
+    def go(j):
+        for e in j.eqns:
+            if e.primitive.name == "pjit":
+                sub = e.params["jaxpr"]
+                sub = getattr(sub, "jaxpr", sub)
+                for bv, ov in zip(reversed(sub.invars),
+                                  reversed(list(e.invars))):
+                    if _is_var(bv) and _is_var(ov):
+                        alias[bv] = ov
+                for o, so in zip(e.outvars, sub.outvars):
+                    if _is_var(o) and _is_var(so):
+                        alias[o] = so
+                go(sub)
+            else:
+                eqns.append(e)
+
+    go(jaxpr)
+
+    def resolve(v):
+        while v in alias:
+            v = alias[v]
+        return v
+
+    return eqns, resolve
+
+
+def analyze_provenance(closed_jaxpr, islands=(), min_reduce=8) -> ProvReport:
+    """Run the taint machine over a whole traced program."""
+    report = ProvReport()
+    walker = _Walker(report, islands, min_reduce)
+    walker.walk(closed_jaxpr.jaxpr, {})
+    return report

@@ -1,0 +1,95 @@
+"""N1–N3 rule evaluation over a :class:`.provenance.ProvReport`.
+
+Each rule reads the contract's declarations:
+
+- ``islands``: mixed-precision islands (jaxprcheck island syntax — a
+  function name, a file basename, or ``file.py:fn``) where f64→f32
+  narrowing is *by design* (the steady mixed path, the two-float
+  kernels).  N1 only fires for narrows outside every island.
+- ``declared_orders``: ``[{"fn": <island-spec>, "order": <text>}]`` —
+  the pinned summation-order notes N2 matches reductions against.  An
+  entry with an empty ``order`` does not count: the point is the
+  committed prose, not the key.
+- ``narrow_census``: exact ``{"file.py:fn": count}`` pin of every
+  narrow site — any new ``.astype`` anywhere moves the census and
+  fails the ``census`` rule even when N1's dataflow cannot see a sink.
+
+Findings are ``(rule, message, file, line)`` tuples; the runner
+attaches contract paths and applies source pragmas.
+"""
+
+from __future__ import annotations
+
+import json
+
+from ..jaxprcheck.dtypes import _in_island
+from .provenance import ProvReport
+
+RULES = {
+    "N1": "silent-downcast-into-accumulation",
+    "N2": "unpinned-reassociation",
+    "N3": "tf32-hazard",
+    "N4": "missing-exact-body",
+    "N5": "error-ledger-drift",
+}
+
+
+def check_rules(rep: ProvReport, contract: dict) -> list:
+    """``[(rule, message, src_file, src_line)]`` for N1/N2/N3 plus the
+    narrow-census topology pin."""
+    out = []
+    islands = set(contract.get("islands", ()))
+    declared = [d for d in contract.get("declared_orders", ())
+                if str(d.get("order", "")).strip()]
+
+    # N1 — a non-islanded narrow reaching an accumulation sink
+    for hit in rep.sink_hits:
+        if hit.narrow.islanded:
+            continue
+        out.append((
+            "N1",
+            f"silent f64→f32 downcast at {hit.narrow.site} flows into "
+            f"a {hit.sink_kind} sink at {hit.sink} outside every "
+            f"declared mixed-precision island (islands: "
+            f"{sorted(islands)}) — widen, or declare the island and "
+            "justify it",
+            hit.narrow.site.file, hit.narrow.site.line))
+
+    # N2 — reassociation-sensitive reductions without a pinned order
+    for red in rep.reductions:
+        if any(_in_island(red.site.fn, red.site.file, {d["fn"]})
+               for d in declared):
+            continue
+        out.append((
+            "N2",
+            f"reassociation-sensitive {red.kind} over {red.length} "
+            f"{red.dtype} element(s) at {red.site} has no pinned "
+            "summation order — add a declared_orders entry stating the "
+            "order (the PR 8 segmented-Gram note, as contract)",
+            red.site.file, red.site.line))
+
+    # N3 — default-precision f32 dots consuming once-f64 data
+    for d in rep.dots:
+        if d.out_dtype != "float32" or d.highest or not d.tainted:
+            continue
+        out.append((
+            "N3",
+            f"f32 dot_general (k={d.k}) at {d.site} runs at default "
+            "precision on data that was f64 upstream — on GPU the MXU "
+            "lowers this to tf32 (10-bit mantissa) silently; pass "
+            'precision="highest" or justify',
+            d.site.file, d.site.line))
+
+    # census — the committed precision-topology fingerprint
+    want = contract.get("narrow_census")
+    if want is not None:
+        got = rep.narrow_census()
+        if json.dumps(got, sort_keys=True) != \
+                json.dumps(dict(want), sort_keys=True):
+            out.append((
+                "census",
+                f"narrow-site census drift: measured {got}, contract "
+                f"pins {dict(sorted(want.items()))} — every new/removed "
+                "f64→f32 cast must re-pin the topology",
+                None, None))
+    return out

@@ -1,0 +1,155 @@
+"""Contract discovery, per-contract dispatch, pragma suppression.
+
+A numcheck contract is a committed JSON file under
+``<repo>/contracts/`` tagged ``"tool": "numcheck"``:
+
+.. code-block:: json
+
+    {
+      "name": "numerics_crn",
+      "tool": "numcheck",
+      "fast": true,
+      "entry": {"entry": "chunk", "n_psr": 3, "ntoa": 40},
+      "exact_every": 16,
+      "islands": ["jax_backend.py:parallel_cov_mh_scan", "linalg.py"],
+      "declared_orders": [{"fn": "jax_backend.py:ll_rel",
+                           "order": "single fused reduce, fixed layout"}],
+      "narrow_census": {"jax_backend.py:ll_rel": 4},
+      "ledger": {"max_ulp_rel": {"float32": 1.4e-5}},
+      "min_reduce_elems": 8
+    }
+
+The ``tool`` tag keeps jaxprcheck's discovery from picking these up
+(it skips foreign-tool files) while its entry-coverage check still
+counts them — a numcheck contract pinning an entry builder covers it.
+
+Findings carry the contract path (the jaxprcheck Violation surface, so
+the shared ratchet applies) plus, where known, the *source* location
+of the offending equation — a trailing ``# numcheck: disable=N3``
+comment on that source line suppresses the finding, same pragma
+semantics as racecheck.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from pathlib import Path
+
+from ..jaxprcheck.entries import resolve_entry
+from ..jaxprcheck.runner import Violation, load_contract
+from ..jaxprcheck.walk import trace_jaxpr
+from .ledger import check_ledger, error_ledger
+from .pairs import check_pair
+from .provenance import analyze_provenance
+from .rules import check_rules
+
+_REPO_ROOT = Path(__file__).resolve().parents[3]
+CONTRACT_DIR = _REPO_ROOT / "contracts"
+BASELINE_NAME = "numcheck_baseline.json"
+
+_PRAGMA_RE = re.compile(r"#\s*numcheck:\s*disable=([A-Za-z0-9,\s]+)")
+
+
+def pragma_rules(line: str) -> set:
+    """Rules a trailing ``# numcheck: disable=...`` comment suppresses."""
+    m = _PRAGMA_RE.search(line)
+    if not m:
+        return set()
+    return {r.strip().upper() for r in m.group(1).split(",") if r.strip()}
+
+
+def _suppressed(rule, src_file, src_line) -> bool:
+    if not src_file or not src_line:
+        return False
+    try:
+        with open(src_file, encoding="utf-8") as fh:
+            for i, text in enumerate(fh, 1):
+                if i == int(src_line):
+                    disabled = pragma_rules(text)
+                    return rule.upper() in disabled or "ALL" in disabled
+    except OSError:
+        return False
+    return False
+
+
+def discover_contracts(root=None, fast_only=False) -> list:
+    root = Path(root) if root is not None else CONTRACT_DIR
+    out = []
+    for p in sorted(root.glob("*.json")):
+        c = load_contract(p)
+        if c.get("tool") != "numcheck":
+            continue
+        if fast_only and not c.get("fast", False):
+            continue
+        out.append(c)
+    return out
+
+
+def _relpath(path) -> str:
+    try:
+        return os.path.relpath(path, _REPO_ROOT)
+    except ValueError:
+        return str(path)
+
+
+def run_contract(contract: dict):
+    """``(violations, facts)`` for one loaded contract: trace the
+    entry once, run provenance + rules, the N4 pairing proof, and the
+    N5 ledger pin."""
+    path = _relpath(contract.get("_path", contract.get("name", "?")))
+    fn, args, extras = resolve_entry(contract["entry"])
+    closed = trace_jaxpr(fn, args)
+    rep = analyze_provenance(
+        closed, islands=contract.get("islands", ()),
+        min_reduce=contract.get("min_reduce_elems", 8))
+    led = error_ledger(closed)
+    findings = check_rules(rep, contract)
+    findings += check_pair(extras.get("driver"), contract)
+    findings += check_ledger(led, contract)
+    violations = [
+        Violation(path, rule, msg)
+        for rule, msg, src_file, src_line in findings
+        if not _suppressed(rule, src_file, src_line)]
+    facts = {"name": contract.get("name"),
+             "n_eqns": len(closed.jaxpr.eqns),
+             "narrow_census": rep.narrow_census(),
+             "n_reductions": len(rep.reductions),
+             "n_dots": len(rep.dots),
+             "n_sink_hits": len(rep.sink_hits),
+             "ledger": led}
+    return violations, facts
+
+
+def run_contracts(contracts):
+    """``(all_violations, {name: facts})``; a contract that errors out
+    becomes an ``error`` violation rather than an exception, so one
+    broken contract cannot mask the others."""
+    all_v, all_f = [], {}
+    for c in contracts:
+        path = _relpath(c.get("_path", c.get("name", "?")))
+        try:
+            v, f = run_contract(c)
+        except Exception as e:          # noqa: BLE001 - report, don't die
+            all_v.append(Violation(path, "error",
+                                   f"{type(e).__name__}: {e}"))
+            continue
+        all_v.extend(v)
+        all_f[c.get("name", path)] = f
+    return all_v, all_f
+
+
+def analyze_traced(closed_jaxpr, contract: dict | None = None):
+    """Unit surface for tests: provenance + rules over an already
+    traced program, contract declarations optional."""
+    contract = dict(contract or {})
+    rep = analyze_provenance(
+        closed_jaxpr, islands=contract.get("islands", ()),
+        min_reduce=contract.get("min_reduce_elems", 8))
+    return check_rules(rep, contract), rep
+
+
+def load_json(path) -> dict:
+    with open(path, encoding="utf-8") as fh:
+        return json.load(fh)

@@ -83,51 +83,12 @@ def analyze_repo(paths=None, config: dict | None = None,
     return run_passes(corpus, config), sorted(corpus.by_path)
 
 
-# -- the justified baseline ---------------------------------------------------
+# -- the justified baseline (shared with numcheck: ..baseline) ----------------
 
-def _just_key(file: str, rule: str) -> str:
-    return f"{file} [{rule}]"
-
-
-def load_baseline_file(path) -> dict:
-    p = Path(path)
-    if not p.exists():
-        return {"violations": {}, "justifications": {}}
-    data = json.loads(p.read_text())
-    data.setdefault("violations", {})
-    data.setdefault("justifications", {})
-    return data
-
-
-def check_justifications(data: dict) -> list:
-    """Baselined (file, rule) pairs whose justification is missing,
-    empty, or a TODO stub — each fails the gate."""
-    bad = []
-    just = data.get("justifications", {})
-    for f, rules in sorted(data.get("violations", {}).items()):
-        for rule in sorted(rules):
-            text = str(just.get(_just_key(f, rule), "")).strip()
-            if not text or text.upper().startswith("TODO"):
-                bad.append((f, rule))
-    return bad
-
-
-def write_baseline_file(path, findings, root: Path) -> dict:
-    """Write counts; keep existing justifications, stub new pairs with
-    a TODO the justification gate will reject until a human fills it."""
-    from ..baseline import baseline_counts
-
-    old = load_baseline_file(path)
-    counts = baseline_counts(findings, root)
-    just = {}
-    for f, rules in counts.items():
-        for rule in rules:
-            key = _just_key(f, rule)
-            just[key] = old["justifications"].get(
-                key, "TODO: one-line justification for accepting this")
-    data = {"violations": counts, "justifications": just}
-    Path(path).write_text(json.dumps(data, indent=2, sort_keys=True) + "\n")
-    return data
+from ..baseline import check_justifications  # noqa: E402,F401 - re-export
+from ..baseline import justification_key as _just_key  # noqa: E402,F401
+from ..baseline import load_justified_baseline as load_baseline_file  # noqa: E402,F401,E501
+from ..baseline import write_justified_baseline as write_baseline_file  # noqa: E402,F401,E501
 
 
 __all__ = ["RULES", "Finding", "analyze_repo", "analyze_sources",

@@ -16,6 +16,28 @@ import dataclasses
 import os
 
 
+class SettingsError(ValueError):
+    """A malformed process-wide setting — a bad constructor value or a
+    bad ``PTGIBBS_*`` environment override.  Typed so callers can tell
+    configuration mistakes from genuine ValueErrors in model code."""
+
+
+def _env_int(env: str, default: str) -> int:
+    """A positive-integer environment override, validated at read time
+    (Settings construction) instead of failing obscurely deep inside a
+    segmented-Gram reshape."""
+    raw = os.environ.get(env, default)
+    try:
+        val = int(str(raw).strip())
+    except (TypeError, ValueError) as e:
+        raise SettingsError(
+            f"{env}={raw!r} is not an integer") from e
+    if val <= 0:
+        raise SettingsError(
+            f"{env}={val} must be a positive integer")
+    return val
+
+
 @dataclasses.dataclass
 class Settings:
     """Process-wide knobs (read at model-compile time, not per-op)."""
@@ -59,7 +81,8 @@ class Settings:
     #: below the preconditioned system's smallest eigenvalue (~4.5e-6),
     #: so factors of the resulting Sigma stay safely positive definite
     #: while the einsum runs ~60x faster than f64 accumulation.
-    gram_seg_len: int = int(os.environ.get("PTGIBBS_GRAM_SEG", "96"))
+    gram_seg_len: int = dataclasses.field(
+        default_factory=lambda: _env_int("PTGIBBS_GRAM_SEG", "96"))
 
     #: TOA-segment length of the segmented EXACT Gram
     #: (sampler/jax_backend.tnt_d): per-segment f64-accumulated partial
@@ -74,8 +97,8 @@ class Settings:
     #: dimension bounded by this length the scratch collapses to one
     #: segment.  96 keeps the jaxprcheck HBM scratch model's calibration
     #: (hbm.DEFAULT_SEG_LEN) aligned with the program it audits.
-    gram_seg_len_exact: int = int(os.environ.get("PTGIBBS_GRAM_SEG_EXACT",
-                                                 "96"))
+    gram_seg_len_exact: int = dataclasses.field(
+        default_factory=lambda: _env_int("PTGIBBS_GRAM_SEG_EXACT", "96"))
 
     #: mixed-precision mode of the structured correlated-ORF joint b-draw
     #: (sampler/jax_backend.draw_b_joint_structured): when on, the steady
@@ -120,6 +143,18 @@ class Settings:
     #: betas[c % T]; only the beta=1 chains c % T == 0 are posterior
     #: samples).  1 disables tempering; requires ``ensemble`` on.
     pt_ladder: int = int(os.environ.get("PTGIBBS_PT_LADDER", "1"))
+
+    def __post_init__(self):
+        # segment lengths feed reshape/pad arithmetic in the segmented
+        # Grams — a zero, negative, or fractional length would surface
+        # as an opaque shape error deep inside tracing, so reject it
+        # here with a typed, named error instead
+        for name in ("gram_seg_len", "gram_seg_len_exact"):
+            v = getattr(self, name)
+            if isinstance(v, bool) or not isinstance(v, int) or v <= 0:
+                raise SettingsError(
+                    f"settings.{name}={v!r} must be a positive integer "
+                    "(env: PTGIBBS_GRAM_SEG / PTGIBBS_GRAM_SEG_EXACT)")
 
     def apply(self):
         """Push precision into the JAX config.  Called once at model-compile

@@ -303,7 +303,7 @@ def asis_rho_redraw(cm, x, b, u, key, beta=None):
     grid = 10.0 ** jnp.linspace(math.log10(cm.rhomin),
                                 math.log10(cm.rhomax),
                                 settings.rho_grid_size, dtype=fdt)
-    pr_ar = jnp.arange(P)
+    pr_ar = jnp.arange(P, dtype=jnp.int32)
 
     def step(carry, args):
         x, b, u = carry
@@ -352,7 +352,8 @@ def asis_rho_redraw(cm, x, b, u, key, beta=None):
         return (x, b, u), None
 
     keys = jr.split(key, K)
-    (x, b, u), _ = jax.lax.scan(step, (x, b, u), (jnp.arange(K), keys))
+    (x, b, u), _ = jax.lax.scan(step, (x, b, u),
+                                (jnp.arange(K, dtype=jnp.int32), keys))
     return x, b, u
 
 

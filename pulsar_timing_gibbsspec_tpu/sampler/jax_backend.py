@@ -192,7 +192,8 @@ def ke_segsum(cm: CompiledPTA, vals):
     E = cm.ke_par_ix.shape[1]
     shape = (cm.P, E + 1) + vals.shape[2:]
     out = jnp.zeros(shape, vals.dtype)
-    return out.at[jnp.arange(cm.P)[:, None], jnp.asarray(cm.ke_eid, jnp.int32)].add(vals)
+    return out.at[jnp.arange(cm.P, dtype=jnp.int32)[:, None],
+                  jnp.asarray(cm.ke_eid, jnp.int32)].add(vals)
 
 
 def ke_weights(cm: CompiledPTA, x, Nvec):
@@ -457,7 +458,7 @@ def draw_b_hd_sequential(cm: CompiledPTA, x, b, key, exact=False):
               else tnt_d_x(cm, x, N))                   # (P, B, B), (P, B)
     phi = cm.phi(x)
     pinv = 1.0 / phi                               # (P, B)
-    rows_p = jnp.arange(P)[:, None]
+    rows_p = jnp.arange(P, dtype=jnp.int32)[:, None]
     rho = 10.0 ** (2.0 * jnp.asarray(x, cdt)[cm.rho_ix_x])       # (K,)
     Ginv = cm.orf_ginv_k(x).astype(cdt)            # (K, P, P)
     gsin = jnp.asarray(cm.gw_sin_ix, jnp.int32)
@@ -618,7 +619,7 @@ def draw_b_hd_freqblock(cm: CompiledPTA, x, b, key, exact=False):
               else tnt_d_x(cm, x, N))                   # (P, B, B), (P, B)
     phi = cm.phi(x)
     pinv = 1.0 / phi                                    # (P, B)
-    rows_p = jnp.arange(P)[:, None]
+    rows_p = jnp.arange(P, dtype=jnp.int32)[:, None]
     rho = 10.0 ** (2.0 * jnp.asarray(x, cdt)[cm.rho_ix_x])        # (K,)
     Ginv = cm.orf_ginv_k(x).astype(cdt)                 # (K, P, P)
     gsin = jnp.asarray(cm.gw_sin_ix, jnp.int32)
@@ -671,7 +672,7 @@ def draw_b_hd_freqblock(cm: CompiledPTA, x, b, key, exact=False):
     m = 4 if (Kr > 0 and not cm.red_shares_gw) else 2
     zs = jr.normal(kz2, (K, m * P), cdt)
     eyeP = jnp.eye(P, dtype=cdt)
-    pr_arange = jnp.arange(P)
+    pr_arange = jnp.arange(P, dtype=jnp.int32)
 
     def step(b, k):
         gcols = [jnp.take(gsin, k, axis=1), jnp.take(gcos, k, axis=1)]
@@ -807,7 +808,7 @@ def _joint_perm_parts(cm: CompiledPTA, x):
               else tnt_d_x(cm, x, N))   # see draw_b_hd_sequential note
     phi = cm.phi(x)
     pinv = 1.0 / phi                                     # (P, B)
-    rows_p = jnp.arange(P)[:, None]
+    rows_p = jnp.arange(P, dtype=jnp.int32)[:, None]
     cols, valid, ccl = cm.gw_cols_valid()                # (P, 2K) each
     gwm = jnp.zeros((P, B), cdt).at[rows_p, ccl].max(valid)
     nm = 1.0 - gwm                                       # non-GW indicator
@@ -876,7 +877,7 @@ def draw_b_joint(cm: CompiledPTA, x, key):
     n = PB + G * P
     (TNT, d, cols, valid, ccl, gwm, nm, Snn, Tg,
      Agg) = _joint_perm_parts(cm, x)
-    rows_p = jnp.arange(P)[:, None]
+    rows_p = jnp.arange(P, dtype=jnp.int32)[:, None]
     Dg, _, _ = _joint_gw_prior(cm, x, valid)
     # dense assembly in the permuted layout
     lrows = jnp.arange(P)[:, None] * B + jnp.arange(B)[None, :]    # (P, B)
@@ -1006,7 +1007,7 @@ def draw_b_joint_structured(cm: CompiledPTA, x, key, b=None, exact=False,
          if factors is None else factors)
     mm = tf_mm if f.mixed else _mm_t
     factor = tf_chol_factor if f.mixed else blocked_chol_inv
-    rows_p = jnp.arange(P)[:, None]
+    rows_p = jnp.arange(P, dtype=jnp.int32)[:, None]
 
     # ---- stage 2: Schur complement on the GW subspace ---------------------
     Dg, rho2, Gpp = _joint_gw_prior(cm, x, f.valid)
@@ -1023,9 +1024,11 @@ def draw_b_joint_structured(cm: CompiledPTA, x, key, b=None, exact=False,
     dj_gT = dj_g.T                                                 # (2K, P)
     Dg_hat = Dg * dj_gT[:, :, None] * dj_gT[:, None, :]
     M = Agg_hat - CCt                                              # (P,2K,2K)
+    pr = jnp.arange(P, dtype=jnp.int32)
+    gr = jnp.arange(G, dtype=jnp.int32)
     S = jnp.zeros((G, G, P, P), cdt).at[
-        :, :, jnp.arange(P), jnp.arange(P)].set(jnp.moveaxis(M, 0, -1))
-    S = S.at[jnp.arange(G), jnp.arange(G)].add(Dg_hat)
+        :, :, pr, pr].set(jnp.moveaxis(M, 0, -1))
+    S = S.at[gr, gr].add(Dg_hat)
 
     # ---- solves + sample --------------------------------------------------
     dn_hat = f.dj_n * (f.d * f.nm)                                 # (P, B)
@@ -1043,8 +1046,7 @@ def draw_b_joint_structured(cm: CompiledPTA, x, key, b=None, exact=False,
     # the local columns explain the GW columns); chol(D S D) = D chol(S)
     # for diagonal D, so preconditioning here leaves the sample map of
     # the overall factorization unchanged in exact arithmetic
-    sdiag = jnp.diagonal(S[jnp.arange(G), jnp.arange(G)],
-                         axis1=-2, axis2=-1)                       # (G, P)
+    sdiag = jnp.diagonal(S[gr, gr], axis1=-2, axis2=-1)            # (G, P)
     sj = 1.0 / jnp.sqrt(sdiag)
     rg = r_g.T                                                     # (G, P)
     if G * P <= SCHUR_DENSE_MAX:
@@ -1156,7 +1158,8 @@ def parallel_cov_mh_scan(cm: CompiledPTA, x, key, ll_per_fn, par_ix, nper,
     nper = jnp.asarray(nper, jnp.int32)
     par_ix = jnp.asarray(par_ix, jnp.int32)
     W = par_ix.shape[1]
-    wmask = (jnp.arange(W)[None, :] < nper[:, None]).astype(fdt)
+    wmask = (jnp.arange(W, dtype=jnp.int32)[None, :]
+             < nper[:, None]).astype(fdt)
     live = nper > 0
     amp = 2.38 / jnp.sqrt(jnp.maximum(nper, 1).astype(fdt))
     safe_ix = jnp.minimum(par_ix, cm.nx - 1)
@@ -1165,7 +1168,11 @@ def parallel_cov_mh_scan(cm: CompiledPTA, x, key, ll_per_fn, par_ix, nper,
     k1, k3, k4, k5 = jr.split(key, 4)
     scale = jr.choice(k1, scales, (nsteps, cm.P), p=probs)
     z = jr.normal(k3, (nsteps, cm.P, W), dtype=fdt)
-    Lz = jnp.einsum("pwv,spv->spw", chol, z) * wmask[None]
+    # precision="highest": proposal shaping feeds the accept ratio
+    # through logg; a tf32 lowering on GPU would perturb the proposal
+    # density away from the density actually sampled (numcheck N3)
+    Lz = jnp.einsum("pwv,spv->spw", chol, z,
+                    precision="highest") * wmask[None]
     noise = Lz * (amp[None, :, None] * scale[:, :, None])
     logu = jnp.log(jr.uniform(k4, (nsteps, cm.P), dtype=fdt))
     if mode is not None:
@@ -1174,7 +1181,12 @@ def parallel_cov_mh_scan(cm: CompiledPTA, x, key, ll_per_fn, par_ix, nper,
         asq = jnp.asarray(asqrt, fdt) / fdt(inflate)
 
         def logg(w):
-            u = jnp.einsum("pwv,pw->pv", asq, (w - mode) * wmask)
+            # the independence-proposal log-density enters the Hastings
+            # correction; w derives from the f64 state, so a default-
+            # precision (tf32-on-GPU) product here would bias the
+            # accept ratio (numcheck N3)
+            u = jnp.einsum("pwv,pw->pv", asq, (w - mode) * wmask,
+                           precision="highest")
             return -0.5 * jnp.sum(u * u, axis=-1)
     else:
         coin = jnp.zeros((nsteps, cm.P), bool)
@@ -1256,7 +1268,8 @@ def laplace_newton_chol(cm: CompiledPTA, x, ll_per_fn, par_ix, nper,
     par_ix = jnp.asarray(par_ix, jnp.int32)
     nper = jnp.asarray(nper, jnp.int32)
     safe_ix = jnp.minimum(par_ix, cm.nx - 1)
-    wmask = jnp.arange(W)[None, :] < nper[:, None]          # (P, W) bool
+    wmask = (jnp.arange(W, dtype=jnp.int32)[None, :]
+             < nper[:, None])                               # (P, W) bool
     live = nper > 0
 
     hw2 = jnp.asarray(_prior_halfwidth2(cm), cdt)[safe_ix]  # (P, W)
@@ -3100,10 +3113,10 @@ class JaxGibbsDriver:
                 (((x, b, u), es_end),
                  (xs, bs, ess)) = jax.lax.scan(
                     ens_step, ((x, b, u), ens_state),
-                    it0 + jnp.arange(n))
+                    it0 + jnp.arange(n, dtype=jnp.int32))
             else:
-                (x, b, u), (xs, bs) = jax.lax.scan(step, (x, b, u),
-                                                   it0 + jnp.arange(n))
+                (x, b, u), (xs, bs) = jax.lax.scan(
+                    step, (x, b, u), it0 + jnp.arange(n, dtype=jnp.int32))
             # full-precision carry at row n_keep (rows record PRE-sweep
             # states; n_keep == n means the final carry).  Branch instead
             # of concatenating a carry row onto the stacks: the b record

@@ -162,3 +162,35 @@ def test_compiled_sweep_under_transfer_guard(small_pta, external_guard):
             pass
     assert done == niter
     assert np.all(np.isfinite(chain))
+
+
+# ---------------------------------------------------------------------------
+# settings validation: the segmented-Gram segment lengths
+# ---------------------------------------------------------------------------
+
+def test_settings_rejects_bad_gram_seg_lengths():
+    from pulsar_timing_gibbsspec_tpu.config import Settings, SettingsError
+
+    assert Settings(gram_seg_len=96).gram_seg_len == 96
+    for bad in (0, -3, 1.5, "96", True, None):
+        with pytest.raises(SettingsError):
+            Settings(gram_seg_len=bad)
+        with pytest.raises(SettingsError):
+            Settings(gram_seg_len_exact=bad)
+
+
+def test_settings_validates_gram_seg_env_overrides(monkeypatch):
+    from pulsar_timing_gibbsspec_tpu.config import Settings, SettingsError
+
+    monkeypatch.setenv("PTGIBBS_GRAM_SEG", "48")
+    assert Settings().gram_seg_len == 48
+    monkeypatch.setenv("PTGIBBS_GRAM_SEG", "0")
+    with pytest.raises(SettingsError, match="positive"):
+        Settings()
+    monkeypatch.setenv("PTGIBBS_GRAM_SEG", "ninety-six")
+    with pytest.raises(SettingsError, match="not an integer"):
+        Settings()
+    monkeypatch.delenv("PTGIBBS_GRAM_SEG")
+    monkeypatch.setenv("PTGIBBS_GRAM_SEG_EXACT", "-1")
+    with pytest.raises(SettingsError, match="positive"):
+        Settings()

@@ -202,6 +202,29 @@ def test_r3_explicit_dtype_positional_keyword_or_astype():
     assert rules_of(src) == []
 
 
+def test_r3_arange_requires_explicit_dtype():
+    # arange's result dtype flips int/float with its argument types —
+    # the classic silent-precision leak the R3 extension closes
+    src = """
+        import jax
+        import jax.numpy as jnp
+        @jax.jit
+        def f(x):
+            return x + jnp.arange(3)
+    """
+    assert rules_of(src) == ["R3"]
+    ok = """
+        import jax
+        import jax.numpy as jnp
+        @jax.jit
+        def f(x):
+            a = jnp.arange(3, dtype=jnp.int32)
+            b = jnp.arange(0, 3, 1, jnp.float32)
+            return x + a + b
+    """
+    assert rules_of(ok) == []
+
+
 def test_r3_untraced_allocation_is_fine():
     src = """
         import jax.numpy as jnp

@@ -70,6 +70,37 @@ def test_factor_cache_is_inert(hd_cm_x):
         assert np.array_equal(np.asarray(a), np.asarray(b))
 
 
+def test_mixed_breakdown_keeps_previous_b(hd_cm_x):
+    """The joint_mixed non-finite guard, pinned directly: when the
+    two-float stage factors break down (any NaN in the candidate), the
+    draw must return the PREVIOUS b untouched — skip the update, never
+    poison the chain — and zeros when no previous b exists.  The finite
+    path must still produce a fresh draw, not the carry."""
+    import jax.numpy as jnp
+    import jax.random as jr
+
+    cm, x = hd_cm_x
+    key = jr.PRNGKey(21)
+    f = jb.joint_factor_cache(cm, x, exact=False, mixed=True)
+    b_ok = jb.draw_b_joint_structured(cm, x, key, factors=f, mixed=True)
+    assert np.isfinite(np.asarray(b_ok)).all()
+    prev = jnp.full_like(b_ok, 0.5)
+    got = jb.draw_b_joint_structured(cm, x, key, b=prev, factors=f,
+                                     mixed=True)
+    assert np.array_equal(np.asarray(got), np.asarray(b_ok))
+    assert not np.array_equal(np.asarray(got), np.asarray(prev))
+    # poison the stage-1 inverse factor: every candidate entry goes NaN
+    f_bad = f._replace(Li1=f.Li1 * np.nan)
+    kept = jb.draw_b_joint_structured(cm, x, key, b=prev, factors=f_bad,
+                                      mixed=True)
+    assert np.array_equal(np.asarray(kept), np.asarray(prev))
+    # no previous b: the guard falls back to a zero update
+    kept0 = jb.draw_b_joint_structured(cm, x, key, factors=f_bad,
+                                       mixed=True)
+    assert np.array_equal(np.asarray(kept0),
+                          np.zeros_like(np.asarray(kept0)))
+
+
 def test_mixed_draw_is_ks_level(hd_cm_x):
     """The two-float (f32 factor + one refinement step) steady draw
     carries the accepted O(n*eps_f32) error class: same-key samples land

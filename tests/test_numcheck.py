@@ -1,0 +1,440 @@
+"""numcheck: the static precision-flow / reassociation / exact-body
+auditor (``analysis/numcheck``).
+
+The mutation self-test is the core: seed the exact defects the tool
+exists to catch — an ``.astype(jnp.float32)`` injected into a synthetic
+Gram accumulation (N1), a deleted f64 exact-body pairing (N4) — and
+prove the rules fire; then prove the disciplined twins stay quiet.
+Plus the N2/N3 positive/negative fixtures, the N5 ledger drift check,
+pragma suppression, the justified-baseline gate, and the committed
+contracts themselves (lint-marked — those trace the real entries).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+jax.config.update("jax_enable_x64", True)
+
+from pulsar_timing_gibbsspec_tpu.analysis.baseline import (
+    check_justifications, load_justified_baseline)
+from pulsar_timing_gibbsspec_tpu.analysis.jaxprcheck.walk import trace_jaxpr
+from pulsar_timing_gibbsspec_tpu.analysis.numcheck.ledger import (
+    check_ledger, error_ledger)
+from pulsar_timing_gibbsspec_tpu.analysis.numcheck.pairs import (
+    check_pair, compare_signatures)
+from pulsar_timing_gibbsspec_tpu.analysis.numcheck.rules import RULES
+from pulsar_timing_gibbsspec_tpu.analysis.numcheck.runner import (
+    _suppressed, analyze_traced, discover_contracts, pragma_rules)
+
+ROOT = Path(__file__).resolve().parents[1]
+
+F64 = jax.ShapeDtypeStruct
+
+
+def rules_of(findings):
+    return [r for r, _msg, _f, _ln in findings]
+
+
+def tr(fn, *avals):
+    return trace_jaxpr(fn, tuple(avals))
+
+
+# ---------------------------------------------------------------------------
+# mutation self-test: the seeded N1 defect
+# ---------------------------------------------------------------------------
+
+def _gram_mutant(vs):
+    """A Gram accumulation with the classic silent-mixed-precision bug
+    seeded: the f64 rows are narrowed to f32 *inside* the accumulation
+    loop, and the narrowed Gram feeds a Cholesky."""
+    def step(g, v):
+        v32 = v.astype(jnp.float32)     # the seeded defect
+        return g + jnp.outer(v32, v32), None
+    g, _ = jax.lax.scan(step, jnp.zeros((8, 8), jnp.float32), vs)
+    return jnp.linalg.cholesky(g + 100.0 * jnp.eye(8, dtype=jnp.float32))
+
+
+def _gram_disciplined(vs):
+    """The twin without the defect: accumulate in f64, narrow only the
+    final factor (outside any accumulation path's upstream)."""
+    def step(g, v):
+        return g + jnp.outer(v, v), None
+    g, _ = jax.lax.scan(step, jnp.zeros((8, 8), jnp.float64), vs)
+    return jnp.linalg.cholesky(g + 100.0 * jnp.eye(8, dtype=jnp.float64))
+
+
+@pytest.mark.lint
+def test_n1_fires_on_astype_injected_into_gram_accumulation():
+    closed = tr(_gram_mutant, F64((16, 8), jnp.float64))
+    findings, rep = analyze_traced(closed)
+    assert "N1" in rules_of(findings)
+    n1 = [m for r, m, _f, _ln in findings if r == "N1"]
+    assert any("cholesky" in m or "dot_general" in m for m in n1)
+    # the census fingerprints the seeded narrow
+    assert sum(rep.narrow_census().values()) == 1
+
+
+def test_n1_quiet_when_the_narrow_is_a_declared_island():
+    closed = tr(_gram_mutant, F64((16, 8), jnp.float64))
+    findings, _ = analyze_traced(
+        closed, {"islands": ["test_numcheck.py"]})
+    assert "N1" not in rules_of(findings)
+
+
+def test_n1_quiet_on_the_disciplined_twin():
+    closed = tr(_gram_disciplined, F64((16, 8), jnp.float64))
+    findings, rep = analyze_traced(closed)
+    assert "N1" not in rules_of(findings)
+    assert rep.narrow_census() == {}
+
+
+def test_scan_carried_accumulation_is_an_n2_reduction():
+    # the Gram loop's carry is an add-chain over its own input: a
+    # reassociation-sensitive reduction of length = trip count
+    closed = tr(_gram_disciplined, F64((16, 8), jnp.float64))
+    _, rep = analyze_traced(closed)
+    carries = [r for r in rep.reductions if r.kind == "scan_carry"]
+    assert carries and carries[0].length == 16
+
+
+# ---------------------------------------------------------------------------
+# mutation self-test: the deleted exact-body pairing (N4)
+# ---------------------------------------------------------------------------
+
+class _FakeCM:
+    nx, P, Bmax = 4, 2, 3
+    dtype = np.dtype("float32")
+    cdtype = np.dtype("float32")
+    y = np.zeros(8, np.float32)
+    has_ke = False
+
+
+class _PairedDriver:
+    """A driver honouring the PR 3 convention: both bodies exist and
+    share one abstract signature."""
+
+    exact_every = 16
+    cm = _FakeCM()
+
+    def _aux(self):
+        # chain-stacked aux, axis 0 = chains (as drv._aux() returns it)
+        return (np.zeros((4, 2), np.float32),)
+
+    def _sweep_body(self, bdraw):
+        def body(carry, key, aux, t, beta=None):
+            x, b, u = carry
+            return (x + aux[0].sum(), b, u)
+        return body
+
+
+class _UnpairedDriver(_PairedDriver):
+    """The seeded defect: the f64 exact body was deleted."""
+
+    def _sweep_body(self, bdraw):
+        if bdraw == "exact":
+            raise AttributeError("exact body deleted by mutation")
+        return super()._sweep_body(bdraw)
+
+
+class _DriftedDriver(_PairedDriver):
+    """The subtler defect: the exact body's signature drifted, so the
+    chunk's lax.cond could no longer alternate the pair."""
+
+    def _sweep_body(self, bdraw):
+        def body(carry, key, aux, t, beta=None):
+            x, b, u = carry
+            if bdraw == "exact":
+                x = x.astype(jnp.float64)
+            return (x + aux[0].sum(), b, u)
+        return body
+
+
+def test_n4_quiet_on_a_paired_driver():
+    assert check_pair(_PairedDriver(), {"exact_every": 16}) == []
+
+
+@pytest.mark.lint
+def test_n4_fires_when_the_exact_body_is_deleted():
+    f = check_pair(_UnpairedDriver(), {"exact_every": 16})
+    assert rules_of(f) == ["N4"]
+    assert "no registered f64 exact body" in f[0][1]
+
+
+def test_n4_fires_on_signature_drift():
+    f = check_pair(_DriftedDriver(), {"exact_every": 16})
+    assert rules_of(f) == ["N4"]
+    assert "signature mismatch" in f[0][1]
+
+
+def test_n4_cadence_must_be_declared_and_match():
+    f = check_pair(_PairedDriver(), {})
+    assert rules_of(f) == ["N4"] and "no exact_every" in f[0][1]
+    f = check_pair(_PairedDriver(), {"exact_every": 8})
+    assert rules_of(f) == ["N4"] and "does not match" in f[0][1]
+
+
+def test_n4_kernel_ecorr_runs_exact_only_no_pair_required():
+    class KE(_UnpairedDriver):
+        class cm(_FakeCM):
+            has_ke = True
+    assert check_pair(KE(), {"exact_every": 16}) == []
+
+
+def test_compare_signatures_reports_arity_and_leaf_drift():
+    assert compare_signatures([((4,), "f32")], [((4,), "f32")]) == []
+    a = compare_signatures([((4,), "f32")], [((4,), "f32"), ((2,), "f32")])
+    assert "arity" in a[0]
+    m = compare_signatures([((4,), "float32")], [((4,), "float64")])
+    assert "mismatch at leaf 0" in m[0]
+
+
+# ---------------------------------------------------------------------------
+# N2: unpinned reassociation
+# ---------------------------------------------------------------------------
+
+def _big_sum(x):
+    return jnp.sum(x)
+
+
+def test_n2_fires_without_a_declared_order():
+    closed = tr(_big_sum, F64((64,), jnp.float32))
+    findings, _ = analyze_traced(closed)
+    assert "N2" in rules_of(findings)
+
+
+def test_n2_quiet_with_a_pinned_order():
+    closed = tr(_big_sum, F64((64,), jnp.float32))
+    findings, _ = analyze_traced(closed, {"declared_orders": [
+        {"fn": "test_numcheck.py",
+         "order": "single fused reduce in trace order"}]})
+    assert "N2" not in rules_of(findings)
+
+
+def test_n2_an_empty_order_note_does_not_count():
+    closed = tr(_big_sum, F64((64,), jnp.float32))
+    findings, _ = analyze_traced(closed, {"declared_orders": [
+        {"fn": "test_numcheck.py", "order": "  "}]})
+    assert "N2" in rules_of(findings)
+
+
+def test_small_reductions_are_below_the_n2_floor():
+    closed = tr(_big_sum, F64((4,), jnp.float32))
+    findings, rep = analyze_traced(closed)
+    assert "N2" not in rules_of(findings) and rep.reductions == []
+
+
+# ---------------------------------------------------------------------------
+# N3: default-precision dots on once-f64 data
+# ---------------------------------------------------------------------------
+
+def _tainted_dot(a, b):
+    return a.astype(jnp.float32) @ b
+
+
+def test_n3_fires_on_default_precision_tainted_f32_dot():
+    closed = tr(_tainted_dot,
+                F64((8, 8), jnp.float64), F64((8, 8), jnp.float32))
+    findings, _ = analyze_traced(
+        closed, {"islands": ["test_numcheck.py"]})
+    assert "N3" in rules_of(findings)
+
+
+def test_n3_an_island_does_not_excuse_the_tf32_hazard():
+    # islands excuse the *downcast* (N1), never the precision flag
+    closed = tr(_tainted_dot,
+                F64((8, 8), jnp.float64), F64((8, 8), jnp.float32))
+    findings, _ = analyze_traced(
+        closed, {"islands": ["test_numcheck.py"]})
+    assert "N1" not in rules_of(findings)
+    assert "N3" in rules_of(findings)
+
+
+def test_n3_quiet_at_highest_precision():
+    def f(a, b):
+        return jax.lax.dot(a.astype(jnp.float32), b,
+                           precision="highest")
+    closed = tr(f, F64((8, 8), jnp.float64), F64((8, 8), jnp.float32))
+    findings, _ = analyze_traced(closed, {"islands": ["test_numcheck.py"]})
+    assert "N3" not in rules_of(findings)
+
+
+def test_n3_quiet_on_never_f64_data():
+    def f(a, b):
+        return a @ b
+    closed = tr(f, F64((8, 8), jnp.float32), F64((8, 8), jnp.float32))
+    findings, _ = analyze_traced(closed)
+    assert "N3" not in rules_of(findings)
+
+
+# ---------------------------------------------------------------------------
+# census pin
+# ---------------------------------------------------------------------------
+
+def test_census_rule_flags_topology_drift():
+    closed = tr(_gram_mutant, F64((16, 8), jnp.float64))
+    _, rep = analyze_traced(closed)
+    pin = rep.narrow_census()
+    findings, _ = analyze_traced(
+        closed, {"islands": ["test_numcheck.py"],
+                 "narrow_census": pin,
+                 "declared_orders": [{"fn": "test_numcheck.py",
+                                      "order": "trace order"}]})
+    assert findings == []
+    drifted, _ = analyze_traced(
+        closed, {"islands": ["test_numcheck.py"], "narrow_census": {}})
+    assert "census" in rules_of(drifted)
+
+
+# ---------------------------------------------------------------------------
+# N5: the error ledger
+# ---------------------------------------------------------------------------
+
+def test_error_ledger_reports_chains_and_ulp_bounds():
+    closed = tr(_gram_disciplined, F64((16, 8), jnp.float64))
+    led = error_ledger(closed)
+    assert "float64" in led["max_ulp_rel"]
+    eps64 = float(np.finfo(np.float64).eps)
+    # the Cholesky chain (n=8) dominates the 8-wide outer products
+    assert led["max_ulp_rel"]["float64"] >= 8 * eps64
+    assert any(b["block"].startswith("test_numcheck.py")
+               for b in led["blocks"])
+
+
+def test_n5_drift_unpinned_and_vanished_dtypes():
+    led = {"max_ulp_rel": {"float32": 1.2e-4}}
+    ok = {"ledger": {"max_ulp_rel": {"float32": 1.2e-4}}}
+    assert check_ledger(led, ok) == []
+    within = {"ledger": {"max_ulp_rel": {"float32": 1.0e-4}}}
+    assert check_ledger(led, within) == []          # inside ±25%
+    drift = {"ledger": {"max_ulp_rel": {"float32": 0.5e-4}}}
+    assert rules_of(check_ledger(led, drift)) == ["N5"]
+    unpinned = {"ledger": {"max_ulp_rel": {}}}
+    assert "does not pin" in check_ledger(led, unpinned)[0][1]
+    vanished = {"ledger": {"max_ulp_rel": {"float32": 1.2e-4,
+                                           "float64": 1e-15}}}
+    assert any("no longer has" in m
+               for _r, m, _f, _ln in check_ledger(led, vanished))
+    assert check_ledger(led, {}) == []              # no pin, no rule
+
+
+# ---------------------------------------------------------------------------
+# pragmas
+# ---------------------------------------------------------------------------
+
+def test_pragma_parsing():
+    assert pragma_rules("x = 1  # numcheck: disable=N1,N3") == {"N1", "N3"}
+    assert pragma_rules("y = 2  # numcheck: disable=all") == {"ALL"}
+    assert pragma_rules("z = 3  # no pragma here") == set()
+
+
+def test_pragma_suppresses_by_source_line(tmp_path):
+    src = tmp_path / "s.py"
+    src.write_text("a = 1\nb = 2  # numcheck: disable=N2\n")
+    assert _suppressed("N2", str(src), 2)
+    assert not _suppressed("N1", str(src), 2)
+    assert not _suppressed("N2", str(src), 1)
+    assert not _suppressed("N2", None, None)
+
+
+# ---------------------------------------------------------------------------
+# the committed contracts and the justified-baseline gate
+# ---------------------------------------------------------------------------
+
+def test_committed_contracts_are_discovered_and_tagged():
+    names = {c["name"] for c in discover_contracts()}
+    assert {"numerics_crn", "numerics_hd_joint"} <= names
+    fast = {c["name"] for c in discover_contracts(fast_only=True)}
+    assert {"numerics_crn", "numerics_hd_joint"} <= fast
+
+
+def test_jaxprcheck_discovery_skips_numcheck_contracts():
+    from pulsar_timing_gibbsspec_tpu.analysis.jaxprcheck.runner import (
+        discover_contracts as jp_discover)
+    names = {c["name"] for c in jp_discover()}
+    assert not names & {"numerics_crn", "numerics_hd_joint"}
+
+
+def test_committed_baseline_is_fully_justified():
+    data = load_justified_baseline(ROOT / "numcheck_baseline.json")
+    assert check_justifications(data) == []
+
+
+def test_todo_stub_is_not_a_justification():
+    data = {"violations": {"m.py": {"N1": 1}},
+            "justifications": {"m.py [N1]": "TODO: fill in"}}
+    assert check_justifications(data) == [("m.py", "N1")]
+    data["justifications"]["m.py [N1]"] = "two-float kernel by design"
+    assert check_justifications(data) == []
+
+
+def test_rule_table_is_closed():
+    assert set(RULES) == {"N1", "N2", "N3", "N4", "N5"}
+
+
+# ---------------------------------------------------------------------------
+# CLI / wrappers (lint tier: these trace the real entry builders)
+# ---------------------------------------------------------------------------
+
+def _run_cli(*args, cwd=ROOT):
+    env = dict(os.environ, PYTHONPATH=str(ROOT))
+    return subprocess.run(
+        [sys.executable, "-m",
+         "pulsar_timing_gibbsspec_tpu.analysis.numcheck", *args],
+        cwd=cwd, env=env, capture_output=True, text=True)
+
+
+@pytest.mark.lint
+def test_cli_head_contracts_audit_clean(tmp_path):
+    led = tmp_path / "ledger.json"
+    r = _run_cli("--fast", "--ledger", str(led))
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "OK" in r.stdout
+    ledgers = json.loads(led.read_text())
+    assert set(ledgers) == {"numerics_crn", "numerics_hd_joint"}
+    for l in ledgers.values():
+        assert l["max_ulp_rel"]
+
+
+def test_cli_exits_2_without_contracts(tmp_path):
+    r = _run_cli("--contracts", str(tmp_path))
+    assert r.returncode == 2
+    assert "no contracts" in r.stderr
+
+
+def test_cli_fails_on_unjustified_baseline(tmp_path):
+    bl = tmp_path / "bl.json"
+    bl.write_text(json.dumps({
+        "violations": {"contracts/numerics_crn.json": {"N1": 1}},
+        "justifications": {}}))
+    # a bogus entry keeps this test off the (slow) tracing path: the
+    # contract errors out as an `error` violation, the justification
+    # gate still runs
+    empty = tmp_path / "contracts"
+    empty.mkdir()
+    c = textwrap.dedent("""\
+        {"name": "noop", "tool": "numcheck", "fast": true,
+         "entry": {"entry": "does_not_exist"}}
+    """)
+    (empty / "noop.json").write_text(c)
+    r = _run_cli("--contracts", str(empty), "--baseline", str(bl))
+    assert r.returncode == 1
+    assert "without justification" in r.stdout
+
+
+def test_tools_numcheck_wrapper_importable():
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "tools_numcheck", ROOT / "tools" / "numcheck.py")
+    m = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(m)          # no side effects on import
+    assert callable(m.main)

@@ -12,15 +12,20 @@ status — the same set the ``lint`` pytest marker covers:
                  layers (pure AST, the checked modules are never
                  imported), ratcheted against
                  ``racecheck_baseline.json``;
-4. jaxprcheck  — jaxpr/HLO contract audit of the fast (CPU-traceable)
+4. numcheck    — precision-flow / reassociation / exact-body audit of
+                 the fast numcheck contracts (N1-N5 over the traced
+                 entry builders), ratcheted against
+                 ``numcheck_baseline.json`` with justified-baseline
+                 semantics;
+5. jaxprcheck  — jaxpr/HLO contract audit of the fast (CPU-traceable)
                  contracts in ``contracts/``, ratcheted against
                  ``jaxprcheck_baseline.json``; also fails when a jit
                  entry builder has no pinned contract (coverage);
-5. perfwatch   — the perf-ledger regression gate over
+6. perfwatch   — the perf-ledger regression gate over
                  ``PERF_LEDGER.jsonl`` plus the static cost-model
                  self-check (CPU tracing only, no device execution).
 
-With ``--chaos`` an optional sixth layer runs the quick seeded chaos
+With ``--chaos`` an optional seventh layer runs the quick seeded chaos
 campaign (``tools/chaos_campaign.py --quick --seeds 5``) — the serving
 tier's blast-radius invariants under randomized fault schedules.  It
 executes real (CPU) sampling, so it is opt-in rather than part of the
@@ -57,6 +62,10 @@ def main(argv=None) -> int:
     layers.append(("racecheck",
                    [sys.executable, "-m",
                     "pulsar_timing_gibbsspec_tpu.analysis.racecheck"]))
+    layers.append(("numcheck",
+                   [sys.executable, "-m",
+                    "pulsar_timing_gibbsspec_tpu.analysis.numcheck",
+                    "--fast"]))
     layers.append(("jaxprcheck",
                    [sys.executable, "-m",
                     "pulsar_timing_gibbsspec_tpu.analysis.jaxprcheck",

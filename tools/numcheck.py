@@ -1,0 +1,19 @@
+"""Thin CLI wrapper: ``python tools/numcheck.py [opts]``.
+
+Equivalent to ``python -m pulsar_timing_gibbsspec_tpu.analysis.numcheck``
+— kept under tools/ so the precision-flow auditor is discoverable next
+to the other probes.  Importing this module has no side effects.
+"""
+
+
+def main(argv=None) -> int:
+    import os
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+    from pulsar_timing_gibbsspec_tpu.analysis.numcheck.__main__ import \
+        main as _main
+    return _main(argv)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -215,17 +215,18 @@ def check(ledger: Path, bands: dict | None = None,
           skip_selfcheck: bool = False) -> int:
     from pulsar_timing_gibbsspec_tpu.obs import perf
 
-    if not ledger.exists():
-        print(f"perfwatch: no ledger at {ledger} — run "
-              "`python tools/perfwatch.py --backfill` first",
-              file=sys.stderr)
-        return 1
-    records = perf.ledger_read(ledger)
+    # an absent or empty ledger is a fresh checkout / new backend, not a
+    # regression: the trajectory gate has nothing to gate against, so it
+    # passes with an actionable note (the cost-model self-check — which
+    # needs no history — still runs below)
+    records = perf.ledger_read(ledger) if ledger.exists() else []
     if not records:
-        print(f"perfwatch: ledger {ledger} holds no records",
-              file=sys.stderr)
-        return 1
-    problems = perf.check_ledger(records, bands)
+        print(f"perfwatch: no ledger records for this backend in "
+              f"{ledger} — nothing to gate yet; seed the trajectory "
+              "with `python tools/perfwatch.py --backfill` (committed "
+              "snapshots) or run tools/bench.py to append the first "
+              "record")
+    problems = perf.check_ledger(records, bands) if records else []
     if not skip_selfcheck:
         problems += _cost_selfcheck()
     if problems:
