@@ -23,11 +23,12 @@ def sanitize(name: str) -> str:
 
 
 _UNESC = re.compile(r"\\(.)")
+_UNESC_MAP = {"n": "\n", "r": "\r"}
 
 
 def _unescape(v: str) -> str:
-    return _UNESC.sub(lambda m: "\n" if m.group(1) == "n" else m.group(1),
-                      v)
+    return _UNESC.sub(
+        lambda m: _UNESC_MAP.get(m.group(1), m.group(1)), v)
 
 
 def split_key(key: str):
@@ -54,8 +55,14 @@ def _fmt(v: float) -> str:
 
 
 def _escape(v: str) -> str:
+    """Label-value escaping for the exposition body.  Beyond the spec's
+    ``\\``/``"``/``\\n`` set, a bare ``\\r`` is escaped as well: label
+    values here can arrive from the network path (tenant/job names via
+    the gateway), and an unescaped carriage return would let a hostile
+    name split a sample line and forge metrics on line-oriented
+    scrapers."""
     return str(v).replace("\\", "\\\\").replace('"', '\\"').replace(
-        "\n", "\\n")
+        "\n", "\\n").replace("\r", "\\r")
 
 
 def _render_family(out, seen, name, labels, value, kind, prefix):

@@ -66,6 +66,37 @@ Fault kinds:
   ``devices`` = the surviving device count — the serving tier's
   evacuation drill (drain residents, rebuild on the surviving submesh,
   re-admit).
+
+Transport seams (the gateway in ``serve/gateway.py``):
+
+- ``"gateway.step"`` — in the gateway scheduler thread, before each
+  supervised service round; ``row`` is the gateway step counter.  A
+  ``"gateway_kill"`` armed here simulates SIGKILL on the gateway
+  process mid-stream: the scheduler dies with NO goodbye (no final
+  journal write, no drain) — the restart drill then asserts the
+  journal and checkpoints already on disk are sufficient.
+- ``"wire.request"`` / ``"wire.submit"`` / ``"wire.stream"`` — consumed
+  via :func:`transport_fault` by the gateway's request, submission and
+  stream paths; ``row`` is the request counter (request/submit) or the
+  stream cursor (stream).
+
+Transport fault kinds (consumed by :func:`transport_fault`; these
+return handles instead of raising — the TRANSPORT misbehaves, the
+gateway must stay correct):
+
+- ``"conn_drop"``   the client connection vanishes: at a request seam
+  the computed (and, for submissions, already-journaled) response is
+  never delivered — the lost-ACK window idempotent submission exists
+  for; at a stream seam the stream aborts mid-delivery and the client
+  must reattach with its cursor.
+- ``"dup_submit"``  the client retries a submission it already sent
+  (timeout/lost ACK): the gateway processes the identical submission
+  twice and must resolve both to ONE job handle via the dedupe journal.
+- ``"slow_client"`` the stream consumer stalls ``seconds`` per event:
+  rows keep landing while the stream lags — past the gateway's
+  ``shed_lag`` bound the stream must be SHED (never block sampling).
+- ``"gateway_kill"`` raise :class:`InjectedCrash` at ``"gateway.step"``
+  (see above).
 """
 
 from __future__ import annotations
@@ -197,10 +228,10 @@ def fire(point, row=None, backend=None, outdir=None):
             reason=f"sigterm_at_seam:{point}",
             deadline_s=f.seconds or None)
     for f in _take(point, row, backend, ("crash", "xla_error",
-                                         "device_loss")):
-        if f.kind == "crash":
+                                         "device_loss", "gateway_kill")):
+        if f.kind in ("crash", "gateway_kill"):
             raise InjectedCrash(
-                f"injected crash at {point} (row {row})")
+                f"injected {f.kind} at {point} (row {row})")
         if f.kind == "device_loss":
             raise DeviceLost(
                 f"injected device loss at {point} (row {row}): "
@@ -208,6 +239,19 @@ def fire(point, row=None, backend=None, outdir=None):
                 "device(s) survive", devices=f.devices)
         raise XlaRuntimeError(
             f"INTERNAL: injected device failure at {point} (row {row})")
+
+
+def transport_fault(point, row=None):
+    """Consume armed transport faults at a wire seam (counting a firing
+    each) and return the fired handles — ``conn_drop`` / ``dup_submit``
+    / ``slow_client``.  Unlike :func:`fire` this never raises: the
+    gateway interprets the handles (drop the response, replay the
+    submission, stall the stream consumer) because the FAULT is the
+    transport's, and the code under test is the gateway's recovery."""
+    if not _armed:
+        return []
+    return _take(point, row, None,
+                 ("conn_drop", "dup_submit", "slow_client"))
 
 
 def device_count_override(default=None):

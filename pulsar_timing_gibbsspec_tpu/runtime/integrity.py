@@ -270,6 +270,33 @@ def rollback(outdir) -> bool:
     return True
 
 
+def check_not_quarantined(outdir, force_requeue=False, manifest=None):
+    """Refuse a quarantine-marked checkpoint directory unless the
+    operator passed ``force_requeue``.
+
+    A manifest whose ``serve.state`` is ``"quarantined"`` marks a job
+    the serving tier PARKED after exhausting its quarantine budget: the
+    checkpoint itself is verified (rows up to the last clean save), but
+    resuming it blindly would replay the same poisoned trajectory.
+    EVERY resume path must route through this one check —
+    :func:`load_resume` here and ``ChainStore.load_resume`` (the
+    facade / ``reshard_restore`` path) both call it, so there is no
+    side door that silently resumes a parked job.  ``manifest`` skips
+    the re-read when the caller already holds the (verified) manifest.
+    """
+    if force_requeue:
+        return
+    man = read_manifest(Path(outdir)) if manifest is None else manifest
+    if (isinstance(man, dict) and not man.get("corrupt")
+            and (man.get("serve") or {}).get("state") == "quarantined"):
+        raise CheckpointError(
+            f"{outdir} holds a QUARANTINED job (its serving tier "
+            "parked it after repeated row-health breaches).  The "
+            "checkpoint is verified but the job needs an operator "
+            "decision: resume with force_requeue=True "
+            "(--force-requeue) to requeue it from the verified rows")
+
+
 def load_resume(outdir, force_requeue=False):
     """Standalone verified checkpoint load for a bare directory.
 
@@ -295,16 +322,6 @@ def load_resume(outdir, force_requeue=False):
     outdir = Path(outdir)
     if not (outdir / "chain.npy").exists():
         return None
-    man = read_manifest(outdir)
-    if (not force_requeue and isinstance(man, dict)
-            and not man.get("corrupt")
-            and (man.get("serve") or {}).get("state") == "quarantined"):
-        raise CheckpointError(
-            f"{outdir} holds a QUARANTINED job (its serving tier "
-            "parked it after repeated row-health breaches).  The "
-            "checkpoint is verified but the job needs an operator "
-            "decision: resume with force_requeue=True "
-            "(--force-requeue) to requeue it from the verified rows")
 
     def _names(fname):
         p = outdir / fname
@@ -315,4 +332,4 @@ def load_resume(outdir, force_requeue=False):
 
     store = ChainStore(outdir, _names("pars_chain.txt"),
                        _names("pars_bchain.txt"))
-    return store.load_resume()
+    return store.load_resume(force_requeue=force_requeue)

@@ -42,6 +42,7 @@ that also recompiles every time is a cache-miss bug, not flakiness.
 from __future__ import annotations
 
 import json
+import threading
 import time
 from dataclasses import asdict, dataclass, field
 from pathlib import Path
@@ -132,6 +133,14 @@ class CircuitBreaker:
     uploads keep diverging stops being re-admitted at full cadence —
     its retries cost the service compile/dispatch wall that healthy
     tenants are paying for.
+
+    Thread-safe: every transition and query runs under one instance
+    RLock.  The gateway reaches ``check``/``would_allow`` from N
+    concurrent handler threads while the scheduler thread claims
+    probes via ``allow`` — without the lock, two ``allow`` callers can
+    both observe ``_probing`` False and BOTH claim the single
+    half-open probe (check-then-set), so one failing probe re-opens
+    the breaker while a duplicate probe is already in flight.
     """
 
     def __init__(self, window=8, threshold=0.5, min_events=2,
@@ -141,6 +150,7 @@ class CircuitBreaker:
         self.min_events = max(1, int(min_events))
         self.cooldown_s = float(cooldown_s)
         self.clock = clock
+        self._lock = threading.RLock()
         self._events: list[bool] = []     # True = failure
         self.state = "closed"
         self.opened_at = None
@@ -153,64 +163,73 @@ class CircuitBreaker:
         return sum(self._events) / len(self._events)
 
     def record_failure(self) -> None:
-        if self.state == "half_open":
-            # the probe failed: straight back to open, fresh cooldown
-            self._trip()
-            return
-        self._events = (self._events + [True])[-self.window:]
-        if (self.state == "closed"
-                and len(self._events) >= self.min_events
-                and self._failure_rate() >= self.threshold):
-            self._trip()
+        with self._lock:
+            if self.state == "half_open":
+                # the probe failed: straight back to open, fresh cooldown
+                self._trip()
+                return
+            self._events = (self._events + [True])[-self.window:]
+            if (self.state == "closed"
+                    and len(self._events) >= self.min_events
+                    and self._failure_rate() >= self.threshold):
+                self._trip()
 
     def record_success(self) -> None:
-        if self.state == "half_open":
-            # probe succeeded: the fault cleared — close and forget
-            self.state = "closed"
-            self._events = []
-            self._probing = False
-            return
-        self._events = (self._events + [False])[-self.window:]
+        with self._lock:
+            if self.state == "half_open":
+                # probe succeeded: the fault cleared — close and forget
+                self.state = "closed"
+                self._events = []
+                self._probing = False
+                return
+            self._events = (self._events + [False])[-self.window:]
 
     def _trip(self) -> None:
-        self.state = "open"
-        self.opened_at = self.clock()
-        self.opens += 1
-        self._probing = False
+        with self._lock:
+            self.state = "open"
+            self.opened_at = self.clock()
+            self.opens += 1
+            self._probing = False
         telemetry.incr("circuit_opens")
 
     def would_allow(self) -> bool:
         """Non-consuming query: would :meth:`allow` pass right now?
         (Never transitions state or claims the half-open probe slot —
         submit-time gating must not eat the scheduler's probe.)"""
-        if self.state == "closed":
-            return True
-        if self.state == "open":
-            return self.clock() - self.opened_at >= self.cooldown_s
-        return not self._probing
+        with self._lock:
+            if self.state == "closed":
+                return True
+            if self.state == "open":
+                return self.clock() - self.opened_at >= self.cooldown_s
+            return not self._probing
 
     def allow(self) -> bool:
         """True when a call may proceed: always in CLOSED; in OPEN only
         once the cooldown elapsed (transitioning to HALF-OPEN); in
-        HALF-OPEN only for the single in-flight probe."""
-        if self.state == "closed":
-            return True
-        if self.state == "open":
-            if self.clock() - self.opened_at >= self.cooldown_s:
-                self.state = "half_open"
+        HALF-OPEN only for the single in-flight probe.  The
+        claim-the-probe decision is atomic under the instance lock:
+        exactly one concurrent caller wins the half-open slot."""
+        with self._lock:
+            if self.state == "closed":
+                return True
+            if self.state == "open":
+                if self.clock() - self.opened_at >= self.cooldown_s:
+                    self.state = "half_open"
+                    self._probing = True
+                    return True
+                return False
+            # half-open: one probe at a time
+            if not self._probing:
                 self._probing = True
                 return True
             return False
-        # half-open: one probe at a time
-        if not self._probing:
-            self._probing = True
-            return True
-        return False
 
     def check(self, subject="operation") -> None:
         """Raise :class:`CircuitOpen` unless :meth:`would_allow` —
         a query, not a claim: the probe slot stays available."""
-        if not self.would_allow():
+        with self._lock:
+            if self.would_allow():
+                return
             wait = 0.0 if self.opened_at is None else max(
                 0.0, self.cooldown_s - (self.clock() - self.opened_at))
             raise CircuitOpen(
@@ -220,9 +239,10 @@ class CircuitBreaker:
                 f"{wait:.1f}s", breaker=self)
 
     def snapshot(self) -> dict:
-        return {"state": self.state, "opens": int(self.opens),
-                "failure_rate": round(self._failure_rate(), 3),
-                "events": len(self._events)}
+        with self._lock:
+            return {"state": self.state, "opens": int(self.opens),
+                    "failure_rate": round(self._failure_rate(), 3),
+                    "events": len(self._events)}
 
 
 class AdmissionController:

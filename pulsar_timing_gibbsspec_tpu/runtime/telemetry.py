@@ -70,9 +70,12 @@ _gauges: dict[str, float] = {}
 
 def _esc(v) -> str:
     """Prometheus label-value escaping; keeps composite keys parseable
-    when a value carries quotes/backslashes (e.g. a path label)."""
+    when a value carries quotes/backslashes (e.g. a path label).  ``\\r``
+    is escaped too — the exposition spec only names ``\\n``, but a bare
+    carriage return from a hostile network-supplied label value would
+    still break line-oriented scrapers."""
     return str(v).replace("\\", "\\\\").replace('"', '\\"').replace(
-        "\n", "\\n")
+        "\n", "\\n").replace("\r", "\\r")
 
 
 def labeled(name: str, **labels) -> str:

@@ -116,7 +116,7 @@ class ChainStore:
             # retry's os.replace would silently promote
             tmp.unlink(missing_ok=True)
 
-    def load_resume(self):
+    def load_resume(self, force_requeue=False):
         """Return (chain, bchain, start_iter, adapt_state) or None if there
         is nothing to resume from.
 
@@ -126,7 +126,14 @@ class ChainStore:
         is raised when neither set verifies — never a silent resume
         from corrupt files.  Pre-manifest directories skip verification
         (legacy path) but a chain/bchain row-count mismatch is still
-        reported loudly instead of silently truncated."""
+        reported loudly instead of silently truncated.
+
+        A quarantine-marked manifest (the serving tier parked this job
+        after exhausting its row-health budget) refuses to load unless
+        ``force_requeue=True`` — ``integrity.check_not_quarantined``,
+        shared with ``integrity.load_resume`` so the facade /
+        ``reshard_restore`` path cannot silently resume what the
+        scheduler refused."""
         from ..runtime import integrity, telemetry
 
         man = integrity.read_manifest(self.outdir)
@@ -149,6 +156,8 @@ class ChainStore:
                     ".bak checkpoint", RuntimeWarning, stacklevel=2)
                 self.log_metrics({"event": "checkpoint_rollback"})
                 man = integrity.read_manifest(self.outdir)
+        integrity.check_not_quarantined(self.outdir, force_requeue,
+                                        manifest=man)
         cpath = self.outdir / "chain.npy"
         bpath = self.outdir / "bchain.npy"
         if not (cpath.exists() and bpath.exists()):

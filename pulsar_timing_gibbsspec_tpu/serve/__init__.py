@@ -6,9 +6,18 @@ Turns the one-shot batch sampler into an always-on posterior engine
 one compiled sweep without retracing (:mod:`.engine`), per-request
 state + checkpointing (:mod:`.jobs`), and a fair-share scheduler that
 multiplexes independent analyses as extra batch rows of one compiled
-program (:mod:`.service`).  Contracts and the gauge glossary live in
-``docs/SERVING.md``; the static zero-retrace contract is
-``contracts/serve_buckets.json``.
+program (:mod:`.service`).  The network boundary sits in front of all
+of it: the fault-tolerant transport frontend (:mod:`.gateway` behind
+the :mod:`.wire` format/transports) adds idempotent submission,
+deadline propagation, resumable cursor streams and graceful drain
+without weakening any in-process contract.  Contracts and the gauge
+glossary live in ``docs/SERVING.md``; the static zero-retrace contract
+is ``contracts/serve_buckets.json``.
+
+:mod:`.gateway`/:mod:`.wire` are imported lazily (via module
+``__getattr__``) so the in-process service keeps its import cost and
+the analysis tooling can audit the transport modules without loading
+jax.
 """
 
 from .buckets import BucketOverflow, BucketSpec, BucketTable, probe_shape
@@ -16,8 +25,30 @@ from .engine import ProgramCache, SignatureMismatch, model_signature
 from .jobs import JOB_STATES, Job
 from .service import SamplerService
 
+_LAZY = {
+    "Gateway": ("gateway", "Gateway"),
+    "StreamSub": ("gateway", "StreamSub"),
+    "HttpTransport": ("wire", "HttpTransport"),
+    "WireError": ("wire", "WireError"),
+    "WireRequest": ("wire", "WireRequest"),
+    "WireResponse": ("wire", "WireResponse"),
+}
+
+
+def __getattr__(name):
+    got = _LAZY.get(name)
+    if got is None:
+        raise AttributeError(f"module {__name__!r} has no attribute "
+                             f"{name!r}")
+    import importlib
+
+    return getattr(importlib.import_module("." + got[0], __name__), got[1])
+
+
 __all__ = [
     "BucketOverflow", "BucketSpec", "BucketTable", "probe_shape",
     "ProgramCache", "SignatureMismatch", "model_signature",
     "JOB_STATES", "Job", "SamplerService",
+    "Gateway", "StreamSub", "HttpTransport",
+    "WireError", "WireRequest", "WireResponse",
 ]

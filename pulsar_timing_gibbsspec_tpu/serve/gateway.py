@@ -1,0 +1,761 @@
+"""Fault-tolerant job gateway: the network frontend of the service.
+
+:class:`Gateway` puts a transport boundary in front of
+:class:`~.service.SamplerService` without surrendering any contract
+the runtime already guarantees.  Clients disconnect, retry, duplicate,
+stall and lie about payload sizes; the gateway's job is to make all of
+that boring:
+
+- **Idempotent submission** — every submission carries a client-chosen
+  ``dedupe_key``.  The first submission admits the job and records the
+  ``dedupe_key -> (job_id, tenant_id, payload sha256)`` binding in the
+  journal *before* the ACK leaves the building; a replay (client retry
+  after a lost ACK — the ``conn_drop``/``dup_submit`` chaos kinds)
+  returns the ORIGINAL handle instead of double-admitting
+  (``dedupe_hits`` counter).  A replayed key with a different payload
+  digest is a typed ``DEDUPE_MISMATCH``, never a second job.
+- **Deadline propagation** — a per-request deadline
+  (``X-PTGibbs-Deadline-Ms`` / ``deadline_ms``) rides into the
+  scheduler loop: when it expires the job takes the existing
+  per-request drain (``SamplerService.drain_job`` — verified
+  checkpoint, slot freed at the chunk boundary, co-residents bitwise
+  untouched; ``deadline_drains`` counter) and reports ``expired``.
+  Its recorded prefix stays streamable and resumable.
+- **Resumable result streams** — stream cursors ARE monotonic
+  recorded-row counts, so the stream state lives in the client's
+  cursor and the job's verified row buffer, not in per-connection
+  server state: a disconnected client reattaches with its last cursor
+  and resumes exactly where it left off — bitwise, across gateway
+  restarts, because the rows come from the same deterministic chain.
+  Live streams are bounded per client (``shed_lag`` rows): a consumer
+  that falls further behind than the bound is SHED (typed
+  ``STREAM_SHED`` final event, ``shed_streams`` counter) — the
+  sampling loop never blocks on a slow socket.
+- **Graceful drain** — SIGTERM (via ``runtime.preemption``; the
+  gateway polls ``drain_requested`` like every other loop) stops
+  admissions (typed ``DRAINING``), drains residents through the PR 4
+  preemption path, persists the journal, and parks.  A restarted
+  gateway reloads the journal (verified: checksum sidecar + ``.bak``
+  rollback, the ``runtime/integrity`` manifest pattern), readmits
+  unfinished jobs against their checkpoint dirs, and refuses
+  stream-crossing reattachment (a reattach credential that does not
+  match the journaled dedupe binding is a typed ``STREAM_CROSSING``).
+
+Concurrency: transport handler threads and the scheduler thread share
+ONE reentrant lock (``_cond``); handlers hold it only to read/adjust
+bookkeeping, the scheduler holds it across a chunk step (submissions
+during a dispatch queue briefly — admission is between chunks anyway).
+Stream generators wait on the same condition, so a finished chunk
+wakes every attached stream.  All state machines here
+(``gateway``/``stream``) are declared in ``contracts/racecheck.json``
+and audited by racecheck M1–M3 alongside L1/L2/S1/C6.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import re
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+
+from ..obs import trace as otrace
+from ..runtime import faults, preemption, telemetry
+from . import wire
+from .service import SamplerService
+from .wire import WireError, WireRequest, WireResponse
+
+JOURNAL = "gateway_journal.json"
+JOURNAL_SHA = "gateway_journal.sha256"
+JOURNAL_BAK = "gateway_journal.bak.json"
+JOURNAL_BAK_SHA = "gateway_journal.bak.sha256"
+JOURNAL_SCHEMA = 1
+
+#: gateway lifecycle (racecheck machine ``gateway``)
+GATEWAY_STATES = ("serving", "draining", "stopped")
+#: stream subscription lifecycle (racecheck machine ``stream``)
+STREAM_STATES = ("attached", "streaming", "shed", "closed")
+
+_JOB_ROUTE = re.compile(r"^/v1/jobs/([A-Za-z0-9._-]{1,64})$")
+_STREAM_ROUTE = re.compile(r"^/v1/jobs/([A-Za-z0-9._-]{1,64})/stream$")
+
+
+def synthetic_model_builder(payload: dict):
+    """Default payload -> PTA builder: the bounded ``synthetic`` spec
+    the probes and chaos drills upload.  Every field is range-checked —
+    an upload names a model size, it does not get to pick one that
+    compiles for an hour.  Deployments with real par/tim ingest pass
+    their own builder; the gateway treats it as opaque."""
+    spec = payload.get("synthetic")
+    if not isinstance(spec, dict):
+        raise WireError("BAD_REQUEST",
+                        "payload must carry a 'synthetic' model spec")
+
+    def _bounded(key, default, lo, hi):
+        try:
+            v = int(spec.get(key, default))
+        except (TypeError, ValueError):
+            raise WireError("BAD_REQUEST",
+                            f"synthetic.{key} must be an int") from None
+        if not lo <= v <= hi:
+            raise WireError(
+                "BAD_REQUEST",
+                f"synthetic.{key}={v} outside [{lo}, {hi}]")
+        return v
+
+    n_psr = _bounded("n_psr", 2, 1, 8)
+    ntoa = _bounded("ntoa", 24, 8, 512)
+    tm_cols = _bounded("tm_cols", 3, 2, 8)
+    seed = _bounded("seed", 0, 0, 2**31 - 1)
+    nmodes = _bounded("nmodes", 3, 1, 16)
+    from ..analysis.jaxprcheck.entries import build_model, synthetic_pulsars
+
+    return build_model(
+        synthetic_pulsars(n_psr, ntoa, tm_cols=tm_cols, seed=seed), nmodes)
+
+
+class StreamSub:
+    """One attached result stream (bookkeeping only — the cursor is
+    the client's; this object exists so live streams can be counted,
+    bounded and shed)."""
+
+    def __init__(self, job_id: str, cursor: int):
+        self.job_id = job_id
+        self.cursor = int(cursor)
+        self.state = "attached"
+
+    def begin(self) -> None:
+        if self.state == "attached":
+            self.state = "streaming"
+
+    def shed(self) -> None:
+        """The consumer fell past the lag bound: drop the stream, keep
+        the sampler.  The client reattaches with its cursor."""
+        if self.state == "streaming":
+            self.state = "shed"
+
+    def close(self) -> None:
+        if self.state == "attached":
+            self.state = "closed"
+            return
+        if self.state == "streaming":
+            self.state = "closed"
+
+
+class Gateway:
+    """Transport-agnostic gateway core over one ``SamplerService``.
+
+    ``handle(WireRequest) -> WireResponse`` is the whole surface a
+    transport consumes (see :class:`~.wire.Transport`).  ``start()``
+    spawns the scheduler thread; ``join()`` blocks until the gateway
+    stops (drained, killed, or all work done and ``stop_when_idle``).
+    """
+
+    def __init__(self, root, table, *, model_builder=None, svc_kw=None,
+                 max_body=wire.MAX_BODY_BYTES, max_niter=100_000,
+                 shed_lag=256, stream_batch=64, stop_when_idle=False,
+                 clock=time.monotonic):
+        self.root = Path(root)
+        self.root.mkdir(parents=True, exist_ok=True)
+        self.max_body = int(max_body)
+        self.max_niter = int(max_niter)
+        self.shed_lag = int(shed_lag)
+        self.stream_batch = int(stream_batch)
+        self.stop_when_idle = bool(stop_when_idle)
+        self._clock = clock
+        self._build = (synthetic_model_builder if model_builder is None
+                       else model_builder)
+
+        kw = dict(svc_kw or {})
+        kw.setdefault("breaker", True)
+        kw.setdefault("admission", True)
+        self.svc = SamplerService(self.root / "svc", table, **kw)
+
+        # one reentrant lock for every gateway/service mutation; the
+        # condition wakes attached streams after each chunk writeback
+        self._cond = threading.Condition(threading.RLock())
+        self.state = "serving"
+        self._thread = None
+        self._steps = 0
+        self._requests = 0
+        self._subs: set[StreamSub] = set()
+        self._cold: dict[str, tuple] = {}   # job_id -> (rows, it) from disk
+
+        # journal: dedupe_key -> entry; _by_job is the reverse route
+        self._entries: dict[str, dict] = {}
+        self._next_seq = 0
+        self._next_tenant = 0
+        self._deadlines: dict[str, float] = {}   # job_id -> monotonic
+        self._load_journal()
+        self._by_job = {e["job_id"]: e for e in self._entries.values()}
+        self._readmit()
+
+    # -- journal (integrity pattern: tmp+fsync+rename, sha sidecar, .bak)
+
+    def _journal_blob(self) -> bytes:
+        doc = {"schema": JOURNAL_SCHEMA,
+               "service_seed": int(self.svc.service_seed),
+               "next_seq": int(self._next_seq),
+               "next_tenant": int(self._next_tenant),
+               "entries": self._entries}
+        return json.dumps(doc, indent=1, sort_keys=True).encode("utf-8")
+
+    def _write_atomic(self, name, blob: bytes) -> None:
+        tmp = self.root / (name + ".tmp")
+        with open(tmp, "wb") as fh:
+            fh.write(blob)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, self.root / name)
+
+    def _write_journal(self) -> None:
+        """Persist the routing state: rotate the verified ``.bak`` pair
+        first (a kill between the journal replace and the sidecar
+        replace must leave a recoverable generation), then primary,
+        then its checksum sidecar."""
+        prim, sha = self.root / JOURNAL, self.root / JOURNAL_SHA
+        if prim.exists() and sha.exists():
+            blob = prim.read_bytes()
+            if hashlib.sha256(blob).hexdigest() == \
+                    sha.read_text().strip():
+                self._write_atomic(JOURNAL_BAK, blob)
+                self._write_atomic(JOURNAL_BAK_SHA,
+                                   sha.read_bytes())
+        blob = self._journal_blob()
+        self._write_atomic(JOURNAL, blob)
+        self._write_atomic(JOURNAL_SHA,
+                           hashlib.sha256(blob).hexdigest().encode())
+
+    def _verified_journal(self, name, sha_name):
+        p, s = self.root / name, self.root / sha_name
+        if not p.exists():
+            return None
+        blob = p.read_bytes()
+        if not s.exists() or hashlib.sha256(blob).hexdigest() != \
+                s.read_text().strip():
+            return None
+        try:
+            doc = json.loads(blob)
+        except ValueError:
+            return None
+        if not isinstance(doc, dict) or doc.get("schema") != JOURNAL_SCHEMA:
+            return None
+        return doc
+
+    def _load_journal(self) -> None:
+        from ..runtime.integrity import CheckpointError
+
+        doc = self._verified_journal(JOURNAL, JOURNAL_SHA)
+        if doc is None and (self.root / JOURNAL).exists():
+            doc = self._verified_journal(JOURNAL_BAK, JOURNAL_BAK_SHA)
+            if doc is None:
+                raise CheckpointError(
+                    f"{self.root / JOURNAL}: gateway journal fails its "
+                    "checksum sidecar and no verified .bak generation "
+                    "exists — refusing to serve with unverifiable "
+                    "dedupe/routing state (delete the journal to start "
+                    "a FRESH gateway that cannot resume old handles)")
+            telemetry.incr("rollbacks")
+        if doc is None:
+            return
+        if int(doc.get("service_seed", 0)) != int(self.svc.service_seed):
+            raise CheckpointError(
+                f"{self.root / JOURNAL}: journal was written under "
+                f"service_seed {doc.get('service_seed')} but this "
+                f"gateway runs seed {self.svc.service_seed} — tenant "
+                "PRNG identities would cross streams; refuse")
+        self._entries = dict(doc.get("entries", {}))
+        self._next_seq = int(doc.get("next_seq", len(self._entries)))
+        self._next_tenant = int(doc.get("next_tenant", len(self._entries)))
+
+    def _readmit(self) -> None:
+        """Resubmit every unfinished journal entry against its own
+        checkpoint dir (``Job.try_resume`` restores the verified
+        prefix bitwise).  ``done`` entries stay cold — their rows
+        stream from disk; ``expired`` entries stay drained (the
+        client's deadline passed; re-running it is not our call)."""
+        now = time.time()
+        for ent in self._entries.values():
+            if ent.get("state") in ("done", "expired", "failed"):
+                continue
+            pta = self._build(ent["payload"])
+            job = self.svc.submit(pta, int(ent["niter"]),
+                                  job_id=ent["job_id"],
+                                  tenant_id=int(ent["tenant_id"]),
+                                  outdir=ent["outdir"])
+            ent["state"] = "active"
+            dl = ent.get("deadline_unix")
+            if dl is not None:
+                self._deadlines[job.job_id] = \
+                    self._clock() + max(0.0, float(dl) - now)
+        if self._entries:
+            self._write_journal()
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> "Gateway":
+        self._thread = threading.Thread(target=self._scheduler,
+                                        name="ptgibbs-gateway-sched",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def join(self, timeout=None) -> None:
+        if self._thread is not None:
+            self._thread.join(timeout)
+
+    def alive(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    def _scheduler(self) -> None:
+        """The single service-driving loop: deadlines, one supervised
+        chunk, journal sync, stream wakeup.  Exits by drain (SIGTERM /
+        ``/v1/drain``), injected ``gateway_kill``, or idle completion
+        when ``stop_when_idle``."""
+        try:
+            while True:
+                if preemption.drain_requested():
+                    self._graceful_drain()
+                    return
+                self._enforce_deadlines()
+                self._steps += 1
+                faults.fire("gateway.step", row=self._steps)
+                try:
+                    with self._cond:
+                        busy = self.svc.step_supervised()
+                        changed = self._sync_journal_states()
+                        self._cond.notify_all()
+                    if changed:
+                        with self._cond:
+                            self._write_journal()
+                except preemption.Preempted:
+                    self._graceful_drain(residents_drained=True)
+                    return
+                if not busy:
+                    if self.stop_when_idle and self._all_settled():
+                        self._graceful_drain(idle=True)
+                        return
+                    time.sleep(0.002)
+        except faults.InjectedCrash:
+            # simulated SIGKILL mid-stream: no goodbye, no final journal
+            # write — durability must already be on disk (it is: the
+            # journal persists at every mutation, checkpoints at every
+            # save_every chunk), which is exactly what the restart
+            # drill asserts
+            with self._cond:
+                self.state = "stopped"
+                self._cond.notify_all()
+
+    def _all_settled(self) -> bool:
+        """Every journaled job terminal — and at least one exists, so
+        an idle-stopping gateway does not park before its first
+        submission arrives."""
+        with self._cond:
+            return bool(self._entries) and all(
+                e.get("state") in ("done", "expired", "failed",
+                                   "quarantined")
+                for e in self._entries.values())
+
+    def _graceful_drain(self, residents_drained=False, idle=False) -> None:
+        """Stop admissions, drain residents through the preemption
+        path, persist the journal, park.  Safe to reach twice."""
+        with self._cond:
+            if self.state == "serving":
+                self.state = "draining"
+            self._cond.notify_all()
+        otrace.instant("gateway.drain", idle=idle)
+        if not residents_drained and any(self.svc.residents):
+            try:
+                with self._cond:
+                    self.svc.step_supervised()   # raises Preempted
+            except preemption.Preempted:
+                pass
+            except Exception:                    # noqa: BLE001
+                pass   # draining: best effort, journal still persists
+        with self._cond:
+            self._sync_journal_states()
+            for ent in self._entries.values():
+                if ent.get("state") == "active":
+                    ent["state"] = "drained"
+            self._write_journal()
+            if self.state == "draining":
+                self.state = "stopped"
+            self._cond.notify_all()
+
+    def _sync_journal_states(self) -> bool:
+        changed = False
+        for ent in self._entries.values():
+            if ent.get("state") not in ("active",):
+                continue
+            job = self.svc.jobs.get(ent["job_id"])
+            if job is None:
+                continue
+            new = None
+            if job.state == "done":
+                new = "done"
+            elif job.state == "failed":
+                new = "failed"
+            elif job.state == "quarantined" and job.failure:
+                new = "quarantined"     # terminally parked, not cooldown
+            if new is not None and ent.get("state") != new:
+                ent["state"] = new
+                changed = True
+        return changed
+
+    def _enforce_deadlines(self) -> None:
+        """Expired client deadlines convert to the per-request drain:
+        verified checkpoint, slot freed at the chunk boundary, every
+        co-resident untouched — never a hard kill."""
+        now = self._clock()
+        with self._cond:
+            due = [jid for jid, dl in self._deadlines.items() if now >= dl]
+            for jid in due:
+                del self._deadlines[jid]
+                ent = self._by_job.get(jid)
+                if ent is None or ent.get("state") != "active":
+                    continue
+                if self.svc.drain_job(jid, reason="deadline"):
+                    ent["state"] = "expired"
+                    telemetry.incr("deadline_drains")
+                    otrace.instant("gateway.deadline_drain", job=jid)
+            if due:
+                self._write_journal()
+                self._cond.notify_all()
+
+    # -- request handling ----------------------------------------------------
+
+    def handle(self, req: WireRequest) -> WireResponse:
+        """The transport-facing entry point (thread-safe)."""
+        self._requests += 1
+        fired = faults.transport_fault("wire.request", row=self._requests)
+        with otrace.span("gateway.request", method=req.method,
+                         route=req.path):
+            try:
+                resp = self._route(req)
+            except WireError as err:
+                resp = WireResponse.error(err)
+            except Exception as exc:             # noqa: BLE001
+                resp = WireResponse.error(wire.classify_exception(exc))
+        telemetry.incr("gateway_requests", code=str(resp.status))
+        if any(f.kind == "conn_drop" for f in fired):
+            # the response is computed — and for a submission, already
+            # journaled — but the client never sees it: the lost-ACK
+            # window the dedupe contract exists for
+            raise wire.ConnDropped(f"injected conn_drop on {req.path}")
+        return resp
+
+    def _route(self, req: WireRequest) -> WireResponse:
+        path = req.path.rstrip("/") or "/"
+        if req.method == "POST" and path == "/v1/jobs":
+            return self._submit(req)
+        if req.method == "POST" and path == "/v1/drain":
+            preemption.request_drain(reason="gateway_api")
+            return WireResponse(body={"draining": True})
+        if req.method == "GET" and path == "/v1/metrics":
+            return WireResponse(
+                raw=self.svc.prometheus().encode("utf-8"),
+                headers={"Content-Type":
+                         "text/plain; version=0.0.4; charset=utf-8"})
+        if req.method == "GET" and path == "/v1/healthz":
+            with self._cond:
+                body = {"state": self.state,
+                        "jobs": len(self._entries),
+                        "queue_depth": len(self.svc.queue),
+                        "residents": sum(1 for j in self.svc.residents
+                                         if j is not None)}
+            return WireResponse(body=body)
+        m = _JOB_ROUTE.match(path)
+        if m and req.method == "GET":
+            return self._status(m.group(1), req)
+        m = _STREAM_ROUTE.match(path)
+        if m and req.method == "GET":
+            return self._stream(m.group(1), req)
+        raise WireError("BAD_REQUEST",
+                        f"no route for {req.method} {req.path}")
+
+    # -- idempotent submission ----------------------------------------------
+
+    def _submit(self, req: WireRequest) -> WireResponse:
+        body = wire.parse_body(req.body, self.max_body)
+        dedupe = wire.require_name(body.get("dedupe_key"), "dedupe_key")
+        deadline_s = wire.parse_deadline_ms(req.headers, body)
+        payload = body.get("payload")
+        if not isinstance(payload, dict):
+            raise WireError("BAD_REQUEST",
+                            "payload must be a JSON object")
+        try:
+            niter = int(body.get("niter", 0))
+        except (TypeError, ValueError):
+            raise WireError("BAD_REQUEST", "niter must be an int") from None
+        if not 1 <= niter <= self.max_niter:
+            raise WireError("BAD_REQUEST",
+                            f"niter must be in [1, {self.max_niter}]")
+        digest = wire.payload_digest(payload)
+        fired = faults.transport_fault("wire.submit", row=self._requests)
+        resp = self._submit_once(dedupe, payload, digest, niter, deadline_s)
+        for f in fired:
+            if f.kind == "dup_submit":
+                # the retry a real client sends after a lost ACK — must
+                # resolve to the SAME handle via the journal binding
+                resp = self._submit_once(dedupe, payload, digest, niter,
+                                         deadline_s)
+        return resp
+
+    def _submit_once(self, dedupe, payload, digest, niter,
+                     deadline_s) -> WireResponse:
+        with self._cond:
+            if self.state != "serving":
+                raise WireError(
+                    "DRAINING",
+                    f"gateway is {self.state}: not accepting work — "
+                    "resubmit to a serving instance (your dedupe key "
+                    "makes the retry safe)")
+            ent = self._entries.get(dedupe)
+            if ent is not None:
+                if ent["payload_sha256"] != digest \
+                        or int(ent["niter"]) != int(niter):
+                    raise WireError(
+                        "DEDUPE_MISMATCH",
+                        f"dedupe_key {dedupe!r} is bound to a different "
+                        "submission (payload digest or niter changed): "
+                        "replays must be byte-identical — pick a fresh "
+                        "key for new work")
+                telemetry.incr("dedupe_hits")
+                return self._handle_body(ent, replayed=True)
+            pta = self._build(payload)
+            job_id = f"g{self._next_seq:05d}"
+            tenant_id = self._next_tenant
+            outdir = self.root / "jobs" / job_id
+            job = self.svc.submit(pta, niter, job_id=job_id,
+                                  tenant_id=tenant_id, outdir=outdir)
+            self._next_seq += 1
+            self._next_tenant += 1
+            ent = {"job_id": job.job_id, "tenant_id": int(tenant_id),
+                   "niter": int(niter), "payload": payload,
+                   "payload_sha256": digest, "outdir": str(outdir),
+                   "dedupe_key": dedupe, "state": "active",
+                   "deadline_unix": (None if deadline_s is None
+                                     else time.time() + deadline_s)}
+            self._entries[dedupe] = ent
+            self._by_job[job.job_id] = ent
+            if deadline_s is not None:
+                self._deadlines[job.job_id] = self._clock() + deadline_s
+            # the binding is durable BEFORE the ACK can be lost
+            self._write_journal()
+            self._cond.notify_all()
+            return self._handle_body(ent, replayed=False)
+
+    def _handle_body(self, ent, replayed) -> WireResponse:
+        it, state, _ = self._progress_locked(ent)
+        return WireResponse(body={
+            "job_id": ent["job_id"], "tenant_id": int(ent["tenant_id"]),
+            "niter": int(ent["niter"]), "state": state,
+            "cursor": int(it), "replayed": bool(replayed)})
+
+    # -- status / streams ----------------------------------------------------
+
+    def _entry(self, job_id, req: WireRequest) -> dict:
+        ent = self._by_job.get(job_id)
+        if ent is None:
+            raise WireError("NOT_FOUND", f"unknown job {job_id!r}")
+        cred = req.headers.get(wire.DEDUPE_HEADER)
+        if cred is not None and cred != ent["dedupe_key"]:
+            raise WireError(
+                "STREAM_CROSSING",
+                f"reattach credential does not match the journaled "
+                f"dedupe binding for {job_id!r} — refusing a "
+                "stream-crossing reattachment")
+        return ent
+
+    def _cold_rows(self, ent):
+        """Recorded rows of a job this incarnation never ran (done /
+        expired before a restart): loaded once from the verified
+        checkpoint.  ``force_requeue=True`` is a READ — streaming the
+        verified clean prefix of a parked job is safe; re-running it is
+        the decision that needs the operator."""
+        jid = ent["job_id"]
+        got = self._cold.get(jid)
+        if got is None:
+            from ..runtime import integrity
+
+            loaded = integrity.load_resume(ent["outdir"],
+                                           force_requeue=True)
+            if loaded is None:
+                got = (np.zeros((0, 0), np.float64), 0)
+            else:
+                chain, _bchain, upto, _adapt = loaded
+                got = (np.asarray(chain[:upto], np.float64), int(upto))
+            self._cold[jid] = got
+        return got
+
+    def _progress_locked(self, ent):
+        """(it, state, job|None) under the lock.  The gateway overlay
+        ('expired', terminal quarantine) wins over the raw job state."""
+        job = self.svc.jobs.get(ent["job_id"])
+        if ent.get("state") == "expired":
+            it = int(job.it) if job is not None \
+                else self._cold_rows(ent)[1]
+            return it, "expired", job
+        if job is None:
+            rows, it = self._cold_rows(ent)
+            return it, str(ent.get("state", "unknown")), None
+        state = job.state
+        if state == "quarantined" and job.failure:
+            state = "quarantined"       # terminally parked
+        return int(job.it), state, job
+
+    def _terminal(self, ent, state, job) -> bool:
+        if state in ("done", "failed", "expired", "drained"):
+            return True
+        return state == "quarantined" and (job is None
+                                           or job.failure is not None)
+
+    def _rows_locked(self, ent, lo, hi) -> np.ndarray:
+        job = self.svc.jobs.get(ent["job_id"])
+        if job is not None and job.chain is not None:
+            return np.array(job.chain[lo:hi], np.float64)
+        rows, it = self._cold_rows(ent)
+        return np.array(rows[lo:min(hi, it)], np.float64)
+
+    def _diag_locked(self, ent) -> dict:
+        lab = {"job": ent["job_id"], "tenant": str(int(ent["tenant_id"]))}
+        out = {}
+        for g in ("serve_ess_per_sec", "serve_rhat_max",
+                  "serve_accept_rate"):
+            v = telemetry.get_gauge(g, **lab)
+            if v is not None:
+                out[g] = v
+        return out
+
+    def _status(self, job_id, req: WireRequest) -> WireResponse:
+        with self._cond:
+            ent = self._entry(job_id, req)
+            it, state, job = self._progress_locked(ent)
+            body = {"job_id": job_id, "state": state, "cursor": int(it),
+                    "niter": int(ent["niter"]),
+                    "tenant_id": int(ent["tenant_id"]),
+                    "diag": self._diag_locked(ent),
+                    "deadline_pending": job_id in self._deadlines}
+            if job is not None:
+                body["failure"] = job.failure
+                ttfs = job.time_to_first_sample_ms()
+                if ttfs is not None:
+                    body["time_to_first_sample_ms"] = ttfs
+        return WireResponse(body=body)
+
+    def _stream(self, job_id, req: WireRequest) -> WireResponse:
+        with self._cond:
+            ent = self._entry(job_id, req)
+        cursor = wire.parse_cursor(req.query.get("cursor", 0),
+                                   niter=ent["niter"])
+        live = req.query.get("live", "") in ("1", "true", "yes")
+        try:
+            wait_s = float(req.query.get("wait", 0.0))
+        except ValueError:
+            raise WireError("BAD_REQUEST", "wait must be seconds") from None
+        wait_s = min(max(wait_s, 0.0), 60.0)
+        return WireResponse(
+            stream=self._stream_iter(ent, cursor, live, wait_s))
+
+    def _stream_iter(self, ent, cursor, live, wait_s):
+        """NDJSON event generator.  Each line carries the NEXT cursor —
+        acknowledging a line by advancing the client cursor is all the
+        protocol there is, which is why reattachment is trivial.  In
+        live mode the stream follows the job until terminal (or shed);
+        otherwise it long-polls up to ``wait_s`` then returns whatever
+        arrived."""
+        sub = StreamSub(ent["job_id"], cursor)
+        with self._cond:
+            self._subs.add(sub)
+            telemetry.gauge("gateway_streams", float(len(self._subs)))
+        sub.begin()
+        deadline = self._clock() + wait_s
+        try:
+            while True:
+                fired = faults.transport_fault("wire.stream",
+                                               row=sub.cursor)
+                for f in fired:
+                    if f.kind == "slow_client":
+                        # the consumer stalls; rows keep landing.  The
+                        # lag check below is what sheds it
+                        time.sleep(f.seconds)
+                    elif f.kind == "conn_drop":
+                        raise wire.ConnDropped("injected mid-stream drop")
+                with self._cond:
+                    it, state, job = self._progress_locked(ent)
+                    lag = it - sub.cursor
+                    if live and lag > self.shed_lag:
+                        sub.shed()
+                        telemetry.incr("shed_streams")
+                        otrace.instant("gateway.shed", job=sub.job_id,
+                                       lag=int(lag))
+                        err = WireError(
+                            "STREAM_SHED",
+                            f"stream lagged {lag} rows (> {self.shed_lag})"
+                            " and was shed — reattach with your cursor")
+                        yield (json.dumps(
+                            {**err.body(), "cursor": int(sub.cursor),
+                             "final": True},
+                            sort_keys=True) + "\n").encode()
+                        return
+                    rows = (self._rows_locked(
+                        ent, sub.cursor,
+                        min(it, sub.cursor + self.stream_batch))
+                        if lag > 0 else None)
+                    terminal = self._terminal(ent, state, job) \
+                        and it <= sub.cursor
+                    stopped = self.state != "serving"
+                    diag = self._diag_locked(ent)
+                if rows is not None and len(rows):
+                    nxt = sub.cursor + len(rows)
+                    yield (json.dumps(
+                        {"cursor": int(nxt), "state": state,
+                         "rows": rows.tolist(), "diag": diag},
+                        sort_keys=True) + "\n").encode()
+                    sub.cursor = nxt
+                    continue
+                if terminal:
+                    yield (json.dumps(
+                        {"cursor": int(sub.cursor), "state": state,
+                         "final": True, "diag": diag},
+                        sort_keys=True) + "\n").encode()
+                    return
+                if stopped:
+                    err = WireError("DRAINING",
+                                    "gateway drained mid-stream — "
+                                    "reattach to a serving instance "
+                                    "with your cursor")
+                    yield (json.dumps(
+                        {**err.body(), "cursor": int(sub.cursor),
+                         "final": True}, sort_keys=True) + "\n").encode()
+                    return
+                if not live and self._clock() >= deadline:
+                    yield (json.dumps(
+                        {"cursor": int(sub.cursor), "state": state,
+                         "rows": []}, sort_keys=True) + "\n").encode()
+                    return
+                with self._cond:
+                    self._cond.wait(0.05)
+        finally:
+            sub.close()
+            with self._cond:
+                self._subs.discard(sub)
+                telemetry.gauge("gateway_streams", float(len(self._subs)))
+
+    # -- reporting -----------------------------------------------------------
+
+    def report(self) -> dict:
+        with self._cond:
+            return {
+                "state": self.state,
+                "entries": {k: {kk: vv for kk, vv in e.items()
+                                if kk != "payload"}
+                            for k, e in self._entries.items()},
+                "steps": int(self._steps),
+                "requests": int(self._requests),
+                "service": self.svc.report(),
+            }

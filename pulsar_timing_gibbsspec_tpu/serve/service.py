@@ -755,6 +755,78 @@ class SamplerService:
                 except Exception:
                     self.mesh = None  # survivors can't form a mesh
 
+    def drain_job(self, job_id, reason="request") -> bool:
+        """Per-request drain of ONE job through its verified
+        checkpoint — the gateway's deadline-propagation path.  The job
+        leaves its slot/queue position at this chunk boundary (the
+        slot swaps to an inert filler at the next restack) and parks
+        resumable in state ``queued``; every co-resident keeps running
+        untouched, and nothing is ever hard-killed.  Returns True when
+        the job was drained, False when it is unknown or already
+        terminal."""
+        from ..runtime import integrity
+
+        job = self.jobs.get(job_id)
+        if job is None or job.state in ("done", "failed"):
+            return False
+        if job.state == "quarantined" and job.failure:
+            return False            # terminally parked: stays parked
+        if job in self.queue:
+            self.queue.remove(job)
+            telemetry.gauge("queue_depth", float(len(self.queue)))
+        for slot, res in enumerate(self.residents):
+            if res is job:
+                self.residents[slot] = None
+                self._dirty = True
+        if job.store is None:
+            # never admitted: nothing on disk to verify, nothing held
+            otrace.instant("serve.drain_job", job=job_id, reason=reason)
+            return True
+        with otrace.span("serve.drain_job", job=job_id, reason=reason):
+            job.set_state("draining")
+            job.checkpoint()
+            res = integrity.verify(job.store.outdir)
+            if not res["ok"]:
+                integrity.rollback(job.store.outdir)
+            job.set_state("queued")     # resumable, not failed
+        return True
+
+    def step_supervised(self) -> bool:
+        """One scheduling round under the recovery ladder: runs
+        :meth:`step` and absorbs the retryable failure classes the
+        supervisor taxonomy allows — device loss evacuates onto the
+        surviving submesh (up to ``evac_max``), device/crash/stall
+        classes revert every resident to its verified checkpoint and
+        back off deterministically (up to ``max_retries``).  ``user``/
+        ``unknown`` errors, exhausted budgets and ``Preempted`` re-
+        raise.  Returns False when there was nothing to run — both
+        :meth:`run` and the gateway scheduler thread are thin loops
+        over this, so in-process and network-fronted serving share one
+        recovery path."""
+        try:
+            return self.step()
+        except preemption.Preempted:
+            raise
+        except faults.DeviceLost as exc:
+            if self._evacuations >= self.evac_max:
+                raise
+            self._evacuations += 1
+            telemetry.incr("device_evacuations")
+            self.evacuate(exc.devices)
+            return True
+        except Exception as exc:                 # noqa: BLE001
+            cls = supervisor.classify_failure(exc)
+            if cls in ("user", "unknown") \
+                    or self._retries >= self.max_retries:
+                raise
+            self._retries += 1
+            telemetry.incr("retries")
+            time.sleep(supervisor.backoff_delay(
+                self._retries, base=self.backoff_base, jitter=0.0,
+                seed=self.service_seed))
+            self._revert_residents()
+            return True
+
     def run(self) -> dict:
         """Drive every submitted job to done/failed.  Retries
         retryable step failures (device/crash/stall classes) with
@@ -763,29 +835,7 @@ class SamplerService:
         loss (up to ``evac_max`` times); re-raises ``user`` errors and
         ``Preempted``."""
         while True:
-            try:
-                worked = self.step()
-            except preemption.Preempted:
-                raise
-            except faults.DeviceLost as exc:
-                if self._evacuations >= self.evac_max:
-                    raise
-                self._evacuations += 1
-                telemetry.incr("device_evacuations")
-                self.evacuate(exc.devices)
-                continue
-            except Exception as exc:             # noqa: BLE001
-                cls = supervisor.classify_failure(exc)
-                if cls in ("user", "unknown") \
-                        or self._retries >= self.max_retries:
-                    raise
-                self._retries += 1
-                telemetry.incr("retries")
-                time.sleep(supervisor.backoff_delay(
-                    self._retries, base=self.backoff_base, jitter=0.0,
-                    seed=self.service_seed))
-                self._revert_residents()
-                continue
+            worked = self.step_supervised()
             if not worked:
                 if not self.queue:
                     break

@@ -1,0 +1,406 @@
+"""Wire format + transports for the fault-tolerant job gateway.
+
+This module owns everything a transport needs and nothing the
+scheduler does: the typed error taxonomy (one structured code per
+rejection class, mapped onto the PR 13 admission/breaker exceptions),
+bounded JSON body parsing (clients lie about payload sizes), dedupe
+payload digests, deadline and cursor token parsing, and the
+:class:`Transport` protocol with the stdlib :class:`HttpTransport`
+implementation (``http.server`` threading core, so the whole stack
+runs in CI with no dependencies).
+
+The handler logic itself lives in :mod:`.gateway` —
+:class:`~.gateway.Gateway` is transport-agnostic: it consumes
+:class:`WireRequest` and produces :class:`WireResponse`, and any
+transport that can build the former and write the latter (HTTP here; a
+unix socket or gRPC shim elsewhere) gets every robustness contract —
+idempotent submission, deadline propagation, resumable cursors,
+graceful drain — for free.
+
+Error-code taxonomy (``docs/SERVING.md`` carries the full table):
+
+=================== ====== ==============================================
+code                status meaning / mapped exception
+=================== ====== ==============================================
+``BAD_REQUEST``     400    malformed JSON, unknown route, bad field
+``DEADLINE_INVALID`` 400   unparseable / non-positive deadline
+``CURSOR_INVALID``  400    cursor token not a row index in ``[0, niter]``
+``NOT_FOUND``       404    unknown job id
+``DEDUPE_MISMATCH`` 409    dedupe key replayed with a DIFFERENT payload
+``STREAM_CROSSING`` 409    reattach credentials do not match the journal
+``PAYLOAD_TOO_LARGE`` 413  body over the gateway's upload bound
+``BUCKET_OVERFLOW`` 422    dataset no bucket covers (typed, with nearest)
+``QUEUE_FULL``      429    admission backpressure (``AdmissionController``)
+``CIRCUIT_OPEN``    429    the tenant's circuit breaker is open
+``INTERNAL``        500    anything unclassified (the body still carries
+                           the exception repr for the operator)
+``DRAINING``        503    gateway is draining/stopped: no new work
+``STREAM_SHED``     503    this stream fell too far behind and was shed
+=================== ====== ==============================================
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import re
+from typing import Iterator, Protocol
+
+#: default upload bound (bytes) — submissions are small par/tim-shaped
+#: specs, not sample data; anything bigger is hostile or misrouted
+MAX_BODY_BYTES = 1 << 20
+
+#: code -> HTTP status (the taxonomy table in the module docstring)
+ERROR_STATUS = {
+    "BAD_REQUEST": 400,
+    "DEADLINE_INVALID": 400,
+    "CURSOR_INVALID": 400,
+    "NOT_FOUND": 404,
+    "DEDUPE_MISMATCH": 409,
+    "STREAM_CROSSING": 409,
+    "PAYLOAD_TOO_LARGE": 413,
+    "BUCKET_OVERFLOW": 422,
+    "QUEUE_FULL": 429,
+    "CIRCUIT_OPEN": 429,
+    "INTERNAL": 500,
+    "DRAINING": 503,
+    "STREAM_SHED": 503,
+}
+
+#: job ids / dedupe keys / tenant names arriving over the network are
+#: used as filesystem path components and Prometheus label values —
+#: constrain them at the wire instead of trusting every layer below
+NAME_RE = re.compile(r"^[A-Za-z0-9][A-Za-z0-9._-]{0,63}$")
+
+#: deadline header (milliseconds, relative to receipt); the JSON body
+#: field ``deadline_ms`` is equivalent and wins when both are present
+DEADLINE_HEADER = "x-ptgibbs-deadline-ms"
+#: reattach credential header: the submission's dedupe key (stream
+#: requests present it so a restarted gateway can refuse crossings)
+DEDUPE_HEADER = "x-ptgibbs-dedupe-key"
+
+
+class WireError(Exception):
+    """A typed, wire-mappable rejection.  ``code`` is one of
+    :data:`ERROR_STATUS`; ``retry_after_s`` (optional) surfaces breaker
+    cooldowns / backpressure hints to well-behaved clients."""
+
+    def __init__(self, code, message, retry_after_s=None):
+        if code not in ERROR_STATUS:
+            raise ValueError(f"unknown wire error code {code!r}")
+        super().__init__(message)
+        self.code = code
+        self.status = ERROR_STATUS[code]
+        self.retry_after_s = retry_after_s
+
+    def body(self) -> dict:
+        out = {"error": self.code, "message": str(self)}
+        if self.retry_after_s is not None:
+            out["retry_after_s"] = round(float(self.retry_after_s), 3)
+        return out
+
+
+def classify_exception(exc) -> WireError:
+    """Map a service-layer exception onto the wire taxonomy.
+
+    The PR 13 admission/breaker machinery raises one exception type
+    (``CircuitOpen``) for two distinct client remedies — resubmit after
+    the queue drains vs. wait out THIS tenant's cooldown — so the code
+    split here keys on the attached breaker, which backpressure
+    rejections do not carry."""
+    from ..runtime.supervisor import CircuitOpen
+    from .buckets import BucketOverflow
+
+    if isinstance(exc, WireError):
+        return exc
+    if isinstance(exc, BucketOverflow):
+        return WireError("BUCKET_OVERFLOW", str(exc))
+    if isinstance(exc, CircuitOpen):
+        if getattr(exc, "breaker", None) is None:
+            return WireError("QUEUE_FULL", str(exc))
+        br = exc.breaker
+        wait = None
+        if getattr(br, "opened_at", None) is not None:
+            wait = max(0.0, br.cooldown_s - (br.clock() - br.opened_at))
+        return WireError("CIRCUIT_OPEN", str(exc), retry_after_s=wait)
+    return WireError("INTERNAL", repr(exc))
+
+
+# -- bounded body / payload helpers ---------------------------------------
+
+def parse_body(raw: bytes, limit: int = MAX_BODY_BYTES) -> dict:
+    """Bounded JSON object parse.  ``raw`` longer than ``limit`` is a
+    typed ``PAYLOAD_TOO_LARGE`` (the transport already refused to READ
+    past ``limit + 1`` — this re-check makes the bound transport-
+    independent); anything that is not a JSON object is a
+    ``BAD_REQUEST``."""
+    if len(raw) > limit:
+        raise WireError(
+            "PAYLOAD_TOO_LARGE",
+            f"request body {len(raw)} B exceeds the gateway's "
+            f"{limit} B upload bound")
+    try:
+        body = json.loads(raw.decode("utf-8"))
+    except (ValueError, UnicodeDecodeError) as exc:
+        raise WireError("BAD_REQUEST",
+                        f"request body is not valid JSON: {exc}") from None
+    if not isinstance(body, dict):
+        raise WireError("BAD_REQUEST", "request body must be a JSON object")
+    return body
+
+
+def payload_digest(payload: dict) -> str:
+    """Canonical sha256 of a submission payload — the identity a dedupe
+    key is bound to.  Two submissions with one dedupe key and different
+    digests are a client bug (or an attack) and refuse with
+    ``DEDUPE_MISMATCH``; equal digests are the same upload retried."""
+    blob = json.dumps(payload, sort_keys=True,
+                      separators=(",", ":")).encode("utf-8")
+    return hashlib.sha256(blob).hexdigest()
+
+
+def require_name(value, field) -> str:
+    """Validate a network-supplied identifier (dedupe key, job id,
+    tenant name) against :data:`NAME_RE` — these become path components
+    and metric label values downstream."""
+    if not isinstance(value, str) or not NAME_RE.match(value):
+        raise WireError(
+            "BAD_REQUEST",
+            f"{field} must match {NAME_RE.pattern} (got {value!r})")
+    return value
+
+
+def parse_deadline_ms(headers: dict, body: dict | None = None):
+    """Relative deadline in seconds (float) or None when unset.  The
+    body field ``deadline_ms`` wins over the header; non-numeric or
+    non-positive values are a typed ``DEADLINE_INVALID``."""
+    raw = None
+    if body is not None and "deadline_ms" in body:
+        raw = body["deadline_ms"]
+    elif headers:
+        raw = {k.lower(): v for k, v in headers.items()}.get(
+            DEADLINE_HEADER)
+    if raw is None:
+        return None
+    try:
+        ms = float(raw)
+    except (TypeError, ValueError):
+        raise WireError("DEADLINE_INVALID",
+                        f"deadline {raw!r} is not a number (ms)") from None
+    if not ms > 0:
+        raise WireError("DEADLINE_INVALID",
+                        f"deadline must be positive, got {ms} ms")
+    return ms / 1e3
+
+
+def parse_cursor(raw, niter=None) -> int:
+    """Cursor token -> recorded-row index.  Cursors are MONOTONIC row
+    counts into the job's recorded chain, so a reattaching client
+    resumes exactly where it left off (the rows below the cursor were
+    already delivered and acknowledged by advancing it)."""
+    try:
+        cur = int(raw)
+    except (TypeError, ValueError):
+        raise WireError("CURSOR_INVALID",
+                        f"cursor {raw!r} is not a row index") from None
+    if cur < 0 or (niter is not None and cur > int(niter)):
+        raise WireError(
+            "CURSOR_INVALID",
+            f"cursor {cur} outside [0, {niter if niter is not None else '∞'}]")
+    return cur
+
+
+# -- transport-agnostic request/response ----------------------------------
+
+@dataclasses.dataclass
+class WireRequest:
+    """One request as the gateway core sees it, transport stripped."""
+
+    method: str
+    path: str
+    query: dict
+    headers: dict
+    body: bytes = b""
+
+
+@dataclasses.dataclass
+class WireResponse:
+    """Either a one-shot JSON body or a stream of NDJSON lines.
+
+    ``stream`` (an iterator of ``bytes`` lines, each a complete JSON
+    document ending in ``\\n``) wins over ``body`` when set; transports
+    write it incrementally (chunked transfer on HTTP) and must tolerate
+    the client vanishing mid-iteration — the iterator owns its own
+    cleanup via ``close()``."""
+
+    status: int = 200
+    body: dict | None = None
+    stream: Iterator[bytes] | None = None
+    headers: dict = dataclasses.field(default_factory=dict)
+    #: pre-encoded non-JSON payload (Prometheus exposition text);
+    #: wins over ``body``, loses to ``stream``
+    raw: bytes | None = None
+
+    @classmethod
+    def error(cls, err: WireError) -> "WireResponse":
+        hdr = {}
+        if err.retry_after_s is not None:
+            hdr["Retry-After"] = str(max(0, int(err.retry_after_s + 0.5)))
+        return cls(status=err.status, body=err.body(), headers=hdr)
+
+
+class Transport(Protocol):
+    """What the gateway needs from a transport: start accepting,
+    stop accepting, and say where it listens.  The transport builds a
+    :class:`WireRequest` per native request, calls
+    ``core.handle(request)`` and writes the :class:`WireResponse` back
+    (honoring ``stream``); it never interprets routes or bodies."""
+
+    def start(self) -> None: ...
+
+    def stop(self) -> None: ...
+
+    @property
+    def address(self) -> tuple: ...
+
+
+class ConnDropped(Exception):
+    """Injected transport fault: the client connection vanished (the
+    ``conn_drop`` chaos kind).  Transports abort the response without
+    writing anything — exactly what a dead TCP peer looks like."""
+
+
+class HttpTransport:
+    """Threading ``http.server`` front for a :class:`~.gateway.Gateway`.
+
+    One handler thread per connection (stdlib ``ThreadingHTTPServer``),
+    so every gateway entry point is concurrent by construction — the
+    core's locking, the breaker's probe accounting and the stream
+    shedding rules are all exercised exactly as a real deployment
+    would.  ``port=0`` binds an ephemeral port (tests)."""
+
+    def __init__(self, core, host="127.0.0.1", port=0):
+        import http.server
+        import threading
+
+        transport = self
+
+        class _Handler(http.server.BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+            # quiet: the gateway has spans/metrics; stderr noise is not
+            # an observability channel
+            def log_message(self, fmt, *args):  # noqa: ARG002
+                pass
+
+            def _read_body(self) -> bytes:
+                """Bounded read: trust Content-Length only up to the
+                upload bound + 1 so a lying client cannot make the
+                handler buffer an arbitrary body (the +1 byte makes the
+                over-limit case detectable as TOO_LARGE, not silently
+                truncated-and-accepted)."""
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                except ValueError:
+                    return b""
+                n = max(0, n)
+                cap = int(core.max_body) + 1
+                return self.rfile.read(min(n, cap))
+
+            def _serve(self, method):
+                from urllib.parse import parse_qsl, urlsplit
+
+                parts = urlsplit(self.path)
+                req = WireRequest(
+                    method=method, path=parts.path,
+                    query=dict(parse_qsl(parts.query)),
+                    headers={k.lower(): v for k, v in self.headers.items()},
+                    body=self._read_body() if method == "POST" else b"")
+                try:
+                    resp = core.handle(req)
+                except ConnDropped:
+                    self.close_connection = True
+                    return
+                try:
+                    self._write(resp)
+                except (BrokenPipeError, ConnectionResetError, OSError):
+                    self.close_connection = True
+
+            def _write(self, resp: WireResponse):
+                if resp.stream is not None:
+                    self.send_response(resp.status)
+                    self.send_header("Content-Type",
+                                     "application/x-ndjson")
+                    self.send_header("Transfer-Encoding", "chunked")
+                    for k, v in resp.headers.items():
+                        self.send_header(k, v)
+                    self.end_headers()
+                    try:
+                        for line in resp.stream:
+                            self.wfile.write(
+                                b"%x\r\n%s\r\n" % (len(line), line))
+                        self.wfile.write(b"0\r\n\r\n")
+                    except ConnDropped:
+                        # injected client vanish: abort mid-stream
+                        self.close_connection = True
+                    finally:
+                        close = getattr(resp.stream, "close", None)
+                        if close is not None:
+                            close()
+                    return
+                if resp.raw is not None:
+                    blob = resp.raw
+                else:
+                    blob = json.dumps(
+                        resp.body if resp.body is not None else {},
+                        sort_keys=True).encode("utf-8")
+                self.send_response(resp.status)
+                hdrs = dict(resp.headers)
+                ctype = hdrs.pop("Content-Type", "application/json")
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(blob)))
+                for k, v in hdrs.items():
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(blob)
+
+            def do_GET(self):
+                self._serve("GET")
+
+            def do_POST(self):
+                self._serve("POST")
+
+        class _Server(http.server.ThreadingHTTPServer):
+            daemon_threads = True
+            # drained gateways must release the port promptly on CI
+            allow_reuse_address = True
+
+        self._server_cls = _Server
+        self._handler_cls = _Handler
+        self._host, self._port = host, int(port)
+        self._httpd = None
+        self._thread = None
+        self._threading = threading
+
+    def start(self) -> None:
+        self._httpd = self._server_cls((self._host, self._port),
+                                       self._handler_cls)
+        self._thread = self._threading.Thread(
+            target=self._httpd.serve_forever, kwargs={"poll_interval": 0.05},
+            name="ptgibbs-gateway-http", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    @property
+    def address(self) -> tuple:
+        if self._httpd is None:
+            raise RuntimeError("transport not started")
+        return self._httpd.server_address

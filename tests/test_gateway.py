@@ -1,0 +1,437 @@
+"""Transport-frontend contracts (serve/gateway.py + serve/wire.py).
+
+The fast tier pins everything that does not need a compiled sampler:
+the typed error taxonomy and its exception mapping, bounded
+body/name/deadline/cursor parsing (network input is hostile), the
+stream-subscription state machine, and the gateway journal's
+integrity story — checksum sidecar, ``.bak`` rollback, refusal on an
+unverifiable journal or a service-seed mismatch.
+
+The ``slow``-marked end-to-end test drives a real submission through
+``Gateway.handle`` (no sockets — the transport-agnostic seam): dedupe
+replay returns the original handle, a changed payload is a
+``DEDUPE_MISMATCH``, the cursor stream round-trips every row bitwise
+against the job's own chain, and an expired deadline drains through a
+verified checkpoint.  The HTTP layer on top of the same core is
+exercised by ``tools/serve_probe.py --gateway`` and the chaos
+campaign's gateway leg (kill mid-stream / restart / reattach).
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from pulsar_timing_gibbsspec_tpu.serve import wire
+from pulsar_timing_gibbsspec_tpu.serve.wire import WireError
+
+NITER = 12
+
+
+# -- wire format ----------------------------------------------------------
+
+def test_error_taxonomy_is_closed():
+    """Every code maps to a real HTTP status; unknown codes refuse."""
+    for code, status in wire.ERROR_STATUS.items():
+        err = WireError(code, "msg")
+        assert err.status == status
+        assert err.body()["error"] == code
+    with pytest.raises(ValueError, match="unknown wire error code"):
+        WireError("NOT_A_CODE", "msg")
+    err = WireError("CIRCUIT_OPEN", "msg", retry_after_s=1.23456)
+    assert err.body()["retry_after_s"] == 1.235
+
+
+def test_parse_body_bounds_and_shape():
+    with pytest.raises(WireError) as ei:
+        wire.parse_body(b"x" * 100, limit=99)
+    assert ei.value.code == "PAYLOAD_TOO_LARGE"
+    with pytest.raises(WireError) as ei:
+        wire.parse_body(b"not json{")
+    assert ei.value.code == "BAD_REQUEST"
+    with pytest.raises(WireError) as ei:
+        wire.parse_body(b"[1, 2]")
+    assert ei.value.code == "BAD_REQUEST"
+    assert wire.parse_body(b'{"a": 1}') == {"a": 1}
+
+
+def test_require_name_refuses_hostile_identifiers():
+    """Names become path components and Prometheus label values —
+    traversal, control characters and over-length all refuse."""
+    assert wire.require_name("job-1.A_b", "dedupe_key") == "job-1.A_b"
+    for bad in ("", "a\nb", "../etc", ".hidden", "a" * 65, 7, None,
+                'quo"te', "spa ce", "unié"):
+        with pytest.raises(WireError) as ei:
+            wire.require_name(bad, "dedupe_key")
+        assert ei.value.code == "BAD_REQUEST"
+
+
+def test_parse_deadline_precedence_and_validation():
+    hdr = {wire.DEADLINE_HEADER: "1500"}
+    assert wire.parse_deadline_ms(hdr) == 1.5
+    # case-insensitive header lookup (HTTP normalizes arbitrarily)
+    assert wire.parse_deadline_ms({"X-PTGibbs-Deadline-Ms": "500"}) == 0.5
+    # body wins over header
+    assert wire.parse_deadline_ms(hdr, {"deadline_ms": 250}) == 0.25
+    assert wire.parse_deadline_ms({}, {}) is None
+    for bad in ("soon", -5, 0):
+        with pytest.raises(WireError) as ei:
+            wire.parse_deadline_ms({}, {"deadline_ms": bad})
+        assert ei.value.code == "DEADLINE_INVALID"
+
+
+def test_parse_cursor_token_bounds():
+    assert wire.parse_cursor("5", niter=10) == 5
+    assert wire.parse_cursor(0) == 0
+    for bad, niter in (("x", None), (-1, None), (11, 10)):
+        with pytest.raises(WireError) as ei:
+            wire.parse_cursor(bad, niter=niter)
+        assert ei.value.code == "CURSOR_INVALID"
+
+
+def test_payload_digest_is_canonical():
+    a = wire.payload_digest({"b": 1, "a": [1, 2]})
+    b = wire.payload_digest({"a": [1, 2], "b": 1})
+    assert a == b
+    assert a != wire.payload_digest({"a": [1, 2], "b": 2})
+
+
+def test_classify_exception_maps_service_taxonomy():
+    from pulsar_timing_gibbsspec_tpu.runtime.supervisor import (
+        CircuitBreaker, CircuitOpen)
+
+    # passthrough
+    we = WireError("NOT_FOUND", "gone")
+    assert wire.classify_exception(we) is we
+    # backpressure (no breaker attached) vs tenant breaker cooldown
+    assert wire.classify_exception(
+        CircuitOpen("queue full", breaker=None)).code == "QUEUE_FULL"
+    t = {"now": 0.0}
+    br = CircuitBreaker(window=2, threshold=0.5, min_events=1,
+                        cooldown_s=30.0, clock=lambda: t["now"])
+    br.record_failure()
+    assert br.state == "open"
+    t["now"] = 12.0
+    err = wire.classify_exception(CircuitOpen("tenant", breaker=br))
+    assert err.code == "CIRCUIT_OPEN" and err.status == 429
+    assert err.retry_after_s == pytest.approx(18.0)
+    # anything unclassified is INTERNAL, body carries the repr
+    err = wire.classify_exception(RuntimeError("boom"))
+    assert err.code == "INTERNAL" and "boom" in err.body()["message"]
+
+
+def test_bucket_overflow_maps_to_422():
+    from pulsar_timing_gibbsspec_tpu.serve.buckets import (
+        BucketOverflow, BucketSpec, DatasetShape)
+
+    exc = BucketOverflow(DatasetShape(2, 99, 24, 3),
+                         BucketSpec(2, 48, 24, 3))
+    err = wire.classify_exception(exc)
+    assert err.code == "BUCKET_OVERFLOW" and err.status == 422
+
+
+# -- stream subscription machine ------------------------------------------
+
+def test_stream_sub_state_machine():
+    from pulsar_timing_gibbsspec_tpu.serve.gateway import (
+        STREAM_STATES, StreamSub)
+
+    sub = StreamSub("j", 0)
+    assert sub.state == "attached" and sub.state in STREAM_STATES
+    sub.begin()
+    assert sub.state == "streaming"
+    sub.shed()
+    assert sub.state == "shed"
+    sub.close()                       # shed is terminal: close is a no-op
+    assert sub.state == "shed"
+    sub2 = StreamSub("j", 3)
+    sub2.close()                      # never began: attached -> closed
+    assert sub2.state == "closed"
+    sub2.begin()                      # closed is terminal
+    assert sub2.state == "closed"
+
+
+# -- journal integrity ----------------------------------------------------
+
+def _table():
+    from pulsar_timing_gibbsspec_tpu.serve.buckets import (BucketSpec,
+                                                           BucketTable)
+
+    return BucketTable([BucketSpec(2, 40, 24, 3)])
+
+
+def _fake_done_entry(root, key="k0", job_id="g00000"):
+    return {"job_id": job_id, "tenant_id": 0, "niter": 4,
+            "payload": {"synthetic": {}}, "payload_sha256": "0" * 64,
+            "outdir": str(root / "jobs" / job_id), "dedupe_key": key,
+            "state": "done", "deadline_unix": None}
+
+
+def test_journal_roundtrip_and_bak_rollback(tmp_path):
+    """The journal survives its own corruption: primary fails the
+    checksum -> the rotated ``.bak`` pair restores the binding; both
+    generations bad -> typed refusal, never a silent fresh start."""
+    from pulsar_timing_gibbsspec_tpu.runtime import telemetry
+    from pulsar_timing_gibbsspec_tpu.runtime.integrity import CheckpointError
+    from pulsar_timing_gibbsspec_tpu.serve.gateway import (
+        JOURNAL, JOURNAL_BAK, JOURNAL_SHA, Gateway)
+
+    gw = Gateway(tmp_path / "gw", _table())
+    with gw._cond:
+        gw._entries["k0"] = _fake_done_entry(tmp_path / "gw")
+        gw._write_journal()
+        gw._write_journal()          # second write rotates the .bak pair
+    assert (tmp_path / "gw" / JOURNAL_BAK).exists()
+
+    # clean reload: binding survives, done entries are NOT readmitted
+    gw2 = Gateway(tmp_path / "gw", _table())
+    assert gw2._entries["k0"]["job_id"] == "g00000"
+    assert gw2.svc.jobs == {}
+
+    # corrupt the primary: the verified .bak generation takes over
+    prim = tmp_path / "gw" / JOURNAL
+    prim.write_bytes(prim.read_bytes()[:-7] + b"GARBAGE")
+    before = telemetry.get("rollbacks")
+    gw3 = Gateway(tmp_path / "gw", _table())
+    assert gw3._entries["k0"]["job_id"] == "g00000"
+    assert telemetry.get("rollbacks") == before + 1
+
+    # both generations unverifiable: refuse loudly
+    prim.write_bytes(b"{}")
+    (tmp_path / "gw" / JOURNAL_SHA).write_text("f" * 64)
+    (tmp_path / "gw" / JOURNAL_BAK).write_bytes(b"junk")
+    with pytest.raises(CheckpointError, match="journal"):
+        Gateway(tmp_path / "gw", _table())
+
+
+def test_journal_refuses_service_seed_mismatch(tmp_path):
+    """Tenant PRNG identity is (service_seed, tenant_id, iteration): a
+    journal written under another seed must not route onto this
+    service's streams."""
+    from pulsar_timing_gibbsspec_tpu.runtime.integrity import CheckpointError
+    from pulsar_timing_gibbsspec_tpu.serve.gateway import Gateway
+
+    gw = Gateway(tmp_path / "gw", _table())
+    with gw._cond:
+        gw._entries["k0"] = _fake_done_entry(tmp_path / "gw")
+        gw._write_journal()
+    with pytest.raises(CheckpointError, match="service_seed"):
+        Gateway(tmp_path / "gw", _table(),
+                svc_kw={"service_seed": 7})
+
+
+def test_submission_is_journaled_before_ack(tmp_path):
+    """The dedupe binding must be durable BEFORE the client can see the
+    ACK — a fresh gateway instance on the same root resolves the retry
+    to the original handle without the first instance saying goodbye."""
+    from pulsar_timing_gibbsspec_tpu.serve.gateway import Gateway
+    from pulsar_timing_gibbsspec_tpu.serve.wire import WireRequest
+
+    body = json.dumps({
+        "dedupe_key": "dk", "niter": 4,
+        "payload": {"synthetic": {"n_psr": 2, "ntoa": 24, "tm_cols": 3,
+                                  "seed": 0, "nmodes": 3}}}).encode()
+    req = WireRequest("POST", "/v1/jobs", {}, {}, body)
+    gw = Gateway(tmp_path / "gw", _table())
+    resp = gw.handle(req)
+    assert resp.status == 200 and resp.body["replayed"] is False
+    # no shutdown, no drain: the journal alone carries the binding
+    gw2 = Gateway(tmp_path / "gw", _table())
+    resp2 = gw2.handle(req)
+    assert resp2.status == 200
+    assert resp2.body["replayed"] is True
+    assert resp2.body["job_id"] == resp.body["job_id"]
+    assert resp2.body["tenant_id"] == resp.body["tenant_id"]
+    # same key, different payload: typed refusal, no second job
+    body2 = json.dumps({
+        "dedupe_key": "dk", "niter": 4,
+        "payload": {"synthetic": {"n_psr": 2, "ntoa": 30, "tm_cols": 3,
+                                  "seed": 0, "nmodes": 3}}}).encode()
+    resp3 = gw2.handle(WireRequest("POST", "/v1/jobs", {}, {}, body2))
+    assert resp3.status == 409
+    assert resp3.body["error"] == "DEDUPE_MISMATCH"
+    assert len(gw2.svc.jobs) == 1
+
+
+def test_stream_crossing_refused_after_restart(tmp_path):
+    """A reattach credential that does not match the journaled dedupe
+    binding refuses with STREAM_CROSSING (409) — on a RESTARTED
+    gateway, where only the journal knows the binding."""
+    from pulsar_timing_gibbsspec_tpu.serve.gateway import Gateway
+    from pulsar_timing_gibbsspec_tpu.serve.wire import (DEDUPE_HEADER,
+                                                        WireRequest)
+
+    body = json.dumps({
+        "dedupe_key": "mine", "niter": 4,
+        "payload": {"synthetic": {"n_psr": 2, "ntoa": 24, "tm_cols": 3,
+                                  "seed": 0, "nmodes": 3}}}).encode()
+    gw = Gateway(tmp_path / "gw", _table())
+    jid = gw.handle(
+        WireRequest("POST", "/v1/jobs", {}, {}, body)).body["job_id"]
+    gw2 = Gateway(tmp_path / "gw", _table())
+    resp = gw2.handle(WireRequest("GET", f"/v1/jobs/{jid}", {},
+                                  {DEDUPE_HEADER: "not-mine"}))
+    assert resp.status == 409
+    assert resp.body["error"] == "STREAM_CROSSING"
+    # the right credential (or none — status is not secret) passes
+    assert gw2.handle(WireRequest("GET", f"/v1/jobs/{jid}", {},
+                                  {DEDUPE_HEADER: "mine"})).status == 200
+
+
+def test_unknown_route_and_job(tmp_path):
+    from pulsar_timing_gibbsspec_tpu.serve.gateway import Gateway
+    from pulsar_timing_gibbsspec_tpu.serve.wire import WireRequest
+
+    gw = Gateway(tmp_path / "gw", _table())
+    assert gw.handle(WireRequest("PUT", "/v1/jobs", {}, {})).status == 400
+    resp = gw.handle(WireRequest("GET", "/v1/jobs/nope", {}, {}))
+    assert resp.status == 404 and resp.body["error"] == "NOT_FOUND"
+    assert gw.handle(
+        WireRequest("GET", "/v1/healthz", {}, {})).body["state"] == "serving"
+
+
+def test_submit_validation_through_handle(tmp_path):
+    from pulsar_timing_gibbsspec_tpu.serve.gateway import Gateway
+    from pulsar_timing_gibbsspec_tpu.serve.wire import WireRequest
+
+    gw = Gateway(tmp_path / "gw", _table(), max_body=256, max_niter=100)
+
+    def _submit(doc):
+        raw = json.dumps(doc).encode()
+        return gw.handle(WireRequest("POST", "/v1/jobs", {}, {}, raw))
+
+    ok = {"dedupe_key": "d1", "niter": 4,
+          "payload": {"synthetic": {"n_psr": 2, "ntoa": 24,
+                                    "tm_cols": 3, "seed": 0,
+                                    "nmodes": 3}}}
+    assert _submit({**ok, "dedupe_key": "no\nnewline"}).body["error"] \
+        == "BAD_REQUEST"
+    assert _submit({**ok, "niter": 0}).body["error"] == "BAD_REQUEST"
+    assert _submit({**ok, "niter": 101}).body["error"] == "BAD_REQUEST"
+    assert _submit({**ok, "payload": 3}).body["error"] == "BAD_REQUEST"
+    big = {**ok, "payload": {"synthetic": {"pad": "x" * 400}}}
+    assert _submit(big).body["error"] == "PAYLOAD_TOO_LARGE"
+    hostile = {**ok, "dedupe_key": "d2",
+               "payload": {"synthetic": {"ntoa": 10**9}}}
+    assert _submit(hostile).body["error"] == "BAD_REQUEST"
+    assert gw.svc.jobs == {}          # nothing hostile was admitted
+
+
+# -- end-to-end through the transport seam (compiles a sampler) -----------
+
+@pytest.mark.slow
+def test_gateway_stream_bitwise_and_deadline_drain(tmp_path):
+    """One resident gateway run, handle()-level: the cursor stream
+    delivers every row bitwise (JSON float round-trip is exact),
+    reattachment from a mid-stream cursor resumes exactly, and an
+    expired deadline drains through a VERIFIED checkpoint."""
+    from pulsar_timing_gibbsspec_tpu.runtime import integrity, preemption
+    from pulsar_timing_gibbsspec_tpu.serve.gateway import Gateway
+    from pulsar_timing_gibbsspec_tpu.serve.wire import WireRequest
+
+    import time
+
+    preemption.reset()
+    gw = Gateway(tmp_path / "gw", _table(),
+                 svc_kw={"slots": 2, "chunk": 4, "quantum": 100,
+                         "save_every": 1})
+    payload = {"synthetic": {"n_psr": 2, "ntoa": 24, "tm_cols": 3,
+                             "seed": 0, "nmodes": 3}}
+    h = gw.handle(WireRequest("POST", "/v1/jobs", {}, {}, json.dumps(
+        {"dedupe_key": "main", "niter": NITER,
+         "payload": payload}).encode())).body
+    gw.start()
+    try:
+        resp = gw.handle(WireRequest(
+            "GET", f"/v1/jobs/{h['job_id']}/stream",
+            {"cursor": "0", "live": "1"}, {}))
+        rows, cursors = [], []
+        for line in resp.stream:
+            ev = json.loads(line)
+            rows.extend(ev.get("rows") or [])
+            cursors.append(int(ev["cursor"]))
+        assert len(rows) == NITER
+        assert cursors == sorted(cursors)          # monotonic tokens
+        job = gw.svc.jobs[h["job_id"]]
+        assert np.array_equal(np.asarray(rows, np.float64),
+                              np.asarray(job.chain[:NITER], np.float64))
+        # reattach mid-stream: exactly the suffix, bitwise
+        resp = gw.handle(WireRequest(
+            "GET", f"/v1/jobs/{h['job_id']}/stream",
+            {"cursor": "5", "wait": "5"}, {}))
+        tail = []
+        for line in resp.stream:
+            tail.extend(json.loads(line).get("rows") or [])
+        assert np.array_equal(np.asarray(tail, np.float64),
+                              np.asarray(job.chain[5:NITER], np.float64))
+
+        # the deadline job: submitted onto a WARM cache (so the
+        # deadline cannot expire inside the one planned compile) and
+        # sized to be nowhere near done when it lands
+        hdl = gw.handle(WireRequest(
+            "POST", "/v1/jobs", {}, {}, json.dumps(
+                {"dedupe_key": "late", "niter": 50_000,
+                 "deadline_ms": 1000, "payload": payload}).encode())).body
+        deadline = time.monotonic() + 60
+        st = None
+        while time.monotonic() < deadline:
+            st = gw.handle(WireRequest(
+                "GET", f"/v1/jobs/{hdl['job_id']}", {}, {})).body
+            if st["state"] == "expired":
+                break
+            time.sleep(0.05)
+        assert st is not None and st["state"] == "expired"
+        assert 0 < st["cursor"] < 50_000      # drained mid-run
+        rep = integrity.verify(tmp_path / "gw" / "jobs" / hdl["job_id"])
+        assert rep["ok"]
+        # the verified prefix stays streamable after expiry
+        resp = gw.handle(WireRequest(
+            "GET", f"/v1/jobs/{hdl['job_id']}/stream",
+            {"cursor": "0", "wait": "1"}, {}))
+        got = []
+        for line in resp.stream:
+            got.extend(json.loads(line).get("rows") or [])
+        assert len(got) >= 4                  # at least one saved chunk
+    finally:
+        preemption.request_drain(reason="test_teardown")
+        gw.join(timeout=60)
+        preemption.reset()
+
+
+@pytest.mark.slow
+def test_graceful_drain_parks_and_journals(tmp_path):
+    """request_drain() stops admissions (typed DRAINING), drains the
+    resident through the preemption path, and the journal marks the
+    job drained — a successor readmits it."""
+    from pulsar_timing_gibbsspec_tpu.runtime import preemption
+    from pulsar_timing_gibbsspec_tpu.serve.gateway import Gateway
+    from pulsar_timing_gibbsspec_tpu.serve.wire import WireRequest
+
+    preemption.reset()
+    try:
+        gw = Gateway(tmp_path / "gw", _table(),
+                     svc_kw={"slots": 2, "chunk": 4, "quantum": 100,
+                             "save_every": 1})
+        payload = {"synthetic": {"n_psr": 2, "ntoa": 24, "tm_cols": 3,
+                                 "seed": 0, "nmodes": 3}}
+        gw.handle(WireRequest("POST", "/v1/jobs", {}, {}, json.dumps(
+            {"dedupe_key": "d", "niter": 50_000,
+             "payload": payload}).encode()))
+        gw.start()
+        gw.handle(WireRequest("POST", "/v1/drain", {}, {}))
+        gw.join(timeout=120)
+        assert gw.state == "stopped"
+        resp = gw.handle(WireRequest("POST", "/v1/jobs", {}, {},
+                                     json.dumps({
+                                         "dedupe_key": "d2", "niter": 4,
+                                         "payload": payload}).encode()))
+        assert resp.status == 503 and resp.body["error"] == "DRAINING"
+        assert gw.report()["entries"]["d"]["state"] == "drained"
+    finally:
+        preemption.reset()
+    # a successor on the same root readmits the drained job
+    gw2 = Gateway(tmp_path / "gw", _table(),
+                  svc_kw={"slots": 2, "chunk": 4, "quantum": 100,
+                          "save_every": 1})
+    assert gw2.report()["entries"]["d"]["state"] == "active"
+    assert "g00000" in gw2.svc.jobs

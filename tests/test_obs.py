@@ -410,3 +410,45 @@ def test_render_telemetry_roundtrip():
     body = metrics.render_telemetry()
     assert 'ptgibbs_tobs2_ess{job="j1"} 12.5' in body
     telemetry.reset("tobs2_")
+
+
+def test_prometheus_hostile_label_values_roundtrip():
+    """satellite (PR 17): tenant/job names now arrive over the network.
+    A hostile label value — newlines, carriage returns, quotes,
+    backslashes, UTF-8 — must neither split a sample line (forging
+    metrics for a scraper) nor lose information: every sample stays on
+    ONE line and ``split_key``/``_unescape`` recover the exact value."""
+    hostiles = [
+        'evil" 1\nforged_metric 999',          # line-splitting attempt
+        "cr\rlf\n",                            # bare CR must escape too
+        "back\\slash\\",                       # trailing backslash
+        'quo"te"',
+        "unicodé-页-🙂",
+        "plain",
+    ]
+    telemetry.reset("tobs3_")
+    for i, name in enumerate(hostiles):
+        telemetry.gauge("tobs3_g", float(i), tenant=name)
+    body = metrics.render_telemetry()
+    telemetry.reset("tobs3_")
+
+    # 1) no sample line was split: every non-comment line is exactly
+    #    `name{labels} value`, and no forged family appears
+    sample_lines = [ln for ln in body.splitlines()
+                    if ln.startswith("ptgibbs_tobs3_g")]
+    assert len(sample_lines) == len(hostiles)
+    assert "forged_metric" not in {ln.split("{")[0].split(" ")[0]
+                                   for ln in body.splitlines() if ln}
+    # 2) lossless: parse each line back and recover the exact value
+    got = {}
+    for ln in sample_lines:
+        key, val = ln.rsplit(" ", 1)
+        _name, labels = metrics.split_key(key[len("ptgibbs_"):])
+        got[labels["tenant"]] = float(val)
+    assert got == {name: float(i) for i, name in enumerate(hostiles)}
+
+
+def test_prometheus_escape_unescape_roundtrip_exhaustive():
+    for s in ("", "\n", "\r", "\\", '"', "\\n", "a\\\nb", 'x"\r\\"',
+              "\\\\\n\r"):
+        assert metrics._unescape(metrics._escape(s)) == s

@@ -160,6 +160,48 @@ def test_circuit_breaker_state_machine():
 
 
 @pytest.mark.chaos_quick
+def test_breaker_half_open_single_probe_under_concurrency():
+    """Regression (PR 17): the half-open probe slot is claimed
+    atomically.  Before the breaker grew its instance lock, concurrent
+    ``allow()`` callers could interleave between reading ``_probing``
+    and setting it — several callers would each 'win' the single probe
+    and hammer a tenant the breaker had just tripped.  Gateway handler
+    threads make this a real interleaving, not a theoretical one: N
+    threads race ``allow()`` (with ``would_allow`` queries mixed in,
+    which must never consume the slot) and exactly one may claim."""
+    import threading
+
+    from pulsar_timing_gibbsspec_tpu.runtime.supervisor import CircuitBreaker
+
+    for _ in range(20):                   # many rounds to shake the race
+        br = CircuitBreaker(window=4, threshold=0.5, min_events=2,
+                            cooldown_s=0.0)
+        br.record_failure()
+        br.record_failure()
+        assert br.state == "open"         # cooldown 0: probe eligible now
+        n = 8
+        barrier = threading.Barrier(n)
+        wins = []
+
+        def racer():
+            barrier.wait()
+            for _ in range(25):
+                br.would_allow()          # queries must not claim
+            wins.append(br.allow())
+
+        threads = [threading.Thread(target=racer) for _ in range(n)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        assert sum(wins) == 1, \
+            f"{sum(wins)} callers claimed the single half-open probe"
+        assert br.state == "half_open"
+        br.record_success()
+        assert br.state == "closed"
+
+
+@pytest.mark.chaos_quick
 def test_admission_controller_backpressure_and_storm():
     from pulsar_timing_gibbsspec_tpu.runtime.supervisor import (
         AdmissionController, CircuitOpen)
@@ -279,6 +321,34 @@ def test_load_resume_refuses_quarantined_dir(tmp_path):
     np.testing.assert_array_equal(chain[:4], rows)
     np.testing.assert_array_equal(bchain[:4], brows)
     assert int(adapt["tenant_id"]) == 3
+
+
+@pytest.mark.chaos_quick
+def test_chainstore_facade_path_also_refuses_quarantined(tmp_path):
+    """Regression: ``ChainStore.load_resume`` (the facade /
+    ``reshard_restore`` path) used to skip the quarantine check
+    entirely — a parked job could be silently resumed through the side
+    door ``integrity.load_resume`` refused.  Both paths now route
+    through ``integrity.check_not_quarantined``."""
+    from pulsar_timing_gibbsspec_tpu.runtime import integrity
+    from pulsar_timing_gibbsspec_tpu.sampler.chains import ChainStore
+
+    rows = np.arange(8.0).reshape(4, 2)
+    brows = np.arange(4.0).reshape(4, 1)
+    store = ChainStore(tmp_path / "jobF", ["p0", "p1"], ["b0"])
+    store.save(rows, brows, 4,
+               extra={"serve": {"job_id": "jobF", "tenant_id": 1,
+                                "state": "quarantined"}})
+    with pytest.raises(integrity.CheckpointError, match="force_requeue"):
+        store.load_resume()
+    chain, bchain, upto, _ = store.load_resume(force_requeue=True)
+    assert upto == 4
+    np.testing.assert_array_equal(chain[:4], rows)
+    # a NON-quarantined serve marker stays loadable without force
+    store.save(rows, brows, 4,
+               extra={"serve": {"job_id": "jobF", "tenant_id": 1,
+                                "state": "done"}})
+    assert store.load_resume()[2] == 4
 
 
 # -- integration drills ----------------------------------------------------

@@ -294,6 +294,273 @@ def _run_seed(seed, args, root, cache, ptas, storm_pta, solos,
     return rec, fails
 
 
+def _http(method, url, body=None, headers=None, timeout=30):
+    """Tiny stdlib client: (status, raw bytes)."""
+    import urllib.error
+    import urllib.request
+
+    data = None if body is None else json.dumps(body).encode()
+    req = urllib.request.Request(url, data=data, method=method,
+                                 headers=dict(headers or {}))
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as exc:
+        return exc.code, exc.read()
+
+
+def _poll_stream(base, job_id, cursor, dedupe, deadline_s=60.0):
+    """Poll the cursor stream until the job is terminal; returns
+    (rows, final_state, cursor).  This is the RECONNECTING client: each
+    request stands alone, so it works identically across a gateway
+    restart."""
+    import time
+
+    rows, state = [], None
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < deadline_s:
+        st, raw = _http(
+            "GET", f"{base}/v1/jobs/{job_id}/stream?cursor={cursor}"
+            "&wait=2", headers={"x-ptgibbs-dedupe-key": dedupe})
+        if st != 200:
+            raise RuntimeError(f"stream HTTP {st}: {raw[:200]!r}")
+        for line in raw.splitlines():
+            if not line.strip():
+                continue
+            ev = json.loads(line)
+            rows.extend(ev.get("rows") or [])
+            cursor = max(cursor, int(ev.get("cursor", cursor)))
+            state = ev.get("state", state)
+            if ev.get("final"):
+                return rows, state, cursor
+    raise RuntimeError(f"stream did not reach a terminal state in "
+                       f"{deadline_s}s (cursor {cursor}, state {state})")
+
+
+def _gateway_drill(root, cache):
+    """The transport leg: every serving-tier contract driven through
+    the HTTP boundary under injected transport faults.
+
+    Asserts, end to end: duplicate submissions (injected ``dup_submit``
+    replay AND a real client retry) never double-admit; ``gateway_kill``
+    mid-stream → restart → the client reattaches with its cursor and
+    the assembled stream is BITWISE equal to the uninterrupted solo
+    run; a reattach with the wrong dedupe credential refuses
+    (``STREAM_CROSSING``); an expired client deadline drains through a
+    verified checkpoint while co-residents finish untouched; a stalled
+    live consumer is shed without blocking sampling; zero unplanned
+    steady retraces; zero orphaned jobs in the final journal."""
+    from pulsar_timing_gibbsspec_tpu.profiling import recompile_counter
+    from pulsar_timing_gibbsspec_tpu.runtime import (faults, integrity,
+                                                     preemption, telemetry)
+    from pulsar_timing_gibbsspec_tpu.serve.gateway import Gateway
+    from pulsar_timing_gibbsspec_tpu.serve.wire import HttpTransport
+    import time
+
+    fails = []
+    svc_kw = dict(slots=2, chunk=4, quantum=100, save_every=1,
+                  cache=cache)
+    payload = {"synthetic": {"n_psr": 2, "ntoa": 24, "tm_cols": 3,
+                             "seed": 0, "nmodes": 3}}
+
+    # solo ground truth: the gateway assigns tenant 0 to its first
+    # submission, and streams are pure in (service_seed, tenant_id,
+    # iteration) — so an in-process solo run IS the bitwise reference
+    from pulsar_timing_gibbsspec_tpu.analysis.jaxprcheck.entries import (
+        build_model, synthetic_pulsars)
+
+    gniter = 4 * NITER
+    solo_pta = build_model(synthetic_pulsars(2, 24, tm_cols=3, seed=0), 3)
+    solo_svc = _service(root / "gwsolo", cache, slots=2)
+    solo_job = solo_svc.submit(solo_pta, gniter, job_id="gwsolo",
+                               tenant_id=0)
+    solo_svc.run()
+    if solo_job.state != "done":
+        return [f"gateway: solo baseline failed ({solo_job.failure})"]
+    solo_rows = np.asarray(solo_job.chain[:gniter], np.float64).copy()
+
+    preemption.reset()
+    faults.clear()
+    shed0 = telemetry.get("shed_streams")
+    gw = tx = None
+    try:
+        with recompile_counter() as rc:
+            rc.phase("steady")
+            gw = Gateway(root / "gw", _table(), svc_kw=svc_kw,
+                         shed_lag=2, stop_when_idle=False).start()
+            tx = HttpTransport(gw)
+            tx.start()
+            host, port = tx.address
+            base = f"http://{host}:{port}"
+
+            # -- idempotent submission under an injected duplicate
+            faults.inject("dup_submit", point="wire.submit", times=1)
+            st, raw = _http("POST", f"{base}/v1/jobs", body={
+                "dedupe_key": "gwjob", "payload": payload,
+                "niter": gniter})
+            h1 = json.loads(raw)
+            if st != 200:
+                return [f"gateway: submit HTTP {st}: {raw[:200]!r}"]
+            if not h1.get("replayed"):
+                fails.append("gateway: injected dup_submit did not "
+                             "resolve through the dedupe journal")
+            # a real client retry (the lost-ACK path) — same handle
+            st, raw = _http("POST", f"{base}/v1/jobs", body={
+                "dedupe_key": "gwjob", "payload": payload,
+                "niter": gniter})
+            h2 = json.loads(raw)
+            if h2.get("job_id") != h1.get("job_id") \
+                    or not h2.get("replayed"):
+                fails.append("gateway: client retry double-admitted "
+                             f"({h1.get('job_id')} vs {h2.get('job_id')})")
+            if len(gw.svc.jobs) != 1:
+                fails.append(f"gateway: {len(gw.svc.jobs)} jobs admitted "
+                             "for one dedupe key")
+            jid = h1["job_id"]
+
+            # -- kill the gateway mid-stream: arm the kill a couple of
+            # scheduler steps out, then read a live prefix until the
+            # stream dies under us (DRAINING final / rows so far)
+            faults.inject("gateway_kill", point="gateway.step",
+                          at_row=gw._steps + 2, times=1)
+            rows = []
+            cursor = 0
+            st, raw = _http(
+                "GET", f"{base}/v1/jobs/{jid}/stream?cursor=0&wait=5",
+                headers={"x-ptgibbs-dedupe-key": "gwjob"})
+            for line in raw.splitlines():
+                if line.strip():
+                    ev = json.loads(line)
+                    rows.extend(ev.get("rows") or [])
+                    cursor = max(cursor, int(ev.get("cursor", 0)))
+            t0 = time.monotonic()
+            while gw.alive() and time.monotonic() - t0 < 30:
+                time.sleep(0.02)
+            if gw.alive():
+                fails.append("gateway: injected gateway_kill did not "
+                             "stop the scheduler")
+            tx.stop()
+
+            # -- restart: journal reload, cursor reattach, finish
+            gw2 = Gateway(root / "gw", _table(), svc_kw=svc_kw,
+                          shed_lag=2, stop_when_idle=False).start()
+            tx2 = HttpTransport(gw2)
+            tx2.start()
+            gw, tx = gw2, tx2
+            host, port = tx2.address
+            base = f"http://{host}:{port}"
+            # stream-crossing refusal: wrong reattach credential
+            st, raw = _http(
+                "GET", f"{base}/v1/jobs/{jid}/stream?cursor={cursor}",
+                headers={"x-ptgibbs-dedupe-key": "not-the-key"})
+            if st != 409:
+                fails.append("gateway: stream-crossing reattach was "
+                             f"not refused (HTTP {st})")
+            tail, state, cursor = _poll_stream(base, jid, cursor,
+                                               "gwjob")
+            rows.extend(tail)
+            if state != "done":
+                fails.append(f"gateway: job ended {state!r} after "
+                             "restart, not done")
+            got = np.asarray(rows, np.float64)
+            if got.shape != solo_rows.shape \
+                    or not np.array_equal(got, solo_rows):
+                fails.append(
+                    "gateway: reattached stream is not bitwise equal "
+                    f"to the solo run (got {got.shape}, want "
+                    f"{solo_rows.shape})")
+
+            # -- deadline propagation: expires → verified-checkpoint
+            # drain; the co-resident shed job below keeps sampling
+            # niter is sized so the deadline reliably lands mid-run
+            # (save_every=1 writes a verified checkpoint every chunk)
+            st, raw = _http("POST", f"{base}/v1/jobs", body={
+                "dedupe_key": "gwdl", "payload": payload,
+                "niter": 20_000, "deadline_ms": 600})
+            dl = json.loads(raw)
+            if st != 200:
+                fails.append(f"gateway: deadline submit HTTP {st}")
+
+            # -- slow-client shedding on a live stream
+            faults.inject("slow_client", point="wire.stream",
+                          seconds=0.25, times=4)
+            st, raw = _http("POST", f"{base}/v1/jobs", body={
+                "dedupe_key": "gwshed", "payload": payload,
+                "niter": 2 * NITER})
+            sh = json.loads(raw)
+            st, raw = _http(
+                "GET", f"{base}/v1/jobs/{sh['job_id']}/stream"
+                "?cursor=0&live=1",
+                headers={"x-ptgibbs-dedupe-key": "gwshed"},
+                timeout=60)
+            evs = [json.loads(x) for x in raw.splitlines() if x.strip()]
+            if not any(e.get("error") == "STREAM_SHED" for e in evs):
+                fails.append("gateway: stalled live stream was not shed")
+            if telemetry.get("shed_streams") <= shed0:
+                fails.append("gateway: shed_streams counter did not move")
+            # the shed client reattaches by cursor and still gets
+            # every row
+            cur = max(int(e.get("cursor", 0)) for e in evs)
+            srows, sstate, _ = _poll_stream(base, sh["job_id"],
+                                            0, "gwshed")
+            if sstate != "done" or len(srows) != 2 * NITER:
+                fails.append(f"gateway: shed job ended {sstate!r} with "
+                             f"{len(srows)} rows")
+            _ = cur
+
+            # -- the expired job drained through a VERIFIED checkpoint
+            t0 = time.monotonic()
+            dstate = None
+            while time.monotonic() - t0 < 30:
+                st, raw = _http("GET", f"{base}/v1/jobs/{dl['job_id']}")
+                dstate = json.loads(raw).get("state")
+                if dstate == "expired":
+                    break
+                time.sleep(0.05)
+            if dstate != "expired":
+                fails.append(f"gateway: deadline job state {dstate!r}, "
+                             "never expired")
+            else:
+                ent = gw.report()["entries"]["gwdl"]
+                outdir = Path(ent["outdir"])
+                if (outdir / "manifest.json").exists():
+                    if not integrity.verify(outdir)["ok"]:
+                        fails.append("gateway: expired job checkpoint "
+                                     "fails verification")
+
+            # -- zero orphans: every journal entry terminal, queue empty
+            t0 = time.monotonic()
+            while time.monotonic() - t0 < 30 and not gw._all_settled():
+                time.sleep(0.05)
+            ents = gw.report()["entries"]
+            bad = {k: e["state"] for k, e in ents.items()
+                   if e["state"] not in ("done", "expired")}
+            if bad:
+                fails.append(f"gateway: orphaned journal entries {bad}")
+            if gw.svc.queue:
+                fails.append(f"gateway: queue not drained "
+                             f"({len(gw.svc.queue)} left)")
+
+            # teardown through the front door: the graceful-drain path
+            # is part of the contract, so exercise it rather than
+            # abandoning a daemon scheduler
+            preemption.request_drain(reason="gateway_drill_teardown")
+            gw.join(timeout=30)
+            if gw.alive() or gw.state != "stopped":
+                fails.append("gateway: graceful drain did not park the "
+                             f"scheduler (state {gw.state!r})")
+        unplanned = rc.unplanned("steady")
+        if unplanned:
+            fails.append(f"gateway: {unplanned} unplanned steady "
+                         "retrace(s) across kill/restart")
+    finally:
+        faults.clear()
+        preemption.reset()
+        if tx is not None:
+            tx.stop()
+    return fails
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         description="seeded chaos campaign over the serving tier")
@@ -344,6 +611,17 @@ def main(argv=None):
         kinds = [k for k, _ in rec.get("schedule", [])]
         print(f"[campaign] seed {seed:3d} {tag:4s} faults={kinds}",
               flush=True)
+
+    # the transport leg runs in every mode (the --quick invocation IS
+    # the ci_lint --chaos layer, and the gateway contracts are exactly
+    # what CI must hold)
+    print("[campaign] gateway leg: kill/restart/reattach drill ...",
+          flush=True)
+    gw_fails = _gateway_drill(root, cache)
+    failures.extend(gw_fails)
+    records.append({"leg": "gateway", "failures": gw_fails})
+    print(f"[campaign] gateway {'ok' if not gw_fails else 'FAIL'}",
+          flush=True)
 
     report = {"seeds": args.seeds, "quick": bool(args.quick),
               "campaign_seed": args.campaign_seed,

@@ -12,8 +12,14 @@ Exit is nonzero when any job fails, any steady-phase retrace is
 unplanned, or the warm-hit rate is below ``(jobs - 1) / jobs`` (every
 admission after the first must land on the cached program).
 
+``--gateway`` drives the SAME contract through the network boundary
+instead of the in-process API: submissions, result streams and the
+warm-hit scrape all travel HTTP (``serve.gateway`` over
+``serve.wire.HttpTransport``), so the probe proves the transport
+frontend does not cost a single retrace or a warm miss.
+
 Usage: python tools/serve_probe.py [--jobs N] [--niter N] [--slots N]
-       [--chunk N] [--quantum N] [--outdir DIR]
+       [--chunk N] [--quantum N] [--outdir DIR] [--gateway]
 """
 
 from __future__ import annotations
@@ -29,6 +35,117 @@ if __name__ == "__main__":   # script bootstrap; no import side effects
     sys.path.insert(0, ".")
 
 
+def _gateway_probe(args):
+    """Drive the probe's invariants through HTTP: submit via POST
+    /v1/jobs, collect every row via cursor streams, scrape
+    ``warm_hit_rate`` from /v1/metrics, and hold the same bar —
+    all jobs done, zero unplanned retraces, every admission after the
+    first a warm hit."""
+    import urllib.request
+
+    from pulsar_timing_gibbsspec_tpu.analysis.jaxprcheck.entries import (
+        build_model, synthetic_pulsars)
+    from pulsar_timing_gibbsspec_tpu.profiling import recompile_counter
+    from pulsar_timing_gibbsspec_tpu.runtime import telemetry
+    from pulsar_timing_gibbsspec_tpu.serve import BucketTable, probe_shape
+    from pulsar_timing_gibbsspec_tpu.serve.gateway import Gateway
+    from pulsar_timing_gibbsspec_tpu.serve.wire import HttpTransport
+
+    base = Path(args.outdir)
+    if base.exists():
+        shutil.rmtree(base)
+
+    probe_pta = build_model(
+        synthetic_pulsars(args.n_psr, 24, tm_cols=3, seed=0), args.nmodes)
+    table = BucketTable.ladder(args.nmodes, pulsars=(args.n_psr,),
+                               toas=(24 + 6 * args.jobs,),
+                               basis=(probe_shape(probe_pta).basis,))
+
+    def _req(method, path, body=None):
+        data = None if body is None else json.dumps(body).encode()
+        r = urllib.request.Request(f"{burl}{path}", data=data,
+                                   method=method)
+        with urllib.request.urlopen(r, timeout=120) as resp:
+            return resp.read()
+
+    telemetry.reset()
+    rows_by_job, handles = {}, []
+    with recompile_counter() as rc:
+        rc.phase("serve")
+        gw = Gateway(base, table,
+                     svc_kw=dict(slots=args.slots, chunk=args.chunk,
+                                 quantum=args.quantum),
+                     stop_when_idle=True)
+        tx = HttpTransport(gw)
+        tx.start()
+        host, port = tx.address
+        burl = f"http://{host}:{port}"
+        t0 = time.monotonic()
+        # admission needs no scheduler: submit the whole batch first so
+        # idle-stop cannot fire between two submissions
+        for i in range(args.jobs):
+            raw = _req("POST", "/v1/jobs", {
+                "dedupe_key": f"probe{i}", "niter": args.niter,
+                "payload": {"synthetic": {
+                    "n_psr": args.n_psr, "ntoa": 24 + 6 * i,
+                    "tm_cols": 3, "seed": i, "nmodes": args.nmodes}}})
+            handles.append(json.loads(raw))
+        gw.start()
+        for h in handles:
+            jid, cursor, state = h["job_id"], 0, None
+            rows = rows_by_job.setdefault(jid, [])
+            while True:
+                raw = _req("GET", f"/v1/jobs/{jid}/stream"
+                           f"?cursor={cursor}&wait=5")
+                final = False
+                for line in raw.splitlines():
+                    if not line.strip():
+                        continue
+                    ev = json.loads(line)
+                    rows.extend(ev.get("rows") or [])
+                    cursor = max(cursor, int(ev.get("cursor", cursor)))
+                    state = ev.get("state", state)
+                    final = final or bool(ev.get("final"))
+                if final:
+                    break
+            if state != "done":
+                print(f"FAIL: {jid} ended {state!r} over HTTP",
+                      file=sys.stderr)
+                sys.exit(1)
+        wall = time.monotonic() - t0
+        scrape = _req("GET", "/v1/metrics").decode()
+        gw.join(timeout=120)
+        tx.stop()
+
+    warm = None
+    for line in scrape.splitlines():
+        if line.startswith("ptgibbs_warm_hit_rate "):
+            warm = float(line.split()[1])
+    total_rows = sum(len(r) for r in rows_by_job.values())
+    report = {
+        "mode": "gateway",
+        "jobs": {h["job_id"]: {"rows": len(rows_by_job[h["job_id"]]),
+                               "tenant_id": h["tenant_id"]}
+                 for h in handles},
+        "warm_hit_rate": warm,
+        "aggregate_samples_per_s": total_rows / wall if wall else None,
+        "wall_s": wall,
+        "unplanned_serve_retraces": rc.unplanned("serve"),
+        "gateway": gw.report()["state"],
+    }
+    print(json.dumps(report, indent=2))
+
+    ok = (all(len(rows_by_job[h["job_id"]]) == args.niter
+              for h in handles)
+          and rc.unplanned("serve") == 0
+          and warm is not None
+          and warm >= (args.jobs - 1) / args.jobs)
+    if not ok:
+        print("FAIL: serving contract violated through the gateway",
+              file=sys.stderr)
+        sys.exit(1)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--jobs", type=int, default=3,
@@ -42,7 +159,13 @@ def main():
     ap.add_argument("--n-psr", type=int, default=2)
     ap.add_argument("--nmodes", type=int, default=3)
     ap.add_argument("--outdir", default="/tmp/serve_probe")
+    ap.add_argument("--gateway", action="store_true",
+                    help="drive the same assertions through the HTTP "
+                    "gateway instead of the in-process API")
     args = ap.parse_args()
+
+    if args.gateway:
+        return _gateway_probe(args)
 
     from pulsar_timing_gibbsspec_tpu.analysis.jaxprcheck.entries import (
         build_model, synthetic_pulsars)
