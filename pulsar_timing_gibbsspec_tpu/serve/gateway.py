@@ -178,15 +178,26 @@ class Gateway:
         # one reentrant lock for every gateway/service mutation; the
         # condition wakes attached streams after each chunk writeback
         self._cond = threading.Condition(threading.RLock())
+        # journal file I/O (two fsyncs + .bak rotation) happens under
+        # its own lock so neither the scheduler condition nor handler
+        # threads ever wait on disk; generation tags keep concurrent
+        # writers ordered (lock order is always _cond -> _jlock)
+        self._jlock = threading.Lock()
+        self._journal_gen = 0
+        self._journal_written = 0
         self.state = "serving"
+        self.failure = None
         self._thread = None
         self._steps = 0
         self._requests = 0
         self._subs: set[StreamSub] = set()
         self._cold: dict[str, tuple] = {}   # job_id -> (rows, it) from disk
 
-        # journal: dedupe_key -> entry; _by_job is the reverse route
+        # journal: dedupe_key -> entry; _by_job is the reverse route;
+        # _unjournaled tracks bindings created but not yet durable so
+        # a racing replay cannot ACK ahead of the journal write
         self._entries: dict[str, dict] = {}
+        self._unjournaled: set[str] = set()
         self._next_seq = 0
         self._next_tenant = 0
         self._deadlines: dict[str, float] = {}   # job_id -> monotonic
@@ -216,19 +227,34 @@ class Gateway:
         """Persist the routing state: rotate the verified ``.bak`` pair
         first (a kill between the journal replace and the sidecar
         replace must leave a recoverable generation), then primary,
-        then its checksum sidecar."""
-        prim, sha = self.root / JOURNAL, self.root / JOURNAL_SHA
-        if prim.exists() and sha.exists():
-            blob = prim.read_bytes()
-            if hashlib.sha256(blob).hexdigest() == \
-                    sha.read_text().strip():
-                self._write_atomic(JOURNAL_BAK, blob)
-                self._write_atomic(JOURNAL_BAK_SHA,
-                                   sha.read_bytes())
-        blob = self._journal_blob()
-        self._write_atomic(JOURNAL, blob)
-        self._write_atomic(JOURNAL_SHA,
-                           hashlib.sha256(blob).hexdigest().encode())
+        then its checksum sidecar.
+
+        Only the snapshot is taken under the gateway lock; the file
+        I/O (two fsyncs plus the rotation's read-and-verify of the
+        whole entry set) runs under the dedicated journal lock, so the
+        scheduler and every other handler keep moving while a journal
+        lands.  Each snapshot carries a generation tag: a writer that
+        loses the disk race to a NEWER full snapshot skips — its
+        mutation is already durable as part of what landed."""
+        with self._cond:
+            self._journal_gen += 1
+            gen = self._journal_gen
+            blob = self._journal_blob()
+        with self._jlock:
+            if gen <= self._journal_written:
+                return
+            prim, sha = self.root / JOURNAL, self.root / JOURNAL_SHA
+            if prim.exists() and sha.exists():
+                old = prim.read_bytes()
+                if hashlib.sha256(old).hexdigest() == \
+                        sha.read_text().strip():
+                    self._write_atomic(JOURNAL_BAK, old)
+                    self._write_atomic(JOURNAL_BAK_SHA,
+                                       sha.read_bytes())
+            self._write_atomic(JOURNAL, blob)
+            self._write_atomic(JOURNAL_SHA,
+                               hashlib.sha256(blob).hexdigest().encode())
+            self._journal_written = gen
 
     def _verified_journal(self, name, sha_name):
         p, s = self.root / name, self.root / sha_name
@@ -277,10 +303,25 @@ class Gateway:
         checkpoint dir (``Job.try_resume`` restores the verified
         prefix bitwise).  ``done`` entries stay cold — their rows
         stream from disk; ``expired`` entries stay drained (the
-        client's deadline passed; re-running it is not our call)."""
+        client's deadline passed; re-running it is not our call);
+        ``quarantined`` entries stay parked — their manifests carry
+        the quarantine marker and resuming one is an operator decision
+        (``force_requeue``), never a restart default.  A journal still
+        saying ``active`` over a quarantine-marked manifest (the
+        gateway died between the park and the journal sync) defers to
+        the manifest, so one poisoned job can never wedge restarts."""
+        from ..runtime.integrity import CheckpointError, \
+            check_not_quarantined
+
         now = time.time()
         for ent in self._entries.values():
-            if ent.get("state") in ("done", "expired", "failed"):
+            if ent.get("state") in ("done", "expired", "failed",
+                                    "quarantined"):
+                continue
+            try:
+                check_not_quarantined(ent["outdir"])
+            except CheckpointError:
+                ent["state"] = "quarantined"
                 continue
             pta = self._build(ent["payload"])
             job = self.svc.submit(pta, int(ent["niter"]),
@@ -326,12 +367,19 @@ class Gateway:
                 faults.fire("gateway.step", row=self._steps)
                 try:
                     with self._cond:
-                        busy = self.svc.step_supervised()
+                        busy = self.svc.step_supervised(
+                            defer_backoff=True)
+                        backoff = self.svc.take_backoff()
                         changed = self._sync_journal_states()
                         self._cond.notify_all()
                     if changed:
-                        with self._cond:
-                            self._write_journal()
+                        self._write_journal()
+                    if backoff:
+                        # the recovery ladder's deterministic backoff —
+                        # slept here, NOT inside the locked step, so
+                        # handlers keep answering while the service
+                        # waits out a retry
+                        time.sleep(backoff)
                 except preemption.Preempted:
                     self._graceful_drain(residents_drained=True)
                     return
@@ -349,6 +397,35 @@ class Gateway:
             with self._cond:
                 self.state = "stopped"
                 self._cond.notify_all()
+        except Exception as exc:                 # noqa: BLE001
+            # anything the recovery ladder re-raises (exhausted retry
+            # budget, user/unknown-class errors out of a hostile
+            # payload, an unresumable checkpoint): the gateway must
+            # FAIL LOUDLY, never park a dead scheduler behind a live
+            # listener that keeps ACKing work nobody will run
+            self._scheduler_failed(exc)
+
+    def _scheduler_failed(self, exc) -> None:
+        """Terminal scheduler failure: record the cause, settle the
+        journal (jobs the service already failed/quarantined keep that
+        verdict; everything else parks ``drained`` — resumable by a
+        successor from its verified checkpoint), stop the gateway and
+        wake every waiter, so handlers answer typed ``DRAINING`` and
+        attached streams terminate instead of hanging forever."""
+        telemetry.incr("gateway_scheduler_failures")
+        otrace.instant("gateway.scheduler_failure", error=repr(exc))
+        with self._cond:
+            self.failure = repr(exc)
+            self._sync_journal_states()
+            for ent in self._entries.values():
+                if ent.get("state") == "active":
+                    ent["state"] = "drained"
+            self.state = "stopped"
+            self._cond.notify_all()
+        try:
+            self._write_journal()
+        except Exception:                        # noqa: BLE001
+            pass   # best effort: the listener is already refusing work
 
     def _all_settled(self) -> bool:
         """Every journaled job terminal — and at least one exists, so
@@ -371,7 +448,8 @@ class Gateway:
         if not residents_drained and any(self.svc.residents):
             try:
                 with self._cond:
-                    self.svc.step_supervised()   # raises Preempted
+                    # raises Preempted once residents are checkpointed
+                    self.svc.step_supervised(defer_backoff=True)
             except preemption.Preempted:
                 pass
             except Exception:                    # noqa: BLE001
@@ -381,7 +459,8 @@ class Gateway:
             for ent in self._entries.values():
                 if ent.get("state") == "active":
                     ent["state"] = "drained"
-            self._write_journal()
+        self._write_journal()   # durable before the gateway parks
+        with self._cond:
             if self.state == "draining":
                 self.state = "stopped"
             self._cond.notify_all()
@@ -423,8 +502,9 @@ class Gateway:
                     telemetry.incr("deadline_drains")
                     otrace.instant("gateway.deadline_drain", job=jid)
             if due:
-                self._write_journal()
                 self._cond.notify_all()
+        if due:
+            self._write_journal()
 
     # -- request handling ----------------------------------------------------
 
@@ -463,6 +543,7 @@ class Gateway:
         if req.method == "GET" and path == "/v1/healthz":
             with self._cond:
                 body = {"state": self.state,
+                        "failure": self.failure,
                         "jobs": len(self._entries),
                         "queue_depth": len(self.svc.queue),
                         "residents": sum(1 for j in self.svc.residents
@@ -505,49 +586,84 @@ class Gateway:
                                          deadline_s)
         return resp
 
+    def _check_dedupe_locked(self, dedupe, digest, niter):
+        """Replay resolution under the lock: the journaled entry bound
+        to ``dedupe`` (byte-identical replays only), None when the key
+        is fresh, typed refusals otherwise.  Callers hold ``_cond``."""
+        if self.state != "serving":
+            raise WireError(
+                "DRAINING",
+                f"gateway is {self.state}: not accepting work — "
+                "resubmit to a serving instance (your dedupe key "
+                "makes the retry safe)")
+        ent = self._entries.get(dedupe)
+        if ent is None:
+            return None
+        if ent["payload_sha256"] != digest \
+                or int(ent["niter"]) != int(niter):
+            raise WireError(
+                "DEDUPE_MISMATCH",
+                f"dedupe_key {dedupe!r} is bound to a different "
+                "submission (payload digest or niter changed): "
+                "replays must be byte-identical — pick a fresh "
+                "key for new work")
+        return ent
+
+    def _ack(self, ent, dedupe, replayed) -> WireResponse:
+        """The ACK leaves only AFTER the binding is durable.  A fresh
+        binding always journals; a replay journals only when it raced
+        the original submitter's write (the key is still pending) —
+        otherwise the binding already survived at least one snapshot."""
+        if not replayed or dedupe in self._unjournaled:
+            self._write_journal()
+            with self._cond:
+                self._unjournaled.discard(dedupe)
+        if replayed:
+            telemetry.incr("dedupe_hits")
+        with self._cond:
+            return self._handle_body(ent, replayed=replayed)
+
     def _submit_once(self, dedupe, payload, digest, niter,
                      deadline_s) -> WireResponse:
         with self._cond:
-            if self.state != "serving":
-                raise WireError(
-                    "DRAINING",
-                    f"gateway is {self.state}: not accepting work — "
-                    "resubmit to a serving instance (your dedupe key "
-                    "makes the retry safe)")
-            ent = self._entries.get(dedupe)
-            if ent is not None:
-                if ent["payload_sha256"] != digest \
-                        or int(ent["niter"]) != int(niter):
-                    raise WireError(
-                        "DEDUPE_MISMATCH",
-                        f"dedupe_key {dedupe!r} is bound to a different "
-                        "submission (payload digest or niter changed): "
-                        "replays must be byte-identical — pick a fresh "
-                        "key for new work")
-                telemetry.incr("dedupe_hits")
-                return self._handle_body(ent, replayed=True)
-            pta = self._build(payload)
-            job_id = f"g{self._next_seq:05d}"
-            tenant_id = self._next_tenant
-            outdir = self.root / "jobs" / job_id
-            job = self.svc.submit(pta, niter, job_id=job_id,
-                                  tenant_id=tenant_id, outdir=outdir)
-            self._next_seq += 1
-            self._next_tenant += 1
-            ent = {"job_id": job.job_id, "tenant_id": int(tenant_id),
-                   "niter": int(niter), "payload": payload,
-                   "payload_sha256": digest, "outdir": str(outdir),
-                   "dedupe_key": dedupe, "state": "active",
-                   "deadline_unix": (None if deadline_s is None
-                                     else time.time() + deadline_s)}
-            self._entries[dedupe] = ent
-            self._by_job[job.job_id] = ent
-            if deadline_s is not None:
-                self._deadlines[job.job_id] = self._clock() + deadline_s
-            # the binding is durable BEFORE the ACK can be lost
-            self._write_journal()
-            self._cond.notify_all()
-            return self._handle_body(ent, replayed=False)
+            ent = self._check_dedupe_locked(dedupe, digest, niter)
+        if ent is not None:
+            return self._ack(ent, dedupe, replayed=True)
+        # the model build (range-checked, but still array construction
+        # the payload sizes) runs OUTSIDE the gateway lock: one slow
+        # upload must not stall the scheduler or any other handler
+        pta = self._build(payload)
+        with self._cond:
+            # re-check: another handler may have bound this key while
+            # the build ran — the FIRST binding wins, ours is the replay
+            ent = self._check_dedupe_locked(dedupe, digest, niter)
+            if ent is None:
+                job_id = f"g{self._next_seq:05d}"
+                tenant_id = self._next_tenant
+                outdir = self.root / "jobs" / job_id
+                job = self.svc.submit(pta, niter, job_id=job_id,
+                                      tenant_id=tenant_id, outdir=outdir)
+                self._next_seq += 1
+                self._next_tenant += 1
+                ent = {"job_id": job.job_id, "tenant_id": int(tenant_id),
+                       "niter": int(niter), "payload": payload,
+                       "payload_sha256": digest, "outdir": str(outdir),
+                       "dedupe_key": dedupe, "state": "active",
+                       "deadline_unix": (None if deadline_s is None
+                                         else time.time() + deadline_s)}
+                self._entries[dedupe] = ent
+                self._by_job[job.job_id] = ent
+                self._unjournaled.add(dedupe)
+                if deadline_s is not None:
+                    self._deadlines[job.job_id] = \
+                        self._clock() + deadline_s
+                self._cond.notify_all()
+                replayed = False
+            else:
+                replayed = True
+        # the journal file I/O happens off the condition lock: handlers
+        # and the scheduler keep moving while the fsyncs land
+        return self._ack(ent, dedupe, replayed=replayed)
 
     def _handle_body(self, ent, replayed) -> WireResponse:
         it, state, _ = self._progress_locked(ent)
@@ -752,6 +868,7 @@ class Gateway:
         with self._cond:
             return {
                 "state": self.state,
+                "failure": self.failure,
                 "entries": {k: {kk: vv for kk, vv in e.items()
                                 if kk != "payload"}
                             for k, e in self._entries.items()},

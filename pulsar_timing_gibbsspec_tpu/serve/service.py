@@ -165,6 +165,7 @@ class SamplerService:
         self._compile_stalls = 0
         self._next_tenant = 0
         self._retries = 0
+        self._pending_backoff = 0.0
 
         # blast-radius isolation: per-job quarantine budget, per-tenant
         # circuit breakers, service-level admission control, and the
@@ -791,7 +792,7 @@ class SamplerService:
             job.set_state("queued")     # resumable, not failed
         return True
 
-    def step_supervised(self) -> bool:
+    def step_supervised(self, defer_backoff=False) -> bool:
         """One scheduling round under the recovery ladder: runs
         :meth:`step` and absorbs the retryable failure classes the
         supervisor taxonomy allows — device loss evacuates onto the
@@ -802,7 +803,12 @@ class SamplerService:
         raise.  Returns False when there was nothing to run — both
         :meth:`run` and the gateway scheduler thread are thin loops
         over this, so in-process and network-fronted serving share one
-        recovery path."""
+        recovery path.
+
+        ``defer_backoff=True`` parks the retry delay in
+        :meth:`take_backoff` instead of sleeping inline — the gateway
+        steps under its handler-shared condition lock, and a backoff
+        slept there would block every request for its duration."""
         try:
             return self.step()
         except preemption.Preempted:
@@ -821,11 +827,22 @@ class SamplerService:
                 raise
             self._retries += 1
             telemetry.incr("retries")
-            time.sleep(supervisor.backoff_delay(
+            delay = supervisor.backoff_delay(
                 self._retries, base=self.backoff_base, jitter=0.0,
-                seed=self.service_seed))
+                seed=self.service_seed)
+            if defer_backoff:
+                self._pending_backoff = float(delay)
+            else:
+                time.sleep(delay)
             self._revert_residents()
             return True
+
+    def take_backoff(self) -> float:
+        """Read-and-clear the deferred retry delay from the last
+        ``step_supervised(defer_backoff=True)`` round (0.0 when none):
+        the caller sleeps it outside whatever lock it steps under."""
+        delay, self._pending_backoff = self._pending_backoff, 0.0
+        return delay
 
     def run(self) -> dict:
         """Drive every submitted job to done/failed.  Retries

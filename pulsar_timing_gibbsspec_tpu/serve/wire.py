@@ -298,13 +298,25 @@ class HttpTransport:
                 upload bound + 1 so a lying client cannot make the
                 handler buffer an arbitrary body (the +1 byte makes the
                 over-limit case detectable as TOO_LARGE, not silently
-                truncated-and-accepted)."""
+                truncated-and-accepted).
+
+                Reading LESS than Content-Length desyncs HTTP/1.1
+                keep-alive framing — the unread remainder would parse
+                as the start of the next request.  Rather than drain an
+                attacker-chosen number of bytes, the connection closes
+                after the response whenever the declared length exceeds
+                the cap; a malformed Content-Length closes too (the
+                bytes that follow have no trustworthy framing)."""
+                raw = self.headers.get("Content-Length", 0)
                 try:
-                    n = int(self.headers.get("Content-Length", 0))
+                    n = int(raw)
                 except ValueError:
+                    self.close_connection = True
                     return b""
                 n = max(0, n)
                 cap = int(core.max_body) + 1
+                if n > cap:
+                    self.close_connection = True
                 return self.rfile.read(min(n, cap))
 
             def _serve(self, method):

@@ -278,6 +278,124 @@ def test_stream_crossing_refused_after_restart(tmp_path):
                                   {DEDUPE_HEADER: "mine"})).status == 200
 
 
+def test_quarantined_entries_stay_parked_across_restart(tmp_path):
+    """A journal entry in terminal ``quarantined`` state must NOT be
+    readmitted on restart (resuming a parked job is an operator
+    ``force_requeue`` decision), and a journal still saying ``active``
+    over a quarantine-marked manifest — the gateway died between the
+    park and the journal sync — defers to the manifest instead of
+    wedging every subsequent restart on the resume refusal."""
+    from pulsar_timing_gibbsspec_tpu.serve.gateway import Gateway
+
+    gw = Gateway(tmp_path / "gw", _table())
+    parked = _fake_done_entry(tmp_path / "gw", key="kq", job_id="g00000")
+    parked["state"] = "quarantined"
+    stale = _fake_done_entry(tmp_path / "gw", key="ka", job_id="g00001")
+    stale["state"] = "active"
+    outdir = tmp_path / "gw" / "jobs" / "g00001"
+    outdir.mkdir(parents=True)
+    (outdir / "manifest.json").write_text(json.dumps(
+        {"files": {}, "serve": {"state": "quarantined"}}))
+    with gw._cond:
+        gw._entries.update({"kq": parked, "ka": stale})
+        gw._write_journal()
+
+    gw2 = Gateway(tmp_path / "gw", _table())
+    assert gw2.svc.jobs == {}                  # nothing readmitted
+    assert gw2._entries["kq"]["state"] == "quarantined"
+    assert gw2._entries["ka"]["state"] == "quarantined"
+    # the manifest-derived correction is itself durable
+    gw3 = Gateway(tmp_path / "gw", _table())
+    assert gw3._entries["ka"]["state"] == "quarantined"
+
+
+def test_scheduler_failure_stops_gateway_loudly(tmp_path):
+    """An exception escaping the recovery ladder must never leave a
+    dead scheduler behind a live listener: the gateway stops, records
+    the cause, settles the journal (active work parks resumable), and
+    refuses new work with a typed DRAINING."""
+    from pulsar_timing_gibbsspec_tpu.runtime import preemption
+    from pulsar_timing_gibbsspec_tpu.serve.gateway import Gateway
+    from pulsar_timing_gibbsspec_tpu.serve.wire import WireRequest
+
+    preemption.reset()
+    gw = Gateway(tmp_path / "gw", _table())
+    with gw._cond:
+        ent = _fake_done_entry(tmp_path / "gw")
+        ent["state"] = "active"
+        gw._entries["k0"] = ent
+        gw._by_job[ent["job_id"]] = ent
+
+    def boom(defer_backoff=False):  # noqa: ARG001
+        raise RuntimeError("scheduler boom")
+
+    gw.svc.step_supervised = boom
+    gw.start()
+    gw.join(timeout=30)
+    assert not gw.alive()
+    assert gw.state == "stopped"
+    assert "scheduler boom" in gw.failure
+    health = gw.handle(WireRequest("GET", "/v1/healthz", {}, {})).body
+    assert health["state"] == "stopped"
+    assert "scheduler boom" in health["failure"]
+    body = json.dumps({
+        "dedupe_key": "fresh", "niter": 4,
+        "payload": {"synthetic": {}}}).encode()
+    resp = gw.handle(WireRequest("POST", "/v1/jobs", {}, {}, body))
+    assert resp.status == 503 and resp.body["error"] == "DRAINING"
+    # the in-flight entry parked resumable — and durably so
+    assert gw.report()["entries"]["k0"]["state"] == "drained"
+    gw2 = Gateway(tmp_path / "gw", _table())
+    assert gw2._entries["k0"]["state"] in ("active", "drained")
+
+
+def test_oversize_body_closes_keepalive_connection(tmp_path):
+    """A body over the cap on an HTTP/1.1 keep-alive connection must
+    not leave its unread remainder on the socket to be parsed as the
+    next request (connection desync / smuggling): the gateway answers
+    413 and closes; a malformed Content-Length closes too."""
+    import socket
+
+    from pulsar_timing_gibbsspec_tpu.serve.gateway import Gateway
+    from pulsar_timing_gibbsspec_tpu.serve.wire import HttpTransport
+
+    gw = Gateway(tmp_path / "gw", _table(), max_body=256)
+    tr = HttpTransport(gw)
+    tr.start()
+    try:
+        host, port = tr.address
+
+        def _one_closed_exchange(head, body):
+            with socket.create_connection((host, port), timeout=10) as sk:
+                sk.settimeout(10)
+                sk.sendall(head + body)
+                got = b""
+                while True:
+                    chunk = sk.recv(65536)
+                    if not chunk:
+                        break          # server closed: no desync window
+                    got += chunk
+            return got
+
+        smuggled = b"GET /v1/healthz HTTP/1.1\r\nHost: x\r\n\r\n"
+        body = b"x" * 400 + smuggled
+        head = (b"POST /v1/jobs HTTP/1.1\r\nHost: x\r\n"
+                b"Content-Type: application/json\r\n"
+                b"Content-Length: %d\r\n\r\n" % len(body))
+        got = _one_closed_exchange(head, body)
+        assert got.startswith(b"HTTP/1.1 413")
+        # exactly one response: the smuggled tail was never parsed
+        assert got.count(b"HTTP/1.1 ") == 1
+
+        head = (b"POST /v1/jobs HTTP/1.1\r\nHost: x\r\n"
+                b"Content-Length: zzz\r\n\r\n")
+        got = _one_closed_exchange(head, smuggled)
+        assert got.startswith(b"HTTP/1.1 400")
+        assert got.count(b"HTTP/1.1 ") == 1
+    finally:
+        tr.stop()
+
+
 def test_unknown_route_and_job(tmp_path):
     from pulsar_timing_gibbsspec_tpu.serve.gateway import Gateway
     from pulsar_timing_gibbsspec_tpu.serve.wire import WireRequest
