@@ -65,6 +65,9 @@ _MOVEMENT = {
     "bitcast_convert_type",
     "sharding_constraint", "all_gather", "all_to_all", "ppermute",
     "psum", "pbroadcast",
+    # pallas kernel-body primitives: Ref reads/writes and the grid
+    # index are movement/bookkeeping, not arithmetic
+    "get", "swap", "addupdate", "program_id",
 }
 
 
@@ -181,6 +184,21 @@ def jaxpr_cost(jaxpr, _scale: float = 1.0) -> CostReport:
             branches = [jaxpr_cost(b) for b in eqn.params["branches"]]
             if branches:
                 rep._merge(max(branches, key=lambda r: r.flops), 1.0)
+        elif name == "pallas_call":
+            # The kernel body runs once per grid step, so its cost
+            # scales by the grid product (the fused chol kernel has an
+            # empty grid -> x1; the Gram accumulator's grid is the
+            # segment axis -> x nseg; vmap adds the chain axis to the
+            # grid, scaling both).  The body's per-block operand bytes
+            # times the grid steps IS the streamed HBM traffic, so no
+            # separate outer-operand charge (which would double-count
+            # the fused kernel's single round-trip).
+            grid = tuple(getattr(eqn.params.get("grid_mapping"), "grid",
+                                 ()) or ())
+            scale = 1.0
+            for g in grid:
+                scale *= float(g)
+            rep._merge(jaxpr_cost(eqn.params["jaxpr"]), scale)
         else:
             subs = subjaxprs(eqn)
             if subs:                      # pjit / custom_* / remat …

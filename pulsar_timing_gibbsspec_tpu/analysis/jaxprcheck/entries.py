@@ -15,6 +15,10 @@ Entry kinds (the ``entry`` field of a contract):
 - ``chunk`` — a full compiled sweep chunk through the driver
   (:func:`..sampler.jax_backend.sweep_chunk_entry`): key lineage,
   dtype islands, donation.
+- ``kernel_chunk`` — the ``chunk`` entry traced with the fused Pallas
+  kernel tier forced on (``settings.kernel_tier="pallas"``): pins that
+  the fused lowering preserves donation, dtype census and key policy,
+  plus the grid-scaled kernel cost (``crn_kernels``).
 - ``hd_chunk`` — the same chunk under a Hellings-Downs ORF: the
   structured joint b-draw, its two-float kernels and the
   ``joint_mixed`` path (numcheck's ``numerics_hd_joint`` pin).
@@ -108,6 +112,39 @@ def _chunk_entry(spec):
         pta, spec.get("nchains", 4), chunk=spec.get("chunk", 2),
         pad_pulsars=spec.get("pad_pulsars"), seed=spec.get("seed", 0))
     return fn, args, {"driver": drv}
+
+
+def _kernel_chunk_entry(spec):
+    """The ``chunk`` entry traced with ``settings.kernel_tier`` forced
+    to ``"pallas"``: the fused-kernel lowering of the steady sweep
+    (``ops/kernels``), with the b-draw factor chain and the segmented
+    Gram inside ``pallas_call`` bodies.  The contract
+    (``crn_kernels``) pins that fusing changes NOTHING the other
+    audits guard — donation, dtype census (the walkers descend into
+    kernel jaxprs), key-fold policy — and pins the grid-scaled cost.
+    The tier is a trace-time static, so the override wraps the traced
+    function itself (jaxprcheck traces lazily, after this builder
+    returns)."""
+    from ...config import settings
+    from ...sampler import jax_backend as jb
+
+    psrs = synthetic_pulsars(spec.get("n_psr", 3), spec.get("ntoa", 40),
+                             tm_cols=spec.get("tm_cols", 3),
+                             seed=spec.get("seed", 0))
+    pta = build_model(psrs, spec.get("nmodes", 3))
+    fn, args, drv = jb.sweep_chunk_entry(
+        pta, spec.get("nchains", 4), chunk=spec.get("chunk", 2),
+        pad_pulsars=spec.get("pad_pulsars"), seed=spec.get("seed", 0))
+
+    def forced(*a):
+        prev = settings.kernel_tier
+        settings.kernel_tier = "pallas"
+        try:
+            return fn(*a)
+        finally:
+            settings.kernel_tier = prev
+
+    return forced, args, {"driver": drv}
 
 
 def _hd_chunk_entry(spec):
@@ -316,6 +353,7 @@ def _ensemble_chunk_entry(spec):
 
 
 _ENTRIES = {"gram": _gram_entry, "chunk": _chunk_entry,
+            "kernel_chunk": _kernel_chunk_entry,
             "hd_chunk": _hd_chunk_entry,
             "megachunk": _megachunk_entry,
             "obs_chunk": _obs_chunk_entry,

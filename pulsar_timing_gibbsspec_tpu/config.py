@@ -22,6 +22,18 @@ class SettingsError(ValueError):
     configuration mistakes from genuine ValueErrors in model code."""
 
 
+def _env_choice(env: str, default: str, choices: tuple) -> str:
+    """A closed-vocabulary environment override, validated at read time
+    (Settings construction) instead of failing obscurely at the first
+    kernel dispatch."""
+    raw = os.environ.get(env, default)
+    val = str(raw).strip().lower()
+    if val not in choices:
+        raise SettingsError(
+            f"{env}={raw!r} must be one of {sorted(choices)}")
+    return val
+
+
 def _env_int(env: str, default: str) -> int:
     """A positive-integer environment override, validated at read time
     (Settings construction) instead of failing obscurely deep inside a
@@ -112,6 +124,21 @@ class Settings:
     #: regardless of this flag (the breakdown-margin contract).
     joint_mixed: bool = os.environ.get("PTGIBBS_JOINT_MIXED", "1") != "0"
 
+    #: kernel tier of the sweep's hot linear algebra (ops/kernels): the
+    #: fused Pallas/Mosaic chol->solve->sample and segment-streamed Gram
+    #: kernels vs their pure-XLA reference twins.  "auto" (default)
+    #: resolves to "pallas" on a TPU backend when Pallas imports and to
+    #: "xla" everywhere else; an explicit "pallas" off-TPU runs the
+    #: kernels in interpret mode (the CPU parity-test story) and
+    #: degrades to "xla" when Pallas is unavailable.  Resolved from
+    #: static Python at trace time — changing it retraces once, never
+    #: inside the steady loop.  Only the f32 steady bodies ever route to
+    #: Mosaic; the f64/two-float exact bodies are XLA-tier by design
+    #: (docs/PERFORMANCE.md section 9).
+    kernel_tier: str = dataclasses.field(
+        default_factory=lambda: _env_choice(
+            "PTGIBBS_KERNEL_TIER", "auto", ("pallas", "xla", "auto")))
+
     #: mega-chunk factor of the steady loop (sampler/jax_backend): one
     #: device dispatch scans this many chunk_size sub-chunks back to
     #: back, with the carry donated end-to-end — host work per dispatch
@@ -155,6 +182,14 @@ class Settings:
                 raise SettingsError(
                     f"settings.{name}={v!r} must be a positive integer "
                     "(env: PTGIBBS_GRAM_SEG / PTGIBBS_GRAM_SEG_EXACT)")
+        # the kernel tier gates dispatch to compiled accelerator kernels
+        # — a typo'd tier would otherwise fall through a string compare
+        # and silently run the slow path forever
+        kt = self.kernel_tier
+        if not isinstance(kt, str) or kt not in ("pallas", "xla", "auto"):
+            raise SettingsError(
+                f"settings.kernel_tier={kt!r} must be one of "
+                "['auto', 'pallas', 'xla'] (env: PTGIBBS_KERNEL_TIER)")
 
     def apply(self):
         """Push precision into the JAX config.  Called once at model-compile
@@ -182,3 +217,36 @@ class Settings:
 
 
 settings = Settings()
+
+
+#: pinned per-backend dispatch-geometry defaults emitted by
+#: ``tools/autotune.py`` (chunk, megachunk, nchains, gram_seg_len per
+#: backend, selected by measured amortized dispatch cost)
+AUTOTUNE_TABLE = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "AUTOTUNE.json")
+
+
+def autotune_defaults(backend: str | None = None, path: str | None = None):
+    """The pinned autotune row for ``backend`` (default: the current JAX
+    backend), or None when no table/row exists.  Consulted by the driver
+    ONLY when ``PTGIBBS_AUTOTUNE`` is set in the environment — the
+    committed table can never perturb a run that did not opt in."""
+    import json
+
+    path = path or AUTOTUNE_TABLE
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path) as fh:
+            table = json.load(fh)
+    except Exception as e:  # noqa: BLE001 — a torn table is a config error
+        raise SettingsError(f"unreadable autotune table {path}: {e}") from e
+    if backend is None:
+        import jax
+
+        try:
+            backend = jax.default_backend()
+        except Exception:  # noqa: BLE001
+            backend = "cpu"
+    row = (table.get("backends") or {}).get(backend)
+    return dict(row["best"]) if row and row.get("best") else None

@@ -392,6 +392,8 @@ def make_ledger_record(headline: dict, *, source: str, kind: str = "bench",
     only, contract hashes come from the resilience block."""
     rec = {"schema": LEDGER_SCHEMA, "kind": kind, "source": source,
            "ts": time.time() if ts is None else ts}
+    if rec["ts"] is not None:
+        rec["ts_iso"] = _iso_ts(rec["ts"])
     if run:
         rec["run"] = run
     if note:
@@ -415,7 +417,23 @@ def make_ledger_record(headline: dict, *, source: str, kind: str = "bench",
     return rec
 
 
+def _iso_ts(ts: float) -> str:
+    """Host-side ISO-8601 UTC stamp for a ledger epoch ``ts``."""
+    import datetime
+
+    return datetime.datetime.fromtimestamp(
+        float(ts), tz=datetime.timezone.utc
+    ).isoformat(timespec="seconds").replace("+00:00", "Z")
+
+
 def ledger_append(rec: dict, path=None) -> str:
+    """Append one record, stamping the append time when the producer
+    left ``ts`` null/absent (the MULTICHIP snapshot parser used to —
+    a record must always carry a real host-side timestamp)."""
+    if rec.get("ts") is None:
+        rec = dict(rec, ts=time.time())
+    if not rec.get("ts_iso"):
+        rec = dict(rec, ts_iso=_iso_ts(rec["ts"]))
     path = os.fspath(path or ledger_path())
     with open(path, "a") as fh:
         fh.write(json.dumps(rec, sort_keys=True) + "\n")

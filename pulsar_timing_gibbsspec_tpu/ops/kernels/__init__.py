@@ -1,0 +1,144 @@
+"""Kernel tier: fused accelerator kernels with XLA reference twins.
+
+The per-sweep device wall is the b-draw's many-small-matrix chain over
+the ``(C, P, Bmax, Bmax)`` batch — factor, two solves, sample injection
+— plus the segmented Gram.  XLA lowers each stage to its own HBM
+round-trip; the Pallas/Mosaic kernels here run the whole chain out of
+VMEM in one pass:
+
+- :func:`chol_solve_sample` — the fused Jacobi-preconditioned Cholesky
+  -> triangular solves -> N(0, I) sample injection of the b-draw
+  (``ops/linalg.jacobi_factor_mean_prop``'s five outputs) as ONE kernel
+  over the whole per-chain pulsar batch;
+- :func:`gram_accumulate` — the segmented ``tnt_d`` Gram as a
+  grid-streamed accumulate (one VMEM-resident accumulator, one HBM
+  read per segment, no per-segment partial-Gram round-trip).
+
+Every kernel ships with a pure-XLA reference implementation
+(:mod:`.reference`) that the dispatch falls back to, and the Pallas
+body is the SAME traced math applied to the same whole-batch shapes —
+so ``interpret=True`` parity on the CPU container is bitwise in f64
+(tests/test_kernels.py), not merely close.
+
+Dispatch (``Settings.kernel_tier`` / ``PTGIBBS_KERNEL_TIER``):
+
+- ``"xla"`` — always the reference implementations (today's lowering);
+- ``"pallas"`` — the fused kernels, in Mosaic on TPU and in interpret
+  mode elsewhere (the CPU testing story);
+- ``"auto"`` (default) — ``"pallas"`` on a TPU backend when Pallas
+  imports, else ``"xla"``.
+
+Mixed-precision island map: only the f32 STEADY bodies route to Mosaic
+— Mosaic has no f64, so the periodic exact bodies (the widening-f64
+Gram, the two-float ``tf_chol_factor`` refresh) stay on the XLA tier
+by design and the dispatch enforces it (``widen``/``factor="tf"``/f64
+operands fall back unless interpreting).  The tier is resolved from
+static Python at trace time: switching it retraces once, never inside
+the steady loop.
+"""
+
+from __future__ import annotations
+
+from ...config import settings
+from . import reference
+
+_TIERS = ("pallas", "xla", "auto")
+
+
+def pallas_available() -> bool:
+    """Whether the Pallas kernel module imports in this environment."""
+    try:
+        from . import pallas_tpu  # noqa: F401
+    except Exception:  # noqa: BLE001 — any import failure means no tier
+        return False
+    return True
+
+
+def _backend() -> str:
+    import jax
+
+    try:
+        return jax.default_backend()
+    except Exception:  # noqa: BLE001 — backend probe must never raise
+        return "cpu"
+
+
+def interpret_mode() -> bool:
+    """Pallas kernels run in interpret mode off-TPU (the CPU container's
+    parity-test story); Mosaic lowering is TPU-only."""
+    return _backend() != "tpu"
+
+
+def resolve_tier(tier: str | None = None) -> str:
+    """The effective tier: explicit argument > ``settings.kernel_tier``;
+    ``auto`` means Pallas on TPU (when importable) and XLA elsewhere;
+    an explicit ``pallas`` degrades to ``xla`` when Pallas is
+    unavailable (fallback, not failure)."""
+    if tier is None:
+        tier = settings.kernel_tier
+    if tier not in _TIERS:
+        raise ValueError(
+            f"kernel tier {tier!r} must be one of {_TIERS}")
+    if tier == "auto":
+        return ("pallas" if _backend() == "tpu" and pallas_available()
+                else "xla")
+    if tier == "pallas" and not pallas_available():
+        return "xla"
+    return tier
+
+
+def chol_solve_sample(Sig, d, z, *, ridge=0.0, factor="blocked",
+                      tier=None):
+    """Fused batched Cholesky -> solves -> sample injection: the five
+    outputs of ``jacobi_factor_mean_prop`` — ``(L, Li, dj, mean, bp)``
+    with ``bp = mean + dj * Li^T z`` — in one kernel pass over the
+    leading (pulsar) batch.
+
+    ``factor="blocked"`` is the f32/f64 blocked recursion with ``ridge``
+    added to the preconditioned matrix (the steady b-draw proposal);
+    ``factor="tf"`` is the two-float near-f64 factor with ``ridge``
+    riding its f32 stage only (the exact_every refresh) — tf carries
+    emulated-f64 arithmetic, so it is XLA-tier on hardware by design.
+    """
+    t = resolve_tier(tier)
+    if t == "pallas" and factor == "blocked":
+        interp = interpret_mode()
+        if interp or Sig.dtype.name == "float32":
+            from . import pallas_tpu
+
+            return pallas_tpu.chol_solve_sample_pallas(
+                Sig, d, z, ridge=ridge, interpret=interp)
+    return reference.chol_solve_sample_ref(Sig, d, z, ridge=ridge,
+                                           factor=factor)
+
+
+def gram_accumulate(TNa, Ta, *, out_dtype=None, widen=False, tier=None):
+    """Segment-streamed Gram accumulate: ``sum_s TNa[:, s]^T @ Ta[:, s]``
+    over ``(P, nseg, m, B1)`` operands -> ``(P, B1, B1)``.
+
+    ``widen=True`` accumulates each segment's dot directly in
+    ``out_dtype`` (the exact ``tnt_d`` path: f32 products exactly
+    representable in f64); otherwise segments are f32
+    ``precision="highest"`` dots cast to ``out_dtype`` before the
+    segment reduce (``out_dtype=f32`` is the new steady body,
+    ``f64`` the ``tnt_d_seg`` refresh class).  The segment reduce is
+    SEQUENTIAL in both tiers — the grid-accumulator order — so the
+    tiers agree bitwise rather than at reassociation level.
+    """
+    import numpy as np
+
+    if out_dtype is None:
+        out_dtype = TNa.dtype
+    t = resolve_tier(tier)
+    if t == "pallas":
+        interp = interpret_mode()
+        f32 = (np.dtype(TNa.dtype) == np.float32
+               and np.dtype(out_dtype) == np.float32)
+        if interp or (not widen and f32):
+            from . import pallas_tpu
+
+            return pallas_tpu.gram_accumulate_pallas(
+                TNa, Ta, out_dtype=out_dtype, widen=widen,
+                interpret=interp)
+    return reference.gram_accumulate_ref(TNa, Ta, out_dtype=out_dtype,
+                                         widen=widen)

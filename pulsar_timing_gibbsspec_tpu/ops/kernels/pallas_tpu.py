@@ -1,0 +1,90 @@
+"""Pallas/Mosaic fused kernels of the tier (see package docstring).
+
+Both kernels keep the whole per-chain batch resident: the b-draw's
+``(P, Bmax, Bmax)`` factor batch is ~250 KB in f32 at the bench shape
+(45 x 37 x 37) — far under VMEM — so the fused chain runs with ONE HBM
+read of (Sig, d, z) and one write of the five outputs, where the XLA
+lowering round-trips each stage.  The Gram kernel streams the TOA
+segments through a VMEM-resident accumulator: one HBM read per segment
+block, no materialized per-segment partial Grams.
+
+The kernel bodies reuse the exact traced math of the XLA reference
+(``jacobi_factor_mean_prop`` / the reference's per-segment dot) on the
+same whole-batch shapes, which is what makes interpret-mode parity
+bitwise in f64 rather than ULP-close.  ``vmap`` over the chain axis
+composes through ``pallas_call``'s batching rule (the chain axis
+becomes a leading grid dimension).
+
+Off-TPU the kernels run with ``interpret=True`` — correctness is
+provable on the CPU container; Mosaic lowering itself is exercised
+only on hardware.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from ..linalg import jacobi_factor_mean_prop
+from .reference import _segment_dot
+
+
+def chol_solve_sample_pallas(Sig, d, z, *, ridge=0.0, interpret=True):
+    """Fused ``(L, Li, dj, mean, bp)`` over the whole leading batch in
+    one ``pallas_call`` (no grid: the batch is VMEM-resident; ``vmap``
+    adds the chain grid axis)."""
+    dt = Sig.dtype
+
+    def kern(s_ref, d_ref, z_ref, L_ref, Li_ref, dj_ref, m_ref, bp_ref):
+        L, Li, dj, mean, bp = jacobi_factor_mean_prop(
+            s_ref[...], d_ref[...], z_ref[...], ridge=ridge)
+        L_ref[...] = L
+        Li_ref[...] = Li
+        dj_ref[...] = dj
+        m_ref[...] = mean
+        bp_ref[...] = bp
+
+    outs = pl.pallas_call(
+        kern,
+        out_shape=[jax.ShapeDtypeStruct(Sig.shape, dt),
+                   jax.ShapeDtypeStruct(Sig.shape, dt),
+                   jax.ShapeDtypeStruct(d.shape, dt),
+                   jax.ShapeDtypeStruct(d.shape, dt),
+                   jax.ShapeDtypeStruct(d.shape, dt)],
+        interpret=interpret,
+        name="chol_solve_sample",
+    )(Sig, d, z)
+    return tuple(outs)
+
+
+def gram_accumulate_pallas(TNa, Ta, *, out_dtype, widen=False,
+                           interpret=True):
+    """Segment-streamed Gram accumulate: grid over the (sequential)
+    segment axis, whole-pulsar blocks, one VMEM accumulator."""
+    P, nseg, m, B1 = TNa.shape
+
+    def kern(a_ref, b_ref, o_ref):
+        s = pl.program_id(0)
+        # a_ref/b_ref blocks are (P, 1, m, B1); [:, 0] matches the
+        # reference's per-segment (P, m, B1) dot shape exactly
+        part = _segment_dot(a_ref[...], b_ref[...], 0, out_dtype, widen)
+
+        @pl.when(s == 0)
+        def _init():
+            o_ref[...] = part
+
+        @pl.when(s != 0)
+        def _accumulate():
+            o_ref[...] = o_ref[...] + part
+
+    return pl.pallas_call(
+        kern,
+        grid=(nseg,),
+        in_specs=[pl.BlockSpec((P, 1, m, B1), lambda s: (0, s, 0, 0)),
+                  pl.BlockSpec((P, 1, m, B1), lambda s: (0, s, 0, 0))],
+        out_specs=pl.BlockSpec((P, B1, B1), lambda s: (0, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((P, B1, B1), jnp.dtype(out_dtype)),
+        interpret=interpret,
+        name="gram_accumulate",
+    )(TNa, Ta)

@@ -1,0 +1,68 @@
+"""Pure-XLA reference implementations of the fused kernel tier.
+
+These are the ``kernel_tier="xla"`` production paths AND the parity
+oracles the Pallas kernels are tested against.  Two disciplines keep
+the tiers bitwise-comparable in f64 interpret mode (tests/
+test_kernels.py):
+
+- the Pallas kernel bodies call the SAME traced math on the SAME
+  whole-batch shapes (a per-tile kernel would reassociate the batched
+  dots at the 1-2 ULP level — measured — so the tier boundary is drawn
+  at the batch, not the matrix);
+- the segment reduce of :func:`gram_accumulate_ref` is SEQUENTIAL
+  (unrolled left-to-right adds), matching the grid-accumulator order of
+  the Pallas kernel instead of ``jnp.sum``'s reassociated reduce.  For
+  the f64-accumulated paths this is a pure f64 reassociation of the
+  previous ``jnp.sum`` order (the class already documented on
+  ``tnt_d``), bitwise when ``nseg == 1``.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..linalg import jacobi_factor_mean_prop, tf_chol_factor
+
+
+def chol_solve_sample_ref(Sig, d, z, *, ridge=0.0, factor="blocked"):
+    """The unfused lowering of the b-draw factor chain: Jacobi
+    preconditioning, blocked (or two-float) factorization, the fused
+    mean/sample 2-column solve.  Returns ``(L, Li, dj, mean, bp)``.
+
+    ``factor="blocked"``: ``ridge`` is added to the preconditioned
+    matrix (the steady proposal's breakdown guard).  ``factor="tf"``:
+    ``ridge`` rides ``tf_chol_factor``'s f32 stage only and is removed
+    by its two-float congruence correction (the refresh contract)."""
+    if factor == "tf":
+        return jacobi_factor_mean_prop(
+            Sig, d, z, factor=lambda A: tf_chol_factor(A, ridge=ridge))
+    if factor != "blocked":
+        raise ValueError(
+            f"factor={factor!r} must be 'blocked' or 'tf'")
+    return jacobi_factor_mean_prop(Sig, d, z, ridge=ridge)
+
+
+def _segment_dot(TNa, Ta, s, out_dtype, widen):
+    """One segment's partial Gram, in the dtype discipline of the
+    calling path (widening-f64 exact dot vs f32 MXU dot cast to the
+    reduce dtype)."""
+    if widen:
+        return jnp.einsum("pnb,pnc->pbc", TNa[:, s], Ta[:, s],
+                          preferred_element_type=out_dtype)
+    part = jnp.einsum("pnb,pnc->pbc", TNa[:, s], Ta[:, s],
+                      precision="highest")
+    return part.astype(out_dtype)
+
+
+def gram_accumulate_ref(TNa, Ta, *, out_dtype=None, widen=False):
+    """Sequential-segment Gram accumulate over ``(P, nseg, m, B1)``
+    operands -> ``(P, B1, B1)``; the XLA twin of the Pallas grid
+    accumulator (same per-segment dot shapes, same left-to-right
+    reduce order)."""
+    if out_dtype is None:
+        out_dtype = TNa.dtype
+    nseg = TNa.shape[1]
+    acc = _segment_dot(TNa, Ta, 0, out_dtype, widen)
+    for s in range(1, nseg):
+        acc = acc + _segment_dot(TNa, Ta, s, out_dtype, widen)
+    return acc

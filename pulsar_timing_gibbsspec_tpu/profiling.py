@@ -253,10 +253,7 @@ def _block_bodies(driver, x, b):
 
         def gram1(x1, b1, k1):
             N = cm.ndiag_fast(x1)
-            TN = cm.T / N[:, :, None]
-            TNT = jnp.einsum("pnb,pnc->pbc", TN, cm.T,
-                             preferred_element_type=cm.dtype,
-                             precision="highest")
+            TNT, _d = jb.tnt_d_seg32(cm, N)
             return x1, b1 + 0.0 * TNT[:, : b1.shape[1], 0]
 
         out["gram32"] = vm(gram1)

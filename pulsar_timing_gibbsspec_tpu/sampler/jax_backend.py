@@ -46,6 +46,7 @@ from typing import NamedTuple
 
 import numpy as np
 
+from .. import config as config_mod
 from ..config import settings
 from ..obs import trace as otrace
 from ..ops.acf import integrated_act
@@ -73,6 +74,26 @@ DE_DELAY = 256
 # ===========================================================================
 # pure kernels (module-level so __graft_entry__ / parallel can reuse them)
 # ===========================================================================
+
+def _gram_operands(cm: CompiledPTA, Nvec, seg_len):
+    """Segment operands of the fused augmented Gram: ``Ta = [T | y]``
+    and ``TNa = Ta / N`` split into ``nseg`` equal TOA segments, both
+    ``(P, nseg, m, B1)``.  Pads: extra zero TOA rows with unit noise
+    contribute exactly zero to every segment."""
+    import jax.numpy as jnp
+
+    Ta = jnp.concatenate([jnp.asarray(cm.T, cm.dtype),
+                          jnp.asarray(cm.y, cm.dtype)[:, :, None]], axis=2)
+    TNa = Ta / Nvec.astype(cm.dtype)[:, :, None]
+    P, N, B1 = Ta.shape
+    nseg = max(1, -(-N // seg_len))
+    m = -(-N // nseg)
+    if nseg * m != N:
+        pad = nseg * m - N
+        Ta = jnp.pad(Ta, ((0, 0), (0, pad), (0, 0)))
+        TNa = jnp.pad(TNa, ((0, 0), (0, pad), (0, 0)))
+    return TNa.reshape(P, nseg, m, B1), Ta.reshape(P, nseg, m, B1)
+
 
 def tnt_d(cm: CompiledPTA, Nvec, seg_len=None):
     """``TNT = T^T N^-1 T`` and ``d = T^T N^-1 y`` batched over pulsars
@@ -103,7 +124,10 @@ def tnt_d(cm: CompiledPTA, Nvec, seg_len=None):
     Summation order (documented because it defines the exact oracle's
     bitstream): TOAs accumulate inside each segment's f64 dot
     accumulator, then the per-segment partial Grams reduce over the
-    segment axis in f64.  Relative to the monolithic single-dot
+    segment axis in f64, SEQUENTIALLY left-to-right — the kernel tier's
+    grid-accumulator order (ops/kernels), shared by both tiers so the
+    fused Pallas kernel and this XLA path agree bitwise rather than at
+    reassociation level.  Relative to the monolithic single-dot
     accumulation this is a pure f64 REASSOCIATION — same exact products,
     different partial-sum grouping — so the two agree at the f64
     rounding class: within a few ULP at the Jacobi scale
@@ -115,24 +139,12 @@ def tnt_d(cm: CompiledPTA, Nvec, seg_len=None):
     (tests/test_jax_backend.py::test_tnt_d_segmented_parity).  Pads:
     extra zero TOA rows with unit noise contribute exactly zero to
     every segment."""
-    import jax.numpy as jnp
+    from ..ops import kernels
 
     if seg_len is None:
         seg_len = settings.gram_seg_len_exact
-    Ta = jnp.concatenate([jnp.asarray(cm.T, cm.dtype),
-                          jnp.asarray(cm.y, cm.dtype)[:, :, None]], axis=2)
-    TNa = Ta / Nvec.astype(cm.dtype)[:, :, None]
-    P, N, B1 = Ta.shape
-    nseg = max(1, -(-N // seg_len))
-    m = -(-N // nseg)
-    if nseg * m != N:
-        pad = nseg * m - N
-        Ta = jnp.pad(Ta, ((0, 0), (0, pad), (0, 0)))
-        TNa = jnp.pad(TNa, ((0, 0), (0, pad), (0, 0)))
-    G = jnp.einsum("psnb,psnc->psbc", TNa.reshape(P, nseg, m, B1),
-                   Ta.reshape(P, nseg, m, B1),
-                   preferred_element_type=cm.cdtype)
-    G = jnp.sum(G, axis=1)
+    TNa, Ta = _gram_operands(cm, Nvec, seg_len)
+    G = kernels.gram_accumulate(TNa, Ta, out_dtype=cm.cdtype, widen=True)
     return G[:, :cm.Bmax, :cm.Bmax], G[:, :cm.Bmax, cm.Bmax]
 
 
@@ -160,27 +172,43 @@ def tnt_d_seg(cm: CompiledPTA, Nvec, seg_len=None):
 
     Segment length: ``settings.gram_seg_len`` (env ``PTGIBBS_GRAM_SEG``),
     with the error-model constants documented on the setting."""
-    import jax.numpy as jnp
+    from ..ops import kernels
 
     if seg_len is None:
         seg_len = settings.gram_seg_len
-    Ta = jnp.concatenate([jnp.asarray(cm.T, cm.dtype),
-                          jnp.asarray(cm.y, cm.dtype)[:, :, None]], axis=2)
-    TNa = Ta / Nvec.astype(cm.dtype)[:, :, None]
-    P, N, B1 = Ta.shape
-    nseg = max(1, -(-N // seg_len))
-    m = -(-N // nseg)
-    if nseg * m != N:
-        pad = nseg * m - N
-        Ta = jnp.pad(Ta, ((0, 0), (0, pad), (0, 0)))
-        TNa = jnp.pad(TNa, ((0, 0), (0, pad), (0, 0)))
-    # output order psbc (segment axis where the operands carry it): the
-    # spbc form made XLA materialize a transposed operand copy scratch
-    # of (nseg, C, P, Nmax, B1) — tiling-padded 3.4x, 15.8 GB at C=128,
-    # THE out-of-memory term of wide-chain compiles
-    G32 = jnp.einsum("psnb,psnc->psbc", TNa.reshape(P, nseg, m, B1),
-                     Ta.reshape(P, nseg, m, B1), precision="highest")
-    G = jnp.sum(G32.astype(cm.cdtype), axis=1)
+    TNa, Ta = _gram_operands(cm, Nvec, seg_len)
+    # per-segment f32 MXU dots reduced sequentially in f64 through the
+    # kernel tier: segments ride the operand batch axis (the spbc form
+    # made XLA materialize a transposed operand copy scratch of
+    # (nseg, C, P, Nmax, B1) — tiling-padded 3.4x, 15.8 GB at C=128,
+    # THE out-of-memory term of wide-chain compiles) and the bounded
+    # per-segment dot keeps that scratch collapsed to one segment
+    G = kernels.gram_accumulate(TNa, Ta, out_dtype=cm.cdtype, widen=False)
+    return G[:, :cm.Bmax, :cm.Bmax], G[:, :cm.Bmax, cm.Bmax]
+
+
+def tnt_d_seg32(cm: CompiledPTA, Nvec, seg_len=None):
+    """All-f32 steady Gram: the same segmented quantities as
+    :func:`tnt_d_seg` with the segment reduce ALSO in f32 — the
+    PR 3 mixed-precision pattern extended to the CRN steady body.
+
+    Error model: the f32 segment reduce adds ~sqrt(nseg)*eps_f32 of the
+    Jacobi scale on top of :func:`tnt_d_seg`'s in-segment
+    ~sqrt(seg_len)*eps_f32 — the same class as the monolithic f32 Gram
+    this replaces in :func:`draw_b_mh` (and usually smaller: the
+    monolithic dot accumulated all N TOAs in one f32 chain).  The
+    consumer is a Metropolised PROPOSAL, so this error only prices
+    acceptance; stationarity stays exact (the N4 steady/exact pair,
+    contracts/numerics_crn.json).  Routed through the kernel tier
+    (ops/kernels): under ``kernel_tier="pallas"`` the whole accumulate
+    is one segment-streamed Mosaic kernel — f32 end to end, the tier's
+    steady-body island."""
+    from ..ops import kernels
+
+    if seg_len is None:
+        seg_len = settings.gram_seg_len
+    TNa, Ta = _gram_operands(cm, Nvec, seg_len)
+    G = kernels.gram_accumulate(TNa, Ta, out_dtype=cm.dtype, widen=False)
     return G[:, :cm.Bmax, :cm.Bmax], G[:, :cm.Bmax, cm.Bmax]
 
 
@@ -2049,7 +2077,7 @@ def draw_b_mh(cm: CompiledPTA, x, b, u, key, beta=None):
     import jax.numpy as jnp
     import jax.random as jr
 
-    from ..ops.linalg import jacobi_factor_mean_prop
+    from ..ops import kernels
 
     fdt = cm.dtype
     k1, k2 = jr.split(key)
@@ -2062,11 +2090,12 @@ def draw_b_mh(cm: CompiledPTA, x, b, u, key, beta=None):
         # tempered conditional: L^beta is Gaussian with N -> N / beta,
         # which scales TNT and d below in one place (prior untempered)
         N = N / beta.astype(N.dtype)
-    TN = cm.T / N[:, :, None]
-    TNT = jnp.einsum("pnb,pnc->pbc", TN, cm.T,
-                     preferred_element_type=fdt, precision="highest")
-    d = jnp.einsum("pnb,pn->pb", TN, cm.y, preferred_element_type=fdt,
-                   precision="highest")
+    # all-f32 segmented augmented Gram through the kernel tier: TNT and
+    # d from one fused accumulate (tnt_d_seg32) instead of the old
+    # monolithic pair of einsums — same f32 proposal error class,
+    # bounded per-segment dots, and one Mosaic kernel under
+    # kernel_tier="pallas"
+    TNT, d = tnt_d_seg32(cm, N)
     phi32 = cm.phi(x, dtype=fdt)
     eye = jnp.eye(cm.Bmax, dtype=fdt)
     Sig = TNT + (1.0 / phi32)[:, :, None] * eye
@@ -2075,12 +2104,14 @@ def draw_b_mh(cm: CompiledPTA, x, b, u, key, beta=None):
     # small-slice loops on TPU and cost 12.6 ms at the (64, 45, 37, 37)
     # bench shape vs 2.1 ms for blocked_chol_inv + matvecs
     # (tools/chol_probe.py) — 75% of the whole steady sweep was this
-    # lowering (tools/sweep_probe.py: b_mh 13.5 ms of full_sweep 17.9);
-    # the _prop variant fuses the mean and sample-square-root matvecs
-    # into one 2-column batched matmul
+    # lowering (tools/sweep_probe.py: b_mh 13.5 ms of full_sweep 17.9).
+    # The fused chol->solve->sample kernel (ops/kernels) runs the
+    # factor, both triangular solves, and the N(0, I) injection in one
+    # VMEM-resident pass — one HBM round-trip instead of four; the XLA
+    # tier is the identical jacobi_factor_mean_prop lowering as before
     z = jr.normal(k1, (cm.P, cm.Bmax), fdt)
-    L, Li, dj, mean, bp32 = jacobi_factor_mean_prop(Sig, d, z,
-                                                    ridge=_PROP_RIDGE)
+    L, Li, dj, mean, bp32 = kernels.chol_solve_sample(Sig, d, z,
+                                                      ridge=_PROP_RIDGE)
     bp = bp32.astype(cm.cdtype)
     up = b_matvec(cm, bp)
     # ---- exact log-density ratio + proposal correction --------------------
@@ -2126,8 +2157,8 @@ def draw_b_refresh(cm: CompiledPTA, x, b, u, key, beta=None):
     import jax.numpy as jnp
     import jax.random as jr
 
-    from ..ops.linalg import (_batched_diag, jacobi_factor_mean_prop,
-                              tf_chol_factor)
+    from ..ops import kernels
+    from ..ops.linalg import _batched_diag
 
     cdt = cm.cdtype
     k1, k2 = jr.split(key)
@@ -2138,13 +2169,14 @@ def draw_b_refresh(cm: CompiledPTA, x, b, u, key, beta=None):
     TNT, d = tnt_d_seg(cm, N)
     phi = cm.phi(x)
     Sig = TNT + _batched_diag(1.0 / phi)
-    # tf_chol_factor applies _PROP_RIDGE to its f32 stage only and
-    # removes the distortion in the two-float correction — so the ridge
-    # rides the factor, not the helper; the _prop variant fuses the mean
-    # and sample-square-root matvecs into one 2-column batched matmul
+    # factor="tf": tf_chol_factor applies _PROP_RIDGE to its f32 stage
+    # only and removes the distortion in the two-float correction — so
+    # the ridge rides the factor, not the helper.  The exact body stays
+    # on the kernel tier's XLA path by design (Mosaic has no f64; the
+    # mixed-precision island map puts only f32 steady bodies in Pallas)
     z = jr.normal(k1, (cm.P, cm.Bmax), cdt)
-    L, Li, dj, mean, bp = jacobi_factor_mean_prop(
-        Sig, d, z, factor=lambda A: tf_chol_factor(A, ridge=_PROP_RIDGE))
+    L, Li, dj, mean, bp = kernels.chol_solve_sample(
+        Sig, d, z, ridge=_PROP_RIDGE, factor="tf")
     up = b_matvec(cm, bp)
     lpi_old, lpi_new = _logpi_b_pair(cm, x, b, bp, u, up, beta=beta)
     w_old = jnp.einsum("pji,pj->pi", L, (b - mean) / dj)
@@ -2234,13 +2266,24 @@ class JaxGibbsDriver:
         self.white_adapt_iters = white_adapt_iters
         self.red_adapt_iters = red_adapt_iters
         self.red_steps = red_steps
-        self.chunk_size = chunk_size or settings.chunk_size
+        #: pinned autotune defaults (tools/autotune.py -> AUTOTUNE.json):
+        #: consulted only under PTGIBBS_AUTOTUNE, and only for dispatch
+        #: geometry the caller left unset — never overriding an explicit
+        #: chunk_size/megachunk argument, and never touching the sampled
+        #: process (every geometry is bitwise-identical by the key-fold
+        #: policy; the table tunes amortization only)
+        tuned = (config_mod.autotune_defaults()
+                 if os.environ.get("PTGIBBS_AUTOTUNE") else None) or {}
+        self.chunk_size = (chunk_size or tuned.get("chunk")
+                           or settings.chunk_size)
         #: mega-chunk factor: sub-chunks scanned back to back inside ONE
         #: device dispatch (the device-resident steady loop).  The outer
         #: scan re-selects the DE history buffers per sub-chunk, so each
         #: sub sees exactly the history the legacy chunk grid would —
         #: the sampled process is bitwise-identical for every value.
         #: 1 (default) is the legacy one-chunk-per-dispatch loop.
+        if megachunk is None and tuned.get("megachunk"):
+            megachunk = tuned["megachunk"]
         self.megachunk = int(settings.megachunk if megachunk is None
                              else megachunk)
         if self.megachunk < 1:

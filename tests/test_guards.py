@@ -194,3 +194,44 @@ def test_settings_validates_gram_seg_env_overrides(monkeypatch):
     monkeypatch.setenv("PTGIBBS_GRAM_SEG_EXACT", "-1")
     with pytest.raises(SettingsError, match="positive"):
         Settings()
+
+
+# ---------------------------------------------------------------------------
+# settings validation: the kernel tier
+# ---------------------------------------------------------------------------
+
+def test_settings_rejects_bad_kernel_tier():
+    from pulsar_timing_gibbsspec_tpu.config import Settings, SettingsError
+
+    for ok in ("pallas", "xla", "auto"):
+        assert Settings(kernel_tier=ok).kernel_tier == ok
+    for bad in ("mosaic", "", "XLA!", 1, True, None):
+        with pytest.raises(SettingsError, match="kernel_tier"):
+            Settings(kernel_tier=bad)
+
+
+def test_settings_validates_kernel_tier_env_override(monkeypatch):
+    from pulsar_timing_gibbsspec_tpu.config import Settings, SettingsError
+
+    assert Settings().kernel_tier == "auto"          # default
+    monkeypatch.setenv("PTGIBBS_KERNEL_TIER", "pallas")
+    assert Settings().kernel_tier == "pallas"
+    monkeypatch.setenv("PTGIBBS_KERNEL_TIER", " XLA ")
+    assert Settings().kernel_tier == "xla"           # normalized
+    monkeypatch.setenv("PTGIBBS_KERNEL_TIER", "tpu")
+    with pytest.raises(SettingsError, match="must be one of"):
+        Settings()
+
+
+def test_auto_tier_resolves_to_xla_off_tpu():
+    """The dispatch resolution the default tier lands on in this CPU
+    container — Mosaic is TPU-only, so "auto" must mean the reference
+    lowering here, and an explicit "pallas" is honored only when the
+    Pallas module imports (fallback, not failure)."""
+    import jax
+
+    from pulsar_timing_gibbsspec_tpu.ops import kernels
+
+    assert jax.default_backend() != "tpu"
+    assert kernels.resolve_tier("auto") == "xla"
+    assert kernels.resolve_tier("pallas") in ("pallas", "xla")

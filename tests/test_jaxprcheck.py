@@ -346,20 +346,21 @@ def test_violation_surface_matches_baseline_ratchet():
 
 
 def test_bench_contract_c128_passes_via_segmented_gram():
-    """Acceptance, inverted from the r4 era: the segmented exact tnt_d
+    """Acceptance, inverted from the r4 era: the segmented exact Gram
     bounds the widening dot's contraction at one seg_len segment, so
-    the C=128 config now fits — 2.26 GiB of tnt_d scratch (one
-    tile-padded operand copy) against the former 15.82 GiB (8 such
-    copies), under the 15.75 GiB budget.  The scratch pin keeps naming
-    tnt_d so a refactor that silently reverts to the monolithic
-    contraction fails calibration before it OOMs hardware."""
+    the C=128 config now fits — 270 MiB of per-segment scratch (one
+    tile-padded segment operand, down from 2.11 GiB when tnt_d held the
+    whole-model operand and 15.82 GiB in the monolithic r4 lowering),
+    under the 15.75 GiB budget.  The scratch pin names the kernel
+    tier's _segment_dot so a refactor that silently reverts to the
+    monolithic contraction fails calibration before it OOMs hardware."""
     c = runner.load_contract(runner.CONTRACT_DIR / "crn_bench_c128.json")
     violations, facts = runner.run_contract(c)
     assert violations == [], [str(x) for x in violations]
     hbm = facts["hbm"]
     assert hbm["estimate_bytes"] <= 16_911_433_728      # under 15.75 GiB
-    assert hbm["scratch"]["source_fn"] == "tnt_d"
-    assert hbm["scratch"]["bytes"] == 2_264_924_160     # 2.11 GiB
+    assert hbm["scratch"]["source_fn"] == "_segment_dot"
+    assert hbm["scratch"]["bytes"] == 283_115_520       # 270 MiB
 
 
 @pytest.mark.slow

@@ -1,0 +1,308 @@
+"""Kernel tier (ops/kernels): fused kernels vs their XLA reference twins.
+
+The parity contract is BITWISE, not approximate: the Pallas kernel
+bodies run the same traced math on the same whole-batch shapes as the
+reference implementations, and the Gram grid-accumulator shares the
+reference's sequential left-to-right segment reduce — so in interpret
+mode on this CPU container the tiers must agree to the last bit in BOTH
+f64 and f32.  Anything weaker (a per-tile kernel, a reassociated
+reduce) shows up here as a 1-2 ULP drift long before it reaches
+hardware.  On top of parity: dispatch/fallback rules, the mixed-
+precision island map (f64/tf bodies never route to Mosaic on hardware),
+same-key tier agreement of the full Metropolised b-draw, and the
+zero-retrace contract with the tier enabled.
+"""
+
+import contextlib
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import jax.random as jr
+
+from pulsar_timing_gibbsspec_tpu.analysis.jaxprcheck.entries import (
+    build_model, synthetic_pulsars)
+from pulsar_timing_gibbsspec_tpu.config import settings
+from pulsar_timing_gibbsspec_tpu.ops import kernels
+from pulsar_timing_gibbsspec_tpu.ops.kernels import reference
+
+# f64 parity cases need x64 before the first traced op (normally
+# settings.apply() runs at model-compile entry)
+settings.apply()
+
+pytestmark = pytest.mark.pallas
+
+needs_pallas = pytest.mark.skipif(
+    not kernels.pallas_available(),
+    reason="Pallas does not import in this environment")
+
+
+@contextlib.contextmanager
+def _tier(tier):
+    prev = settings.kernel_tier
+    settings.kernel_tier = tier
+    try:
+        yield
+    finally:
+        settings.kernel_tier = prev
+
+
+def _spd_batch(P, B, dtype, seed=0):
+    rng = np.random.default_rng(seed)
+    M = rng.standard_normal((P, B, B))
+    A = np.einsum("pij,pkj->pik", M, M) + B * np.eye(B)
+    return jnp.asarray(A, dtype)
+
+
+def _gram_operands(P, nseg, m, B1, seed=1):
+    rng = np.random.default_rng(seed)
+    TNa = jnp.asarray(rng.standard_normal((P, nseg, m, B1)), jnp.float32)
+    Ta = jnp.asarray(rng.standard_normal((P, nseg, m, B1)), jnp.float32)
+    return TNa, Ta
+
+
+# ---------------------------------------------------------------------------
+# dispatch: tier resolution, fallback, island map
+
+
+def test_resolve_tier_rules():
+    assert kernels.resolve_tier("xla") == "xla"
+    # this container is CPU-only: auto must resolve to the XLA tier
+    # (Mosaic is TPU-only; interpret mode is a testing story, not a
+    # production auto choice)
+    assert jax.default_backend() != "tpu"
+    assert kernels.resolve_tier("auto") == "xla"
+    expected = "pallas" if kernels.pallas_available() else "xla"
+    assert kernels.resolve_tier("pallas") == expected
+    with pytest.raises(ValueError, match="kernel tier"):
+        kernels.resolve_tier("mosaic")
+    # no explicit argument: settings.kernel_tier decides
+    with _tier("xla"):
+        assert kernels.resolve_tier() == "xla"
+    with _tier("auto"):
+        assert kernels.resolve_tier() == "xla"
+
+
+def test_interpret_mode_off_tpu():
+    assert kernels.interpret_mode() is (jax.default_backend() != "tpu")
+
+
+def test_xla_tier_is_the_reference_lowering():
+    """tier="xla" must be jacobi_factor_mean_prop verbatim — the kernel
+    layer adds dispatch, never a different lowering."""
+    from pulsar_timing_gibbsspec_tpu.ops.linalg import \
+        jacobi_factor_mean_prop
+
+    Sig = _spd_batch(4, 7, jnp.float32)
+    rng = np.random.default_rng(2)
+    d = jnp.asarray(rng.standard_normal((4, 7)), jnp.float32)
+    z = jnp.asarray(rng.standard_normal((4, 7)), jnp.float32)
+    got = kernels.chol_solve_sample(Sig, d, z, ridge=1e-6, tier="xla")
+    want = jacobi_factor_mean_prop(Sig, d, z, ridge=1e-6)
+    for g, w in zip(got, want):
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(w))
+
+
+@needs_pallas
+def test_tf_factor_never_routes_to_pallas():
+    """factor="tf" carries emulated-f64 arithmetic — XLA-tier by design
+    even under tier="pallas" on hardware; here (interpret mode) both
+    paths must still produce the reference tf chain bitwise."""
+    Sig = _spd_batch(3, 6, jnp.float64)
+    rng = np.random.default_rng(3)
+    d = jnp.asarray(rng.standard_normal((3, 6)), jnp.float64)
+    z = jnp.asarray(rng.standard_normal((3, 6)), jnp.float64)
+    got = kernels.chol_solve_sample(Sig, d, z, ridge=1e-6, factor="tf",
+                                    tier="pallas")
+    want = reference.chol_solve_sample_ref(Sig, d, z, ridge=1e-6,
+                                           factor="tf")
+    for g, w in zip(got, want):
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(w))
+
+
+def test_chol_solve_sample_rejects_unknown_factor():
+    Sig = _spd_batch(2, 4, jnp.float32)
+    d = jnp.zeros((2, 4), jnp.float32)
+    with pytest.raises(ValueError, match="factor"):
+        kernels.chol_solve_sample(Sig, d, d, factor="qr", tier="xla")
+
+
+# ---------------------------------------------------------------------------
+# interpret-mode parity: bitwise in f64 AND f32, jitted both sides
+
+
+@needs_pallas
+@pytest.mark.parametrize("dtype", ["float64", "float32"])
+def test_chol_solve_sample_parity_bitwise(dtype):
+    dt = jnp.dtype(dtype)
+    Sig = _spd_batch(5, 9, dt, seed=4)
+    rng = np.random.default_rng(5)
+    d = jnp.asarray(rng.standard_normal((5, 9)), dt)
+    z = jnp.asarray(rng.standard_normal((5, 9)), dt)
+    f_p = jax.jit(lambda S, dd, zz: kernels.chol_solve_sample(
+        S, dd, zz, ridge=1e-6, tier="pallas"))
+    f_x = jax.jit(lambda S, dd, zz: kernels.chol_solve_sample(
+        S, dd, zz, ridge=1e-6, tier="xla"))
+    for g, w in zip(f_p(Sig, d, z), f_x(Sig, d, z)):
+        assert g.dtype == w.dtype == dt
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(w))
+
+
+@needs_pallas
+@pytest.mark.parametrize("widen,out_dtype", [
+    (True, "float64"),      # the exact tnt_d widening accumulate
+    (False, "float64"),     # the tnt_d_seg refresh class
+    (False, "float32"),     # the tnt_d_seg32 steady body
+])
+def test_gram_accumulate_parity_bitwise(widen, out_dtype):
+    TNa, Ta = _gram_operands(3, 4, 8, 7)
+    dt = jnp.dtype(out_dtype)
+    f_p = jax.jit(lambda a, b: kernels.gram_accumulate(
+        a, b, out_dtype=dt, widen=widen, tier="pallas"))
+    f_x = jax.jit(lambda a, b: kernels.gram_accumulate(
+        a, b, out_dtype=dt, widen=widen, tier="xla"))
+    g, w = f_p(TNa, Ta), f_x(TNa, Ta)
+    assert g.dtype == w.dtype == dt
+    assert g.shape == (3, 7, 7)
+    np.testing.assert_array_equal(np.asarray(g), np.asarray(w))
+
+
+@needs_pallas
+@pytest.mark.parametrize("dtype", ["float64", "float32"])
+def test_vmap_parity(dtype):
+    """vmap over the chain axis (the production shape: the grid gains a
+    leading dim).  The factor/solve outputs stay bitwise; only the
+    final sample injection ``mean + dj * Li^T z`` moves by one ULP —
+    XLA lowers that einsum differently once it carries the extra batch
+    dim, while the per-grid-step kernel body is shape-invariant."""
+    dt = jnp.dtype(dtype)
+    C = 3
+    Sig = jnp.stack([_spd_batch(4, 6, dt, seed=10 + c)
+                     for c in range(C)])
+    rng = np.random.default_rng(6)
+    d = jnp.asarray(rng.standard_normal((C, 4, 6)), dt)
+    z = jnp.asarray(rng.standard_normal((C, 4, 6)), dt)
+    f_p = jax.jit(jax.vmap(lambda S, dd, zz: kernels.chol_solve_sample(
+        S, dd, zz, ridge=1e-6, tier="pallas")))
+    f_x = jax.jit(jax.vmap(lambda S, dd, zz: kernels.chol_solve_sample(
+        S, dd, zz, ridge=1e-6, tier="xla")))
+    got, want = f_p(Sig, d, z), f_x(Sig, d, z)
+    for g, w in zip(got[:4], want[:4]):       # L, Li, dj, mean: bitwise
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(w))
+    bp_p, bp_x = np.asarray(got[4]), np.asarray(want[4])
+    eps = np.finfo(dt.type).eps
+    assert np.max(np.abs(bp_p - bp_x)) <= 2 * eps * np.abs(bp_x).max()
+
+    TNa, Ta = _gram_operands(2, 3, 5, 4)
+    TNa = jnp.stack([TNa, TNa * 0.5, TNa * 2.0])
+    Ta = jnp.stack([Ta, Ta * 2.0, Ta * 0.5])
+    g_p = jax.jit(jax.vmap(lambda a, b: kernels.gram_accumulate(
+        a, b, out_dtype=jnp.float32, tier="pallas")))(TNa, Ta)
+    g_x = jax.jit(jax.vmap(lambda a, b: kernels.gram_accumulate(
+        a, b, out_dtype=jnp.float32, tier="xla")))(TNa, Ta)
+    np.testing.assert_array_equal(np.asarray(g_p), np.asarray(g_x))
+
+
+# ---------------------------------------------------------------------------
+# numerics of the reference itself
+
+
+def test_gram_accumulate_widen_is_exact():
+    """The widening accumulate is the exact Gram: with integer-valued
+    f32 operands every product and partial sum is exactly representable
+    in f64, so the result equals the numpy oracle to the last bit
+    REGARDLESS of contraction order — segmentation cannot move it."""
+    rng = np.random.default_rng(8)
+    TNa = jnp.asarray(rng.integers(-8, 9, (3, 4, 8, 7)), jnp.float32)
+    Ta = jnp.asarray(rng.integers(-8, 9, (3, 4, 8, 7)), jnp.float32)
+    got = kernels.gram_accumulate(TNa, Ta, out_dtype=jnp.float64,
+                                  widen=True, tier="xla")
+    want = np.einsum("pnb,pnc->pbc",
+                     np.asarray(TNa, np.float64).reshape(3, 32, 7),
+                     np.asarray(Ta, np.float64).reshape(3, 32, 7))
+    np.testing.assert_array_equal(np.asarray(got), want)
+
+
+def test_gram_accumulate_steady_error_class():
+    """The f32 steady body stays within the documented
+    ~sqrt(nseg * seg_len) * eps_f32 error class of the exact Gram."""
+    TNa, Ta = _gram_operands(3, 4, 8, 7, seed=9)
+    exact = np.asarray(kernels.gram_accumulate(
+        TNa, Ta, out_dtype=jnp.float64, widen=True, tier="xla"))
+    steady = np.asarray(kernels.gram_accumulate(
+        TNa, Ta, out_dtype=jnp.float32, widen=False, tier="xla"))
+    scale = np.abs(exact).max()
+    assert np.max(np.abs(steady - exact)) < 64 * np.sqrt(32) * 1.2e-7 * scale
+
+
+# ---------------------------------------------------------------------------
+# the production consumer: same-key tier agreement of the b-draw
+
+
+@pytest.fixture(scope="module")
+def tiny_cm():
+    from pulsar_timing_gibbsspec_tpu.sampler.compiled import compile_pta
+
+    pta = build_model(synthetic_pulsars(3, 40, tm_cols=3, seed=0), 3)
+    return pta, compile_pta(pta)
+
+
+@needs_pallas
+def test_draw_b_mh_tier_agreement_same_key(tiny_cm):
+    """One Metropolised b-draw from the same state and key under each
+    tier: the mixed f32-proposal/f64-accept path must agree to <= 1e-8
+    (interpret parity makes it bitwise here; the bound is the
+    acceptance criterion that also holds on hardware)."""
+    from pulsar_timing_gibbsspec_tpu.sampler import jax_backend as jb
+
+    pta, cm = tiny_cm
+    x = jnp.asarray(pta.initial_sample(np.random.default_rng(1)),
+                    cm.cdtype)
+    b = jnp.zeros((cm.P, cm.Bmax), cm.cdtype)
+    u = jb.b_matvec(cm, b)
+    key = jr.PRNGKey(7)
+    outs = {}
+    for tier in ("pallas", "xla"):
+        with _tier(tier):
+            outs[tier] = jax.jit(
+                lambda xx, bb, uu, kk: jb.draw_b_mh(cm, xx, bb, uu, kk)
+            )(x, b, u, key)
+    b_p, u_p, acc_p = outs["pallas"]
+    b_x, u_x, acc_x = outs["xla"]
+    np.testing.assert_array_equal(np.asarray(acc_p), np.asarray(acc_x))
+    assert bool(np.asarray(acc_p).any())      # the draw actually moved
+    assert np.max(np.abs(np.asarray(b_p) - np.asarray(b_x))) <= 1e-8
+    assert np.max(np.abs(np.asarray(u_p) - np.asarray(u_x))) <= 1e-8
+
+
+@needs_pallas
+def test_steady_loop_zero_retrace_with_kernel_tier(tiny_cm):
+    """Enabling the tier is a trace-time dispatch decision: the steady
+    chunk loop reports zero unplanned retraces, exactly as with the
+    XLA tier (the PR 12 retrace contract)."""
+    from pulsar_timing_gibbsspec_tpu import profiling
+    from pulsar_timing_gibbsspec_tpu.sampler.jax_backend import \
+        JaxGibbsDriver
+
+    pta, _cm = tiny_cm
+    with _tier("pallas"):
+        drv = JaxGibbsDriver(pta, seed=3, common_rho=True,
+                             warmup_sweeps=2, white_adapt_iters=4,
+                             chunk_size=4, nchains=1)
+        niter = 12
+        x0 = pta.initial_sample(np.random.default_rng(0))
+        cshape, bshape = drv.chain_shapes(niter)
+        chain = np.zeros(cshape)
+        bchain = np.zeros(bshape)
+        with profiling.recompile_counter() as rc:
+            rc.phase("warmup")
+            it = drv.run(x0, chain, bchain, 0, niter)
+            done = next(it)
+            rc.phase("steady")
+            for done in it:
+                pass
+        assert done == niter
+        assert rc.unplanned("steady") == 0
+        assert np.all(np.isfinite(chain))

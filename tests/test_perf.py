@@ -323,6 +323,36 @@ def test_ledger_record_roundtrip(tmp_path):
     assert got[0] == got[1] == {k: v for k, v in rec.items()}
 
 
+def test_ledger_records_always_carry_wallclock(tmp_path):
+    """Regression for the ``"ts": null`` ledger rows: every producer
+    path must stamp a real host-side epoch plus its ISO-8601 twin."""
+    rec = make_ledger_record({"metric": "m", "value": 1.0}, source="t",
+                             ts=5.0)
+    assert rec["ts"] == 5.0
+    assert rec["ts_iso"] == "1970-01-01T00:00:05Z"
+    # no explicit ts: stamped at record-build time, never left null
+    rec = make_ledger_record({"metric": "m", "value": 1.0}, source="t")
+    assert isinstance(rec["ts"], float) and rec["ts"] > 0
+    assert rec["ts_iso"].endswith("Z")
+    # a legacy null-ts record is stamped at append time
+    path = tmp_path / "L.jsonl"
+    ledger_append(dict(_rec(2.0), ts=None), path)
+    got = ledger_read(path)[0]
+    assert isinstance(got["ts"], float) and got["ts"] > 0
+    assert got["ts_iso"].endswith("Z")
+
+
+def test_perfwatch_snapshot_records_carry_wallclock(tmp_path):
+    """The MULTICHIP snapshot parser (the producer that used to emit
+    ``"ts": null``) now stamps the snapshot file's mtime."""
+    pw = _load_perfwatch()
+    snap = tmp_path / "MULTICHIP_r1.json"
+    snap.write_text(json.dumps({"ok": True, "n_devices": 2}))
+    rec = pw._parse_multichip_snapshot(snap)
+    assert rec["ts"] == pytest.approx(snap.stat().st_mtime)
+    assert rec["ts_iso"].endswith("Z")
+
+
 def test_check_ledger_within_band_passes():
     recs = [_rec(100.0), _rec(90.0)]      # -10% < 35% band
     assert check_ledger(recs) == []

@@ -1,0 +1,175 @@
+"""Per-backend autotune sweep -> pinned defaults table (AUTOTUNE.json).
+
+Two independent sweeps, both reusing existing machinery:
+
+- **dispatch knobs** (chunk, megachunk, nchains): one
+  ``profiling.dispatch_breakdown`` staging per grid point — the
+  ``chunk_probe --amortize`` measurement — scored by the host-side
+  dispatch tax amortized per sweep (the PR 12 metric the mega-chunk
+  loop drives under 1 ms/sweep).
+- **gram_seg_len**: the steady ``tnt_d_seg32`` Gram block timed per
+  candidate segment length (the kernel_probe measurement), scored by
+  block wall time.  Short segments exist for TPU HBM scratch reasons
+  (contracts/crn_bench_c128.json); on CPU the sweep lands on one
+  segment.
+
+The winner per backend is written to ``AUTOTUNE.json`` at the repo
+root::
+
+    {"version": 1, "backends": {"cpu": {"best": {"chunk": ...,
+     "megachunk": ..., "nchains": ..., "gram_seg_len": ...},
+     "entries": [...]}}}
+
+``config.autotune_defaults()`` reads the table and the driver consults
+it — **opt-in** via ``PTGIBBS_AUTOTUNE=1`` — for ``chunk_size`` and
+``megachunk`` defaults; ``gram_seg_len``/``nchains`` are advisory (the
+segment length is part of the bitwise-resume class, so it never
+changes silently under a tuned table).
+
+Usage: python tools/autotune.py [--chunks 16,64] [--out AUTOTUNE.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+import numpy as np
+
+if __name__ == "__main__":   # script bootstrap; no import side effects
+    sys.path.insert(0, ".")
+
+_REPO_ROOT = Path(__file__).resolve().parents[1]
+
+
+def _build_pta(npsr, ntoa):
+    from pulsar_timing_gibbsspec_tpu.analysis.jaxprcheck.entries import (
+        build_model, synthetic_pulsars)
+
+    return build_model(synthetic_pulsars(npsr, ntoa, 3, seed=0), 10)
+
+
+def sweep_dispatch(pta, chunks, megas, nchains_list, adapt):
+    """One dispatch_breakdown staging per (nchains, megachunk, chunk)
+    grid point; rows of amortized host tax per sweep."""
+    from pulsar_timing_gibbsspec_tpu import profiling
+    from pulsar_timing_gibbsspec_tpu.sampler.jax_backend import (
+        JaxGibbsDriver)
+
+    x0 = pta.initial_sample(np.random.default_rng(0))
+    rows = []
+    for C in nchains_list:
+        for mega in megas:
+            drv = JaxGibbsDriver(pta, seed=1, common_rho=True,
+                                 white_adapt_iters=adapt,
+                                 chunk_size=min(chunks), nchains=C,
+                                 megachunk=mega)
+            niter = adapt + 2 * min(chunks)
+            cshape, bshape = drv.chain_shapes(niter)
+            it = drv.run(x0, np.zeros(cshape), np.zeros(bshape), 0, niter)
+            next(it)       # warmup + adaptation only
+            for chunk in chunks:
+                drv.chunk_size = chunk
+                bd = profiling.dispatch_breakdown(drv, drv.x_cur)
+                rows.append({
+                    "nchains": C, "megachunk": mega, "chunk": chunk,
+                    "dispatch_amortized_ms_per_sweep":
+                        float(bd["dispatch_amortized_per_sweep"]),
+                    "sweeps_per_dispatch":
+                        int(bd["sweeps_per_dispatch"])})
+                print(f"  C={C} mega={mega} chunk={chunk}: "
+                      f"{rows[-1]['dispatch_amortized_ms_per_sweep']:.4f}"
+                      " ms/sweep (host tax)")
+    return rows
+
+
+def sweep_seg_len(pta, seg_lens, ntoa, iters=10, warmup=2):
+    """Steady f32 Gram block wall time per candidate segment length."""
+    import jax
+    import jax.numpy as jnp
+    import jax.random as jr
+
+    from pulsar_timing_gibbsspec_tpu.profiling import _scan_time
+    from pulsar_timing_gibbsspec_tpu.sampler import jax_backend as jb
+    from pulsar_timing_gibbsspec_tpu.sampler.compiled import compile_pta
+
+    cm = compile_pta(pta)
+    x0 = jnp.asarray(pta.initial_sample(np.random.default_rng(0)),
+                     cm.cdtype)
+    N0 = cm.ndiag_fast(x0)
+    C = 8
+    rows = []
+    for seg in seg_lens:
+        seg_eff = seg or ntoa
+
+        def body(x, b, key, _s=seg_eff):
+            out = jax.vmap(
+                lambda k: jb.tnt_d_seg32(
+                    cm, N0 * (1.0 + 0.0 * x), seg_len=_s)[0]
+            )(jr.split(key, C))
+            return x + 0.0 * out.ravel()[0].astype(x.dtype), b
+
+        t = _scan_time(body, jnp.zeros((), cm.dtype),
+                       jnp.zeros((), cm.dtype), iters, warmup)
+        rows.append({"gram_seg_len": seg_eff,
+                     "gram_block_ms": float(t * 1e3)})
+        print(f"  seg_len={seg_eff}: {t * 1e3:.3f} ms (steady gram)")
+    return rows
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--npsr", type=int, default=8)
+    ap.add_argument("--ntoa", type=int, default=360)
+    ap.add_argument("--adapt", type=int, default=100)
+    ap.add_argument("--chunks", default="16,64,256")
+    ap.add_argument("--megas", default="1,4")
+    ap.add_argument("--nchains-list", default="8")
+    ap.add_argument("--seg-lens", default="96,180,0",
+                    help="candidate gram_seg_len values; 0 = ntoa "
+                         "(one segment)")
+    ap.add_argument("--out", default=str(_REPO_ROOT / "AUTOTUNE.json"))
+    args = ap.parse_args()
+
+    import jax
+
+    backend = jax.default_backend()
+    chunks = [int(s) for s in args.chunks.split(",")]
+    megas = [int(s) for s in args.megas.split(",")]
+    nchains_list = [int(s) for s in args.nchains_list.split(",")]
+    seg_lens = [int(s) for s in args.seg_lens.split(",")]
+
+    print(f"autotune: backend={backend}")
+    pta = _build_pta(args.npsr, args.ntoa)
+    print("autotune: dispatch-knob sweep (chunk, megachunk, nchains)")
+    disp = sweep_dispatch(pta, chunks, megas, nchains_list, args.adapt)
+    print("autotune: gram_seg_len sweep")
+    segs = sweep_seg_len(pta, seg_lens, args.ntoa)
+
+    best_disp = min(disp,
+                    key=lambda r: r["dispatch_amortized_ms_per_sweep"])
+    best_seg = min(segs, key=lambda r: r["gram_block_ms"])
+    best = {"chunk": best_disp["chunk"],
+            "megachunk": best_disp["megachunk"],
+            "nchains": best_disp["nchains"],
+            "gram_seg_len": best_seg["gram_seg_len"]}
+
+    out = Path(args.out)
+    table = {"version": 1, "backends": {}}
+    if out.exists():
+        try:
+            table = json.loads(out.read_text())
+        except Exception:
+            pass
+    table.setdefault("backends", {})[backend] = {
+        "best": best, "entries": disp + segs}
+    out.write_text(json.dumps(table, indent=2, sort_keys=True) + "\n")
+    print(f"autotune: best for {backend}: {best}")
+    print(f"autotune: wrote {out}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

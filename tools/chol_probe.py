@@ -10,6 +10,7 @@ block (75% of the steady sweep at C=64, tools/sweep_probe.py) is the
 native small-batch factorization lowering.
 
 Usage: python tools/chol_probe.py [--nchains 64] [--B 37]
+       [--kernel pallas|xla]   # extra row: fused ops/kernels chain
 """
 
 from __future__ import annotations
@@ -28,6 +29,10 @@ def main():
     ap.add_argument("--nchains", type=int, default=64)
     ap.add_argument("--P", type=int, default=45)
     ap.add_argument("--B", type=int, default=37)
+    ap.add_argument("--kernel", choices=("pallas", "xla"), default=None,
+                    help="also time the fused ops/kernels "
+                         "chol_solve_sample at this tier (extra row in "
+                         "the table; off-TPU 'pallas' interprets)")
     args = ap.parse_args()
 
     import jax
@@ -73,6 +78,26 @@ def main():
     t_blocked = _scan_time(blocked, x, b, 20, 3)
     print(f"native cholesky+solves: {t_native*1e3:7.2f} ms")
     print(f"blocked_chol_inv path:  {t_blocked*1e3:7.2f} ms")
+
+    if args.kernel:
+        # the production fused chain at the requested tier: Jacobi
+        # precondition + factor + both solves + sample in one dispatch
+        from pulsar_timing_gibbsspec_tpu.config import settings
+        from pulsar_timing_gibbsspec_tpu.ops import kernels
+
+        settings.kernel_tier = args.kernel
+
+        def fused(x, b, key):
+            Ax = A + x * jnp.eye(B, dtype=jnp.float32)
+            z = jr.normal(key, d.shape, jnp.float32)
+            outs = jax.vmap(
+                lambda a, dd, zz: kernels.chol_solve_sample(a, dd, zz)
+            )(Ax, d, z)
+            return x + 0.0 * outs[4][0, 0, 0], b
+
+        t_fused = _scan_time(fused, x, b, 20, 3)
+        print(f"fused chol_solve_sample [{args.kernel}]:"
+              f" {t_fused*1e3:7.2f} ms")
 
     # accuracy cross-check of the blocked f32 factor against native
     L, dj = precond_cholesky(A)

@@ -19,6 +19,7 @@ warmed-up state:
     with the exact accept (predicted acceptance).
 
 Usage: python tools/gram_probe.py [--nchains 32] [--warm 200]
+       [--kernel pallas|xla]   # extra rows: kernel-tier Gram paths
 """
 
 from __future__ import annotations
@@ -56,6 +57,11 @@ def main():
     ap.add_argument("--nchains", type=int, default=32)
     ap.add_argument("--warm", type=int, default=200)
     ap.add_argument("--adapt", type=int, default=300)
+    ap.add_argument("--kernel", choices=("pallas", "xla"), default=None,
+                    help="also time the production kernel-tier Gram "
+                         "paths (tnt_d_seg32 / tnt_d_seg / tnt_d) at "
+                         "this tier — extra rows in the timing table "
+                         "(off-TPU 'pallas' interprets)")
     args = ap.parse_args()
 
     import bench
@@ -108,6 +114,18 @@ def main():
     for nseg in (4, 8, 16):
         time_gram(lambda cm_, N, n=nseg: tnt_d_nseg(cm_, N, n),
                   f"tnt_d_seg f32 nseg={nseg}")
+
+    if args.kernel:
+        # per-kernel column: the production ops/kernels Gram paths at
+        # the requested tier (dispatch + per-segment shapes included,
+        # unlike the tnt_d_nseg sweep above)
+        from pulsar_timing_gibbsspec_tpu.config import settings
+
+        settings.kernel_tier = args.kernel
+        k = args.kernel
+        time_gram(jb.tnt_d_seg32, f"tnt_d_seg32 [kernel={k}]")
+        time_gram(jb.tnt_d_seg, f"tnt_d_seg   [kernel={k}]")
+        time_gram(jb.tnt_d, f"tnt_d exact [kernel={k}]")
 
     # full exact draw vs segmented draw
     def time_draw(fn, label):

@@ -1,0 +1,177 @@
+"""Measure the CRN steady block mix against the all-exact f64 blocks.
+
+The kernel tier's perf claim, quantified on whatever backend is
+present.  The production steady mix runs the f32 blocks —
+``tnt_d_seg32`` segmented Gram + the fused ``chol_solve_sample``
+factor chain — for ``exact_every - 1`` of every ``exact_every``
+sweeps, and the near-exact two-float refresh blocks (``tnt_d_seg`` +
+``factor="tf"``) for the remaining slot; the pre-PR 3 sweep ran the
+f64 exact blocks (widening-f64 ``tnt_d`` + f64 Jacobi factor chain)
+every sweep.  The probe times the three block chains vmapped over
+chains and reports, for the Gram alone and for the full
+Gram+factor+sample chain,
+
+    mix rate    = exact_every / ((exact_every - 1) t_steady + t_refresh)
+    exact rate  = 1 / t_exact
+    speedup     = mix rate / exact rate
+
+With ``--append`` the Gram-block speedup lands in PERF_LEDGER.jsonl as
+``crn_steady_gram_mix_speedup_vs_exact`` — a gated metric
+(``perfwatch --check``): a kernel or dispatch regression that erodes
+the steady-path advantage fails the gate before it reaches hardware.
+
+``--gram-seg-len`` pins the steady segment length for the run; the
+default 0 means one segment (``seg_len = ntoa``) — the CPU autotune
+optimum (tools/autotune.py), since only TPU HBM scratch motivates
+short segments.  ``--tier pallas|xla|auto`` pins the kernel tier
+(off-TPU, ``pallas`` runs the interpreter — correctness-true but slow;
+timing runs should keep the resolved default).
+
+Usage: python tools/kernel_probe.py [--nchains 8] [--append]
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+import numpy as np
+
+if __name__ == "__main__":   # script bootstrap; no import side effects
+    sys.path.insert(0, ".")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nchains", type=int, default=8)
+    ap.add_argument("--n-psr", type=int, default=20)
+    ap.add_argument("--ntoa", type=int, default=720)
+    ap.add_argument("--tm-cols", type=int, default=5)
+    ap.add_argument("--nmodes", type=int, default=10)
+    ap.add_argument("--exact-every", type=int, default=16)
+    ap.add_argument("--gram-seg-len", type=int, default=0,
+                    help="steady Gram segment length; 0 = one segment "
+                         "(the CPU autotune optimum)")
+    ap.add_argument("--iters", type=int, default=10)
+    ap.add_argument("--warmup", type=int, default=2)
+    ap.add_argument("--tier", default=None,
+                    choices=("pallas", "xla", "auto"))
+    ap.add_argument("--append", action="store_true",
+                    help="append the speedup record to PERF_LEDGER.jsonl")
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import jax.random as jr
+
+    from pulsar_timing_gibbsspec_tpu.analysis.jaxprcheck.entries import (
+        build_model, synthetic_pulsars)
+    from pulsar_timing_gibbsspec_tpu.config import settings
+    from pulsar_timing_gibbsspec_tpu.obs import perf
+    from pulsar_timing_gibbsspec_tpu.ops import kernels
+    from pulsar_timing_gibbsspec_tpu.ops.linalg import (
+        _batched_diag, jacobi_factor_mean_prop)
+    from pulsar_timing_gibbsspec_tpu.profiling import _scan_time
+    from pulsar_timing_gibbsspec_tpu.sampler import jax_backend as jb
+    from pulsar_timing_gibbsspec_tpu.sampler.compiled import compile_pta
+
+    if args.tier:
+        settings.kernel_tier = args.tier
+    seg = args.gram_seg_len or args.ntoa
+
+    psrs = synthetic_pulsars(args.n_psr, args.ntoa,
+                             tm_cols=args.tm_cols, seed=0)
+    pta = build_model(psrs, args.nmodes)
+    cm = compile_pta(pta)
+    C = args.nchains
+    x0 = jnp.asarray(pta.initial_sample(np.random.default_rng(0)),
+                     cm.cdtype)
+    N0 = cm.ndiag_fast(x0)
+    phi = cm.phi(x0)
+    phi32 = cm.phi(x0, dtype=cm.dtype)
+    eye32 = jnp.eye(cm.Bmax, dtype=cm.dtype)
+
+    # every body threads the scan carry through N so nothing hoists out
+    # of the timing loop, and vmaps the per-chain block over C chains
+    def _timed(block):
+        def body(x, b, key):
+            out = jax.vmap(block, in_axes=(None, 0))(
+                N0 * (1.0 + 0.0 * x), jr.split(key, C))
+            return x + 0.0 * out.ravel()[0].astype(x.dtype), b
+        x = jnp.zeros((), cm.dtype)
+        b = jnp.zeros((), cm.dtype)
+        return _scan_time(body, x, b, args.iters, args.warmup)
+
+    # -- Gram blocks alone ------------------------------------------------
+    def gram_steady(N, _k):
+        return jb.tnt_d_seg32(cm, N, seg_len=seg)[0]
+
+    def gram_refresh(N, _k):
+        return jb.tnt_d_seg(cm, N, seg_len=seg)[0].astype(cm.dtype)
+
+    def gram_exact(N, _k):
+        return jb.tnt_d(cm, N)[0].astype(cm.dtype)
+
+    # -- full Gram + factor + sample chains -------------------------------
+    def chain_steady(N, k):
+        TNT, d = jb.tnt_d_seg32(cm, N, seg_len=seg)
+        Sig = TNT + (1.0 / phi32)[:, :, None] * eye32
+        z = jr.normal(k, (cm.P, cm.Bmax), cm.dtype)
+        return kernels.chol_solve_sample(Sig, d, z,
+                                         ridge=jb._PROP_RIDGE)[4]
+
+    def chain_refresh(N, k):
+        TNT, d = jb.tnt_d_seg(cm, N, seg_len=seg)
+        Sig = TNT + _batched_diag(1.0 / phi)
+        z = jr.normal(k, (cm.P, cm.Bmax), cm.cdtype)
+        return kernels.chol_solve_sample(
+            Sig, d, z, ridge=jb._PROP_RIDGE,
+            factor="tf")[4].astype(cm.dtype)
+
+    def chain_exact(N, k):
+        TNT, d = jb.tnt_d(cm, N)
+        Sig = TNT + _batched_diag(1.0 / phi)
+        z = jr.normal(k, (cm.P, cm.Bmax), cm.cdtype)
+        return jacobi_factor_mean_prop(Sig, d, z)[4].astype(cm.dtype)
+
+    E = args.exact_every
+    dev = jax.devices()[0]
+    tier = kernels.resolve_tier()
+    print(f"backend={jax.default_backend()} device={dev.device_kind} "
+          f"tier={tier} C={C} P={cm.P} B={cm.Bmax} ntoa={args.ntoa} "
+          f"seg_len={seg}")
+
+    speedups = {}
+    for label, steady, refresh_, exact in (
+            ("gram", gram_steady, gram_refresh, gram_exact),
+            ("gram+chol+sample", chain_steady, chain_refresh,
+             chain_exact)):
+        t_s = _timed(steady)
+        t_r = _timed(refresh_)
+        t_e = _timed(exact)
+        mix_rate = E / ((E - 1) * t_s + t_r)
+        speedups[label] = mix_rate * t_e
+        print(f"{label:18s} steady {t_s * 1e3:7.2f} ms  refresh "
+              f"{t_r * 1e3:7.2f} ms  exact {t_e * 1e3:7.2f} ms  "
+              f"mix {mix_rate * C:9.1f} blk/s  all-exact "
+              f"{C / t_e:9.1f} blk/s  speedup {speedups[label]:5.2f}x")
+
+    if args.append:
+        rec = perf.make_ledger_record(
+            {"metric": "crn_steady_gram_mix_speedup_vs_exact",
+             "value": float(speedups["gram"]), "unit": "x",
+             "nchains": C, "device_kind": dev.device_kind,
+             "backend": jax.default_backend()},
+            source="tools/kernel_probe.py", kind="probe",
+            note=(f"kernel_tier={tier}; mix=({E - 1}*f32_seg32+"
+                  f"tf_refresh)/{E} vs widen-f64 tnt_d; chain speedup "
+                  f"{speedups['gram+chol+sample']:.2f}x; P={cm.P} "
+                  f"ntoa={args.ntoa} nmodes={args.nmodes} "
+                  f"seg_len={seg}"))
+        path = perf.ledger_append(rec)
+        print(f"appended to {path}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

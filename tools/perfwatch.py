@@ -40,6 +40,7 @@ import json
 import os
 import re
 import sys
+import time
 from pathlib import Path
 
 _REPO_ROOT = Path(__file__).resolve().parents[1]
@@ -123,8 +124,13 @@ def _parse_multichip_snapshot(path: Path) -> dict | None:
     from pulsar_timing_gibbsspec_tpu.obs import perf
 
     doc = json.loads(path.read_text())
+    # the snapshot JSON carries no wall-clock of its own; the file's
+    # mtime is the host-side capture time (ts must never be null — the
+    # ledger's "when did this regress" question depends on it)
+    ts = float(path.stat().st_mtime)
     rec = {"schema": perf.LEDGER_SCHEMA, "kind": "multichip",
-           "source": path.name, "run": path.stem, "ts": None,
+           "source": path.name, "run": path.stem, "ts": ts,
+           "ts_iso": perf._iso_ts(ts),
            "ok": bool(doc.get("ok")),
            "n_devices": doc.get("n_devices")}
     if doc.get("skipped"):
@@ -140,12 +146,29 @@ def _parse_multichip_snapshot(path: Path) -> dict | None:
 
 
 def backfill(ledger: Path, force: bool = False) -> int:
+    """Rebuild the snapshot-derived records (BENCH_r*/MULTICHIP_r*)
+    and MERGE: records from other producers (probes, autotune, CI)
+    are preserved in their original order after the snapshot block,
+    with a host-side ``ts``/``ts_iso`` stamped onto any that predate
+    the no-null-ts rule."""
     from pulsar_timing_gibbsspec_tpu.obs import perf
 
     if ledger.exists() and not force:
         print(f"perfwatch: {ledger} exists; --force to rebuild",
               file=sys.stderr)
         return 1
+    snapshot_sources = {
+        p.name for pat in ("BENCH_r*.json", "MULTICHIP_r*.json")
+        for p in _REPO_ROOT.glob(pat)}
+    preserved = []
+    for rec in perf.ledger_read(ledger) if ledger.exists() else []:
+        if rec.get("source") in snapshot_sources:
+            continue            # regenerated below from the snapshot
+        if rec.get("ts") is None:
+            rec = dict(rec, ts=time.time())
+        if not rec.get("ts_iso"):
+            rec = dict(rec, ts_iso=perf._iso_ts(rec["ts"]))
+        preserved.append(rec)
     records = []
     for p in sorted(_REPO_ROOT.glob("BENCH_r*.json")):
         try:
@@ -165,9 +188,11 @@ def backfill(ledger: Path, force: bool = False) -> int:
             continue
         if rec:
             records.append(rec)
+    records.extend(preserved)
     ledger.write_text(
         "".join(json.dumps(r, sort_keys=True) + "\n" for r in records))
-    print(f"perfwatch: wrote {len(records)} record(s) to {ledger}")
+    print(f"perfwatch: wrote {len(records)} record(s) to {ledger} "
+          f"({len(preserved)} non-snapshot record(s) preserved)")
     return 0
 
 
