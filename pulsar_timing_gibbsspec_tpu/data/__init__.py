@@ -1,5 +1,6 @@
 from .dataset import (Pulsar, load_pulsar, load_directory, get_tspan,
                       from_enterprise, load_enterprise_snapshot)
+from .append import append_polynomial_toas, append_toas, dataset_digest
 from .partim import parse_par, parse_tim
 from .fourier import fourier_basis
 from .design import design_matrix
@@ -11,6 +12,9 @@ __all__ = [
     "get_tspan",
     "from_enterprise",
     "load_enterprise_snapshot",
+    "append_toas",
+    "append_polynomial_toas",
+    "dataset_digest",
     "parse_par",
     "parse_tim",
     "fourier_basis",

@@ -1,0 +1,143 @@
+"""Append-TOAs operations for standing models.
+
+A PTA dataset accrues: new TOAs arrive per pulsar over months while
+the posterior of the standing model keeps being served.  These helpers
+express that growth as a pure dataset-to-dataset operation — extend
+the TOA/design rows of a subset of pulsars, keep everything else
+byte-identical — so the serving layer can digest the grown dataset,
+plan a bucket migration, and fork a checkpoint generation
+(:mod:`..runtime.lineage`) without ever mutating the parent's inputs
+in place.
+
+Design-matrix handling: appending TOAs changes the timing-model fit
+window, so the design matrix is recomputed over the *full* grown TOA
+set (the standard refit).  Column scaling is irrelevant downstream —
+the model ingests the design through an SVD (``tm_svd``) — only the
+column space matters.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+
+import numpy as np
+
+from .dataset import Pulsar
+
+__all__ = ["dataset_digest", "append_toas", "append_polynomial_toas"]
+
+
+def dataset_digest(psrs) -> str:
+    """Content digest of a pulsar list: sha256 over each pulsar's name
+    and its TOA/error/residual/frequency/design bytes, in submission
+    order.  The order is hashed deliberately — the logical pulsar
+    order IS the chain identity (per-pulsar key folds, padded slot
+    assignment), so a reordered dataset is a *different* dataset.
+    """
+    h = hashlib.sha256()
+    for psr in psrs:
+        h.update(str(psr.name).encode())
+        for arr in (psr.toas, psr.toaerrs, psr.residuals, psr.freqs,
+                    psr.Mmat):
+            a = np.ascontiguousarray(np.asarray(arr, np.float64))
+            h.update(np.asarray(a.shape, np.int64).tobytes())
+            h.update(a.tobytes())
+    return h.hexdigest()
+
+
+def append_toas(psr, toas, toaerrs, residuals, freqs=None,
+                backend_flags=None, Mmat=None) -> Pulsar:
+    """Append observations to one pulsar and return a new
+    :class:`Pulsar` (the input is never mutated).
+
+    ``toas``/``toaerrs``/``residuals`` are the new rows; ``freqs`` and
+    ``backend_flags`` default to repeating the pulsar's last entry.
+    ``Mmat`` is the recomputed design matrix over the FULL grown TOA
+    set — appending changes the fit window, so callers refit; when
+    omitted the old columns are re-evaluated only if the caller's
+    design convention is unknown, which is an error here: pass the
+    refit matrix explicitly or use :func:`append_polynomial_toas` for
+    the synthetic family.  The grown arrays are sorted by TOA with a
+    stable argsort so equal epochs keep submission order.
+    """
+    toas = np.asarray(toas, np.float64)
+    n = toas.shape[0]
+    if n == 0:
+        return psr
+    toaerrs = np.asarray(toaerrs, np.float64)
+    residuals = np.asarray(residuals, np.float64)
+    if toaerrs.shape != (n,) or residuals.shape != (n,):
+        raise ValueError(
+            f"{psr.name}: appended toaerrs/residuals must match the "
+            f"{n} new TOAs (got {toaerrs.shape} / {residuals.shape})")
+    if freqs is None:
+        freqs = np.full(n, float(np.asarray(psr.freqs)[-1]))
+    if backend_flags is None:
+        backend_flags = np.asarray([psr.backend_flags[-1]] * n,
+                                   dtype=object)
+    if Mmat is None:
+        raise ValueError(
+            f"{psr.name}: appending TOAs changes the timing-model fit "
+            "window — pass the refit design matrix (Mmat) over the "
+            "full grown TOA set")
+    all_toas = np.concatenate([psr.toas, toas])
+    order = np.argsort(all_toas, kind="stable")
+    Mmat = np.asarray(Mmat, np.float64)
+    if Mmat.shape[0] != all_toas.shape[0]:
+        raise ValueError(
+            f"{psr.name}: refit Mmat has {Mmat.shape[0]} rows, grown "
+            f"dataset has {all_toas.shape[0]} TOAs")
+    return dataclasses.replace(
+        psr,
+        toas=all_toas[order],
+        toaerrs=np.concatenate([psr.toaerrs, toaerrs])[order],
+        residuals=np.concatenate([psr.residuals, residuals])[order],
+        freqs=np.concatenate([np.asarray(psr.freqs, np.float64),
+                              np.asarray(freqs, np.float64)])[order],
+        backend_flags=np.concatenate(
+            [np.asarray(psr.backend_flags, dtype=object),
+             np.asarray(backend_flags, dtype=object)])[order],
+        Mmat=Mmat[order],
+    )
+
+
+def append_polynomial_toas(psrs, add, seed=0, frac_span=0.25) -> list:
+    """Grow a polynomial-design dataset (the synthetic family of
+    ``analysis.jaxprcheck.entries.synthetic_pulsars``) by drawing new
+    TOAs *after* each pulsar's current last epoch and refitting the
+    polynomial design over the full grown set.
+
+    ``add`` is either an int (append that many TOAs to every pulsar)
+    or a ``{name: n}`` mapping (grow a subset; absent pulsars are
+    returned unchanged).  Per-pulsar draws use
+    ``default_rng([seed, index])`` so growth is reproducible and
+    independent of which other pulsars grow.  The parent's TOAs are a
+    strict prefix of the grown pulsar's epochs — new observations land
+    strictly later in time — which is what makes in-bucket resume
+    prefixes meaningful.
+    """
+    out = []
+    for ii, psr in enumerate(psrs):
+        n = int(add) if not isinstance(add, dict) \
+            else int(add.get(psr.name, 0))
+        if n < 0:
+            raise ValueError(f"{psr.name}: cannot append {n} TOAs")
+        if n == 0:
+            out.append(psr)
+            continue
+        rng = np.random.default_rng([int(seed), ii])
+        span = float(psr.tspan) if psr.tspan > 0 else 86400.0
+        lo = float(np.asarray(psr.toas).max())
+        new_toas = np.sort(rng.uniform(lo, lo + frac_span * span, n))
+        scale = float(np.std(psr.residuals)) or 1e-7
+        new_res = scale * rng.standard_normal(n)
+        new_errs = np.full(n, float(np.asarray(psr.toaerrs)[-1]))
+        all_toas = np.concatenate([psr.toas, new_toas])
+        tm_cols = int(psr.Mmat.shape[1])
+        t = (all_toas - all_toas.mean()) / (all_toas.max()
+                                            - all_toas.min())
+        M = np.column_stack([t ** k for k in range(tm_cols)])
+        out.append(append_toas(psr, new_toas, new_errs, new_res,
+                               Mmat=M))
+    return out

@@ -67,6 +67,40 @@ Fault kinds:
   evacuation drill (drain residents, rebuild on the surviving submesh,
   re-admit).
 
+Migration seams (the standing-model append path — ``serve/gateway.py``
+``/v1/append`` and ``SamplerService.append_job`` →
+``runtime/lineage.py``):
+
+- ``"migrate.pre_journal"`` — in the gateway's append handler, after
+  the grown model was validated and routed but BEFORE the forking
+  intent is journaled; a kill here leaves nothing durable (recovery =
+  parent untouched, the client retries).
+- ``"migrate.post_journal"`` — after the ``"forking"`` journal entry
+  is durable but before any checkpoint work; recovery = restart or
+  replay re-materializes the child from the journal.
+- ``"migrate.mid_repad"`` — inside ``lineage.fork_generation``, after
+  the checkpoint set was staged (and re-padded, for a cross-bucket
+  migration) into ``<child>.fork.tmp`` but before the child manifest /
+  atomic promote; a kill here leaves only ignorable stage garbage.
+- ``"migrate.pre_readmit"`` — after the child generation's directory
+  was atomically promoted and verified but before the child job is
+  submitted to the scheduler; recovery = the fork is idempotent, a
+  re-materialization finds the child on disk and just readmits it.
+
+Migration fault kinds:
+
+- ``"kill_mid_migration"`` raise :class:`InjectedCrash` at a migration
+  seam (same recovery contract as ``"crash"``, named separately so a
+  campaign schedule reads as intent).
+- ``"corrupt_lineage"`` mangle the ``lineage.parent_manifest_sha256``
+  recorded in the target directory's ``manifest.json`` AND
+  ``manifest.bak.json`` at a fire point with ``outdir`` — the broken
+  hash chain the rollback-to-ancestor drill detects.
+- ``"append_during_drain"`` make :func:`append_during_drain` return
+  truthy at the gateway's ``"gateway.append"`` poll — simulates the
+  drain beginning before the append was journaled; the gateway must
+  refuse typed (DRAINING), binding nothing.
+
 Transport seams (the gateway in ``serve/gateway.py``):
 
 - ``"gateway.step"`` — in the gateway scheduler thread, before each
@@ -219,6 +253,9 @@ def fire(point, row=None, backend=None, outdir=None):
     for f in _take(point, row, backend, ("truncate_file", "corrupt_file")):
         if outdir is not None:
             _damage(os.path.join(str(outdir), f.path or "chain.npy"), f.kind)
+    for f in _take(point, row, backend, ("corrupt_lineage",)):
+        if outdir is not None:
+            _corrupt_lineage(outdir)
     for f in _take(point, row, backend, ("stall",)):
         time.sleep(f.seconds)
     for f in _take(point, row, backend, ("sigterm_at_seam",)):
@@ -228,8 +265,9 @@ def fire(point, row=None, backend=None, outdir=None):
             reason=f"sigterm_at_seam:{point}",
             deadline_s=f.seconds or None)
     for f in _take(point, row, backend, ("crash", "xla_error",
-                                         "device_loss", "gateway_kill")):
-        if f.kind in ("crash", "gateway_kill"):
+                                         "device_loss", "gateway_kill",
+                                         "kill_mid_migration")):
+        if f.kind in ("crash", "gateway_kill", "kill_mid_migration"):
             raise InjectedCrash(
                 f"injected {f.kind} at {point} (row {row})")
         if f.kind == "device_loss":
@@ -348,6 +386,40 @@ def poison_tenant_rows(np_xs, np_bs, tenant_slots, job_rows):
             np_xs[:, slot] = np.nan
             np_bs[:, slot] = np.nan
     return np_xs, np_bs, poisoned
+
+
+def append_during_drain() -> bool:
+    """Consume an armed ``append_during_drain`` fault at the gateway's
+    append poll (counting a firing).  True = pretend the drain began
+    before this append could be journaled; the gateway refuses typed."""
+    if not _armed:
+        return False
+    return bool(_take("gateway.append", None, None,
+                      ("append_during_drain",)))
+
+
+def _corrupt_lineage(outdir):
+    """Mangle the recorded parent-manifest hash in ``manifest.json``
+    and ``manifest.bak.json`` — both, so a ``.bak`` rollback cannot
+    silently heal the chain and the rollback-to-ancestor path is the
+    one exercised."""
+    import json
+
+    for name in ("manifest.json", "manifest.bak.json"):
+        p = os.path.join(str(outdir), name)
+        if not os.path.exists(p):
+            continue
+        try:
+            with open(p) as fh:
+                man = json.load(fh)
+        except ValueError:
+            continue
+        lin = man.get("lineage")
+        if not isinstance(lin, dict):
+            continue
+        lin["parent_manifest_sha256"] = "0" * 64
+        with open(p, "w") as fh:
+            json.dump(man, fh, indent=1, sort_keys=True)
 
 
 def _damage(path, kind):

@@ -44,6 +44,48 @@ class CheckpointError(RuntimeError):
     """A checkpoint failed verification and could not be recovered."""
 
 
+class LayoutMismatch(CheckpointError):
+    """The checkpoint's recorded pulsar order disagrees with the PTA
+    supplied for resume.
+
+    The logical pulsar order IS the chain identity — per-pulsar key
+    folds and padded slot assignment are positional — so resuming a
+    checkpoint against a reordered or substituted pulsar list would
+    silently attribute one pulsar's state to another.  Names the FIRST
+    mismatched position (``index``/``expected``/``got``)."""
+
+    def __init__(self, outdir, index, expected, got):
+        self.index = int(index)
+        self.expected = expected
+        self.got = got
+        super().__init__(
+            f"{outdir}: pulsar order mismatch at index {index}: the "
+            f"checkpoint layout records {expected!r} but this PTA "
+            f"supplies {got!r} — the logical pulsar order IS the chain "
+            "identity (per-pulsar key folds, padded slot assignment) "
+            "and cannot change on resume; reorder the PTA to the "
+            "recorded layout or start a fresh run")
+
+
+def check_layout_pulsars(outdir, want, got):
+    """Raise :class:`LayoutMismatch` naming the first position where
+    the checkpoint's recorded pulsar list ``want`` disagrees with the
+    supplied PTA's ``got``.  A checkpoint with no recorded list (``want``
+    empty / pre-layout) is not checkable and passes."""
+    want = [str(p) for p in (want or [])]
+    got = [str(p) for p in (got or [])]
+    if not want or want == got:
+        return
+    n = min(len(want), len(got))
+    for i in range(n):
+        if want[i] != got[i]:
+            raise LayoutMismatch(outdir, i, want[i], got[i])
+    # equal prefix, unequal length: the boundary is the first mismatch
+    raise LayoutMismatch(outdir, n,
+                         want[n] if len(want) > n else "<none>",
+                         got[n] if len(got) > n else "<none>")
+
+
 def file_sha256(path, chunk=1 << 20) -> str:
     h = hashlib.sha256()
     with open(path, "rb") as fh:
@@ -181,13 +223,8 @@ def reshard_restore(outdir, pta, devices=None, **gibbs_kwargs):
             "on the original device count instead")
     lay = info["layout"]
     devices = faults.device_count_override(devices)
-    want = list(lay.get("pulsars", []))
-    got = list(getattr(pta, "pulsars", []))
-    if want and got != want:
-        raise CheckpointError(
-            f"{outdir}: pulsar set/order mismatch — the checkpoint's "
-            f"logical layout is {want} but this PTA has {got}; the "
-            "logical order IS the chain identity and cannot move")
+    want = lay.get("pulsars", [])
+    check_layout_pulsars(outdir, want, getattr(pta, "pulsars", []))
     pad = int(lay.get("pad_pulsars", 0)) or None
     if isinstance(devices, (tuple, list)):
         n_chain, n_psr = (int(s) for s in devices)
@@ -297,7 +334,7 @@ def check_not_quarantined(outdir, force_requeue=False, manifest=None):
             "(--force-requeue) to requeue it from the verified rows")
 
 
-def load_resume(outdir, force_requeue=False):
+def load_resume(outdir, force_requeue=False, pta=None):
     """Standalone verified checkpoint load for a bare directory.
 
     ``ChainStore.load_resume`` needs a live store instance (the facade
@@ -316,12 +353,25 @@ def load_resume(outdir, force_requeue=False):
     Such a directory REFUSES to load unless ``force_requeue=True``
     (the ``--force-requeue`` flag on the CLI surfaces) — an operator
     decision, not a scheduler default.
+
+    ``pta``, when supplied, is checked against the manifest's recorded
+    pulsar order (``layout.pulsars`` for facade checkpoints,
+    ``serve.pulsars`` for serving-tier ones) BEFORE anything loads —
+    :class:`LayoutMismatch` names the first disagreeing pulsar.
     """
     from ..sampler.chains import ChainStore
 
     outdir = Path(outdir)
     if not (outdir / "chain.npy").exists():
         return None
+    if pta is not None:
+        man = read_manifest(outdir)
+        if isinstance(man, dict) and not man.get("corrupt"):
+            want = ((man.get("layout") or {}).get("pulsars")
+                    or (man.get("serve") or {}).get("pulsars"))
+            if want:
+                check_layout_pulsars(outdir, want,
+                                     getattr(pta, "pulsars", []))
 
     def _names(fname):
         p = outdir / fname

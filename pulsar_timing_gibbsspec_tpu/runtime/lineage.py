@@ -1,0 +1,313 @@
+"""Checkpoint lineage: hash-chained generations for standing models.
+
+A PTA dataset *accrues* — new TOAs arrive per pulsar for years — so a
+long-lived analysis is a chain of checkpoint GENERATIONS, each forked
+from the verified checkpoint of its parent when the dataset grew.
+This module makes that chain a first-class, verifiable object:
+
+- every forked manifest carries a ``lineage`` section::
+
+      {"generation": 2,                  # 0 = root (no parent)
+       "parent_dir": ".../g00012",      # the parent checkpoint dir
+       "parent_manifest_sha256": "…",   # sha256 of the parent's
+                                        #   manifest.json AT FORK TIME
+       "dataset_sha256": "…",           # content digest of the grown
+                                        #   dataset this fork serves
+       "bucket": [2, 48, 24, 3],        # padded shape of the child
+       "retained_rows": 128}            # rows copied from the parent
+
+  The parent-manifest hash makes the ancestry a hash chain: a child
+  vouches for the exact parent state it was forked from, so a swapped,
+  rolled-back-and-diverged, or bit-rotted ancestor is detectable by
+  walking the chain — same trick as the journal's checksum sidecar,
+  applied across directories.
+
+- :func:`fork_generation` creates a child generation ATOMICALLY: the
+  parent is verified first (``integrity.verify`` + ``.bak`` rollback),
+  the checkpoint set is staged into ``<child>.fork.tmp`` (optionally
+  re-padded for a bigger bucket), the child manifest — inheriting
+  every non-file section of the parent's (``layout``/``shard_map``/
+  ``serve``) with the lineage overlaid — is written last, and the
+  staging dir is promoted with one directory rename.  A kill at ANY
+  point leaves either no child (stage dirs are ignorable garbage) or
+  a fully verified child — never a half-copied directory that a
+  resume path could trust.
+
+- :func:`resolve_verified` walks the chain from the newest generation
+  toward the root and returns the NEWEST generation that verifies —
+  both its files (against its manifest) and its linkage (its recorded
+  parent hash against the parent's actual manifest, ``.bak``
+  accepted).  A torn or corrupted generation therefore degrades to
+  its newest verified ancestor instead of failing the job; when no
+  generation verifies, the typed :class:`LineageError` carries the
+  per-generation report.
+
+Verification attempts ``integrity.rollback`` once per generation
+before giving up on it, so a torn current set with a good ``.bak``
+self-heals exactly like a plain resume would.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import shutil
+from pathlib import Path
+
+from . import faults, telemetry
+from .integrity import (CheckpointError, MANIFEST, MANIFEST_BAK,
+                        check_not_quarantined, read_manifest, rollback,
+                        verify, write_manifest)
+
+#: checkpoint-set members a fork carries over (``.bak`` generations and
+#: ``metrics.jsonl`` stay with the parent — the child starts fresh)
+FORK_FILES = ("chain.npy", "bchain.npy", "adapt.npz",
+              "pars_chain.txt", "pars_bchain.txt")
+#: manifest keys owned by :func:`integrity.write_manifest` itself —
+#: everything else is an inheritable extra section
+_MANIFEST_OWN = ("schema", "rows", "written_at", "files")
+
+
+class LineageError(CheckpointError):
+    """No generation in a checkpoint lineage could be verified.
+
+    ``report`` holds the walk: one ``{"dir", "generation", "ok",
+    "why"}`` record per generation visited, newest first.
+    """
+
+    def __init__(self, msg, report=None):
+        super().__init__(msg)
+        self.report = list(report or [])
+
+
+def lineage_of(outdir) -> dict | None:
+    """The manifest's ``lineage`` section, or None (root / unreadable)."""
+    man = read_manifest(outdir)
+    if not isinstance(man, dict) or man.get("corrupt"):
+        return None
+    lin = man.get("lineage")
+    return dict(lin) if isinstance(lin, dict) else None
+
+
+def generation_of(outdir) -> int:
+    """The directory's generation counter (0 for a root checkpoint)."""
+    lin = lineage_of(outdir)
+    return int(lin.get("generation", 0)) if lin else 0
+
+
+def _manifest_hashes(outdir) -> set:
+    """sha256 of the directory's manifest.json and manifest.bak.json
+    bytes — linkage accepts either, because a legitimate rollback
+    swaps the primary for the ``.bak`` generation."""
+    out = set()
+    for name in (MANIFEST, MANIFEST_BAK):
+        p = Path(outdir) / name
+        if p.exists():
+            out.add(hashlib.sha256(p.read_bytes()).hexdigest())
+    return out
+
+
+def _linkage_ok(outdir, lin) -> tuple:
+    """(ok, why) for one generation's parent linkage."""
+    parent = lin.get("parent_dir")
+    if not parent:
+        return True, None
+    recorded = lin.get("parent_manifest_sha256")
+    if not recorded:
+        return False, "lineage records a parent but no parent hash"
+    if not Path(parent).exists():
+        # a pruned ancestor is not corruption: the chain simply ends
+        # here and this generation stands on its own verification
+        return True, None
+    if recorded not in _manifest_hashes(parent):
+        return False, (f"lineage hash chain broken: recorded parent "
+                       f"manifest sha256 {recorded[:12]}… matches "
+                       f"neither {parent}/manifest.json nor its .bak")
+    return True, None
+
+
+def verify_generation(outdir) -> dict:
+    """Verify ONE generation: files against its manifest (with one
+    ``.bak`` rollback attempt) AND its lineage linkage.  Returns
+    ``{"ok", "why", "rows", "generation"}``."""
+    outdir = Path(outdir)
+    rep = verify(outdir)
+    if not rep["ok"]:
+        if not rollback(outdir):
+            return {"ok": False, "rows": 0,
+                    "generation": generation_of(outdir),
+                    "why": f"checkpoint files fail verification "
+                           f"({', '.join(rep['bad'])}) and no verified "
+                           ".bak exists"}
+        rep = verify(outdir)
+        if not rep["ok"]:
+            return {"ok": False, "rows": 0,
+                    "generation": generation_of(outdir),
+                    "why": "checkpoint fails verification even after "
+                           ".bak rollback"}
+    lin = lineage_of(outdir)
+    if lin is not None:
+        ok, why = _linkage_ok(outdir, lin)
+        if not ok and rollback(outdir):
+            # the primary manifest may carry a damaged lineage section
+            # while the .bak generation is intact — one more chance
+            lin = lineage_of(outdir)
+            ok, why = _linkage_ok(outdir, lin or {})
+        if not ok:
+            return {"ok": False, "rows": int(rep["rows"]),
+                    "generation": generation_of(outdir), "why": why}
+    return {"ok": True, "rows": int(rep["rows"]),
+            "generation": generation_of(outdir), "why": None}
+
+
+def walk(outdir) -> list:
+    """The ancestry from ``outdir`` (newest first) to the root: one
+    ``{"dir", "generation", "lineage"}`` record per generation.  Stops
+    at a missing parent, an unreadable manifest, or a cycle."""
+    out, seen = [], set()
+    cur = Path(outdir)
+    while cur is not None and str(cur) not in seen:
+        seen.add(str(cur))
+        lin = lineage_of(cur)
+        out.append({"dir": str(cur),
+                    "generation": int(lin.get("generation", 0))
+                    if lin else 0,
+                    "lineage": lin})
+        parent = (lin or {}).get("parent_dir")
+        cur = Path(parent) if parent and Path(parent).exists() else None
+    return out
+
+
+def resolve_verified(outdir) -> tuple:
+    """The newest verified generation at or above ``outdir``.
+
+    Walks the lineage chain from ``outdir`` toward the root, verifying
+    each generation (files + linkage, with ``.bak`` rollback); returns
+    ``(dir, report)`` for the first that verifies — the degrade-to-
+    ancestor contract.  Raises :class:`LineageError` (carrying the
+    typed per-generation report) when no generation verifies or the
+    chain cannot be walked further.
+    """
+    report, seen = [], set()
+    cur = Path(outdir)
+    while cur is not None and str(cur) not in seen:
+        seen.add(str(cur))
+        rep = verify_generation(cur)
+        report.append({"dir": str(cur),
+                       "generation": int(rep["generation"]),
+                       "ok": bool(rep["ok"]), "why": rep["why"]})
+        if rep["ok"]:
+            if str(cur) != str(outdir):
+                telemetry.incr("lineage_degrades")
+            return cur, report
+        lin = lineage_of(cur)
+        parent = (lin or {}).get("parent_dir")
+        cur = Path(parent) if parent else None
+    detail = "; ".join(f"{r['dir']} (gen {r['generation']}): {r['why']}"
+                       for r in report)
+    raise LineageError(
+        f"{outdir}: no generation in the checkpoint lineage verifies "
+        f"— {detail or 'no manifest found to walk from'}", report=report)
+
+
+def _rewrite_adapt(stage, overrides) -> None:
+    """Rewrite ``adapt.npz`` in the staging dir with ``overrides``
+    merged over its arrays (``iter`` and every other key preserved)."""
+    import numpy as np
+
+    p = Path(stage) / "adapt.npz"
+    if not p.exists():
+        return
+    with np.load(p) as z:
+        d = {k: z[k] for k in z.files}
+    d.update(overrides)
+    tmp = Path(stage) / "adapt.npz.tmp.npz"
+    np.savez(tmp, **d)
+    os.replace(tmp, p)
+
+
+def fork_generation(parent_dir, child_dir, *, dataset_sha256=None,
+                    bucket=None, serve_extra=None, transform=None,
+                    adapt_overrides=None) -> dict:
+    """Fork a verified parent checkpoint into a child generation.
+
+    Stages the parent's checkpoint set into ``<child>.fork.tmp``,
+    applies ``transform(stage_dir, parent_manifest)`` (the cross-bucket
+    re-pad hook; the ``migrate.mid_repad`` chaos seam fires right after
+    it), writes the child manifest — the parent's non-file sections
+    inherited, ``serve_extra`` overlaid, the ``lineage`` section
+    appended — and promotes the stage with one atomic directory rename.
+    ``adapt_overrides`` (e.g. the child's generation counter) rewrites
+    ``adapt.npz`` in the stage.  Idempotent: an existing child whose
+    lineage already points at this parent's manifest hash is returned
+    as-is, so a replayed or restarted migration never re-forks.
+
+    A parent that fails verification raises through
+    :func:`resolve_verified` semantics at the CALLER's discretion —
+    this function verifies only the immediate parent (with one
+    ``.bak`` rollback attempt) and refuses a quarantine-marked parent
+    (forking one would replay a poisoned trajectory under a new name).
+    """
+    parent_dir, child_dir = Path(parent_dir), Path(child_dir)
+    rep = verify(parent_dir)
+    if not rep["ok"]:
+        if not rollback(parent_dir):
+            raise LineageError(
+                f"{parent_dir}: parent checkpoint fails verification "
+                f"({', '.join(rep['bad'])}) and has no verified .bak — "
+                "cannot fork a generation from unverifiable state")
+        rep = verify(parent_dir)
+        if not rep["ok"]:
+            raise LineageError(
+                f"{parent_dir}: parent checkpoint fails verification "
+                "even after .bak rollback — cannot fork")
+    parent_man = read_manifest(parent_dir)
+    check_not_quarantined(parent_dir, manifest=parent_man)
+    parent_hash = hashlib.sha256(
+        (parent_dir / MANIFEST).read_bytes()).hexdigest()
+    parent_lin = parent_man.get("lineage") or {}
+    generation = int(parent_lin.get("generation", 0)) + 1
+    rows = int(parent_man.get("rows", 0))
+
+    # idempotency: a child already forked from THIS parent state stands
+    child_man = read_manifest(child_dir)
+    if isinstance(child_man, dict) and not child_man.get("corrupt"):
+        lin = child_man.get("lineage") or {}
+        if lin.get("parent_manifest_sha256") == parent_hash \
+                and verify(child_dir, child_man)["ok"]:
+            return child_man
+
+    stage = child_dir.parent / (child_dir.name + ".fork.tmp")
+    if stage.exists():
+        shutil.rmtree(stage)
+    stage.mkdir(parents=True)
+    for nm in FORK_FILES:
+        src = parent_dir / nm
+        if src.exists():
+            shutil.copy2(src, stage / nm)
+    if adapt_overrides:
+        _rewrite_adapt(stage, adapt_overrides)
+    if transform is not None:
+        transform(stage, parent_man)
+    # chaos seam: a kill here leaves only the stage dir — ignorable
+    # garbage, the child does not exist yet, recovery is the parent
+    faults.fire("migrate.mid_repad", row=rows, outdir=stage)
+
+    extras = {k: v for k, v in parent_man.items()
+              if k not in _MANIFEST_OWN}
+    if serve_extra:
+        extras.update(serve_extra)
+    extras["lineage"] = {
+        "generation": generation,
+        "parent_dir": str(parent_dir),
+        "parent_manifest_sha256": parent_hash,
+        "dataset_sha256": dataset_sha256,
+        "bucket": (list(bucket) if bucket is not None else None),
+        "retained_rows": rows,
+    }
+    man = write_manifest(stage, rows=rows, extra=extras)
+    if child_dir.exists():
+        shutil.rmtree(child_dir)
+    os.replace(stage, child_dir)
+    telemetry.incr("lineage_forks")
+    return man

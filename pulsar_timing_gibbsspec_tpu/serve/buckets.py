@@ -75,12 +75,89 @@ class BucketOverflow(ValueError):
     def __init__(self, shape: DatasetShape, nearest: BucketSpec | None):
         self.shape = shape
         self.nearest = nearest
+        self.hint = next_covering(shape, base=nearest)
         near = (f"nearest bucket {nearest.as_tuple()}"
                 if nearest is not None else "empty table")
         super().__init__(
             f"dataset shape (P={shape.pulsars}, TOA={shape.toas}, "
             f"B={shape.basis}, K={shape.modes}) exceeds every bucket; "
-            f"{near}")
+            f"{near}; migration hint: provision a covering bucket like "
+            f"{self.hint.as_tuple()}")
+
+
+def next_covering(shape: DatasetShape, base: BucketSpec | None = None
+                  ) -> BucketSpec:
+    """The planner's proposal for a bucket covering ``shape``: start
+    from ``base`` (the nearest existing bucket, when any) and double
+    each overflowing padded axis until it covers — the same doubling
+    discipline as :meth:`BucketTable.ladder`, so provisioned buckets
+    stay on the ladder instead of proliferating one-off shapes.  The
+    mode count is structural and copied exactly."""
+    p = int(base.pulsars) if base is not None else 1
+    t = int(base.toas) if base is not None else 1
+    b = int(base.basis) if base is not None else 1
+    while p < shape.pulsars:
+        p *= 2
+    while t < shape.toas:
+        t *= 2
+    while b < shape.basis:
+        b *= 2
+    return BucketSpec(p, t, b, int(shape.modes))
+
+
+@dataclasses.dataclass(frozen=True)
+class MigrationPlan:
+    """The migration planner's answer for a grown dataset (see
+    :func:`plan_migration`).
+
+    ``kind`` is ``"in_place"`` when the parent's bucket still covers
+    the grown shape — the compiled program, padded widths, and hence
+    the retained-row prefix are unchanged (bitwise contract) — or
+    ``"rebucket"`` when the grown shape needs the next covering bucket
+    and the checkpoint's padded-basis axes must be re-embedded
+    (zero-padded) into the child bucket's geometry."""
+
+    kind: str                   # "in_place" | "rebucket"
+    parent_bucket: BucketSpec
+    child_bucket: BucketSpec
+    shape: DatasetShape
+
+    @property
+    def in_place(self) -> bool:
+        return self.kind == "in_place"
+
+
+def plan_migration(table: "BucketTable", parent_bucket: BucketSpec,
+                   shape: DatasetShape) -> MigrationPlan:
+    """Plan the bucket migration for a dataset grown to ``shape``
+    while standing in ``parent_bucket``.
+
+    In-place when the parent bucket still covers the grown shape
+    (appends that stay under the padded TOA/basis headroom); otherwise
+    routes the grown shape through ``table`` for the next covering
+    bucket — raising the table's typed :class:`BucketOverflow` (hint
+    attached) when nothing covers.  A mode-count change is structural
+    (different parameter space), not a migration: typed refusal."""
+    if shape.modes != parent_bucket.modes:
+        raise ValueError(
+            f"append cannot change the common-process mode count "
+            f"(parent bucket K={parent_bucket.modes}, grown dataset "
+            f"K={shape.modes}) — a mode change is a new model, not a "
+            "migration; submit a fresh job")
+    if shape.pulsars > parent_bucket.pulsars:
+        # more REAL pulsars means more parameters: the chain prefix
+        # would not even be the same vector.  Growing the pulsar set is
+        # a new model; only the TOA/basis axes of existing pulsars may
+        # grow under a migration.
+        raise ValueError(
+            f"append cannot add pulsars ({shape.pulsars} > parent "
+            f"bucket's {parent_bucket.pulsars}) — the parameter space "
+            "changes; submit a fresh job for the extended array")
+    if parent_bucket.covers(shape):
+        return MigrationPlan("in_place", parent_bucket, parent_bucket,
+                             shape)
+    child = table.route(shape)      # BucketOverflow propagates, typed
+    return MigrationPlan("rebucket", parent_bucket, child, shape)
 
 
 def probe_shape(pta) -> DatasetShape:

@@ -74,6 +74,15 @@ JOURNAL_SHA = "gateway_journal.sha256"
 JOURNAL_BAK = "gateway_journal.bak.json"
 JOURNAL_BAK_SHA = "gateway_journal.bak.sha256"
 JOURNAL_SCHEMA = 1
+#: per-ENTRY schema: 1 = the PR 17 submission shape, 2 = lineage-
+#: bearing append entries (parent_dedupe / parent_job_id / generation,
+#: states "forking"/"superseded").  Entries carry their version
+#: explicitly; a missing field reads as 1 (every pre-field journal is a
+#: v1 journal).  Unknown versions are a TYPED refusal at load — a
+#: future reader's entries must never be half-understood and resumed
+#: wrong.
+ENTRY_SCHEMA = 2
+KNOWN_ENTRY_SCHEMAS = (1, 2)
 
 #: gateway lifecycle (racecheck machine ``gateway``)
 GATEWAY_STATES = ("serving", "draining", "stopped")
@@ -114,8 +123,42 @@ def synthetic_model_builder(payload: dict):
     nmodes = _bounded("nmodes", 3, 1, 16)
     from ..analysis.jaxprcheck.entries import build_model, synthetic_pulsars
 
-    return build_model(
-        synthetic_pulsars(n_psr, ntoa, tm_cols=tm_cols, seed=seed), nmodes)
+    psrs = synthetic_pulsars(n_psr, ntoa, tm_cols=tm_cols, seed=seed)
+    # accumulated /v1/append batches: the journal replays the whole
+    # growth history so a restarted gateway rebuilds the grown model
+    # from the payload alone.  Bounded like everything else an upload
+    # names.
+    appends = payload.get("appends") or []
+    if not isinstance(appends, list) or len(appends) > 8:
+        raise WireError("BAD_REQUEST",
+                        "appends must be a list of at most 8 batches")
+    if appends:
+        from ..data.append import append_polynomial_toas
+
+        for i, batch in enumerate(appends):
+            if not isinstance(batch, dict):
+                raise WireError("BAD_REQUEST",
+                                f"appends[{i}] must be a JSON object")
+            try:
+                add = int(batch.get("add", 0))
+                aseed = int(batch.get("seed", 0))
+            except (TypeError, ValueError):
+                raise WireError(
+                    "BAD_REQUEST",
+                    f"appends[{i}].add/.seed must be ints") from None
+            if not 1 <= add <= 256:
+                raise WireError(
+                    "BAD_REQUEST",
+                    f"appends[{i}].add={add} outside [1, 256]")
+            if not 0 <= aseed <= 2**31 - 1:
+                raise WireError(
+                    "BAD_REQUEST",
+                    f"appends[{i}].seed={aseed} outside range")
+            psrs = append_polynomial_toas(psrs, add, seed=aseed)
+        if max(p.ntoa for p in psrs) > 1024:
+            raise WireError("BAD_REQUEST",
+                            "grown dataset exceeds the 1024-TOA bound")
+    return build_model(psrs, nmodes)
 
 
 class StreamSub:
@@ -183,6 +226,11 @@ class Gateway:
         # threads ever wait on disk; generation tags keep concurrent
         # writers ordered (lock order is always _cond -> _jlock)
         self._jlock = threading.Lock()
+        # serializes append materializations (drain parent -> fork ->
+        # readmit child): two racing replays of the same append must
+        # resolve to ONE fork (lock order: _mlock -> _cond -> _jlock;
+        # never taken while holding _cond)
+        self._mlock = threading.Lock()
         self._journal_gen = 0
         self._journal_written = 0
         self.state = "serving"
@@ -294,7 +342,23 @@ class Gateway:
                 f"service_seed {doc.get('service_seed')} but this "
                 f"gateway runs seed {self.svc.service_seed} — tenant "
                 "PRNG identities would cross streams; refuse")
-        self._entries = dict(doc.get("entries", {}))
+        entries = dict(doc.get("entries", {}))
+        for key, ent in entries.items():
+            try:
+                sv = int(ent.get("schema_version", 1))
+            except (TypeError, ValueError):
+                sv = -1
+            if sv not in KNOWN_ENTRY_SCHEMAS:
+                raise CheckpointError(
+                    f"{self.root / JOURNAL}: journal entry {key!r} "
+                    f"carries schema_version {ent.get('schema_version')!r}"
+                    f" but this gateway understands only "
+                    f"{list(KNOWN_ENTRY_SCHEMAS)} — refusing to resume "
+                    "an entry written by a newer writer (half-understood "
+                    "routing state could cross streams or drop lineage); "
+                    "upgrade the gateway or serve this root with the "
+                    "writer that produced it")
+        self._entries = entries
         self._next_seq = int(doc.get("next_seq", len(self._entries)))
         self._next_tenant = int(doc.get("next_tenant", len(self._entries)))
 
@@ -314,9 +378,19 @@ class Gateway:
             check_not_quarantined
 
         now = time.time()
+        forking = []
         for ent in self._entries.values():
             if ent.get("state") in ("done", "expired", "failed",
-                                    "quarantined"):
+                                    "quarantined", "superseded"):
+                # superseded: a child generation replaced this job; its
+                # verified rows stay streamable cold, it never reruns
+                continue
+            if ent.get("state") == "forking":
+                # the gateway died between journaling the append intent
+                # and promoting the child: re-materialize AFTER the
+                # parent entry (below) is readmitted, so the fork finds
+                # its parent job registered
+                forking.append(ent)
                 continue
             try:
                 check_not_quarantined(ent["outdir"])
@@ -327,12 +401,25 @@ class Gateway:
             job = self.svc.submit(pta, int(ent["niter"]),
                                   job_id=ent["job_id"],
                                   tenant_id=int(ent["tenant_id"]),
-                                  outdir=ent["outdir"])
+                                  outdir=ent["outdir"],
+                                  generation=int(ent.get("generation", 0)))
             ent["state"] = "active"
             dl = ent.get("deadline_unix")
             if dl is not None:
                 self._deadlines[job.job_id] = \
                     self._clock() + max(0.0, float(dl) - now)
+        for ent in forking:
+            try:
+                self._materialize_append(ent)
+            except Exception as exc:             # noqa: BLE001
+                # a migration that cannot complete on restart (table
+                # changed, lineage unresolvable) must settle LOUDLY,
+                # not park an orphan entry behind a live gateway
+                ent["state"] = "failed"
+                ent["failure"] = repr(exc)
+                telemetry.incr("gateway_migration_failures")
+                otrace.instant("gateway.migration_failure",
+                               job=ent.get("job_id"), error=repr(exc))
         if self._entries:
             self._write_journal()
 
@@ -434,7 +521,7 @@ class Gateway:
         with self._cond:
             return bool(self._entries) and all(
                 e.get("state") in ("done", "expired", "failed",
-                                   "quarantined")
+                                   "quarantined", "superseded")
                 for e in self._entries.values())
 
     def _graceful_drain(self, residents_drained=False, idle=False) -> None:
@@ -532,6 +619,8 @@ class Gateway:
         path = req.path.rstrip("/") or "/"
         if req.method == "POST" and path == "/v1/jobs":
             return self._submit(req)
+        if req.method == "POST" and path == "/v1/append":
+            return self._append(req)
         if req.method == "POST" and path == "/v1/drain":
             preemption.request_drain(reason="gateway_api")
             return WireResponse(body={"draining": True})
@@ -649,6 +738,7 @@ class Gateway:
                        "niter": int(niter), "payload": payload,
                        "payload_sha256": digest, "outdir": str(outdir),
                        "dedupe_key": dedupe, "state": "active",
+                       "schema_version": 1,
                        "deadline_unix": (None if deadline_s is None
                                          else time.time() + deadline_s)}
                 self._entries[dedupe] = ent
@@ -664,6 +754,183 @@ class Gateway:
         # the journal file I/O happens off the condition lock: handlers
         # and the scheduler keep moving while the fsyncs land
         return self._ack(ent, dedupe, replayed=replayed)
+
+    # -- standing-model append (/v1/append) ---------------------------------
+
+    def _append(self, req: WireRequest) -> WireResponse:
+        """Append TOAs to a standing model: fork the parent job's
+        verified checkpoint into a child generation on the grown
+        dataset, supersede the parent, readmit the child warm.
+
+        Same dedupe/journal contract as submission — the forking
+        intent is journaled BEFORE any checkpoint work, the ACK leaves
+        only after the binding is durable, and a replay (lost ACK,
+        restart) resolves to the original child handle, re-running
+        nothing: the fork itself is idempotent
+        (``lineage.fork_generation`` recognizes a child already forked
+        from this parent state).
+        """
+        body = wire.parse_body(req.body, self.max_body)
+        dedupe = wire.require_name(body.get("dedupe_key"), "dedupe_key")
+        parent_key = wire.require_name(body.get("parent"), "parent")
+        deadline_s = wire.parse_deadline_ms(req.headers, body)
+        spec = body.get("append")
+        if not isinstance(spec, dict):
+            raise WireError("BAD_REQUEST",
+                            "append must be a JSON object (the grown-"
+                            "TOAs spec, e.g. {'add': 16, 'seed': 1})")
+        try:
+            niter = int(body.get("niter", 0))
+        except (TypeError, ValueError):
+            raise WireError("BAD_REQUEST", "niter must be an int") from None
+        if not 1 <= niter <= self.max_niter:
+            raise WireError("BAD_REQUEST",
+                            f"niter must be in [1, {self.max_niter}]")
+        if faults.append_during_drain():
+            # the injected race: the drain began before this append
+            # could be journaled — refuse typed, bind nothing; the
+            # dedupe key makes the client's retry safe elsewhere
+            raise WireError(
+                "DRAINING",
+                "gateway began draining before this append was "
+                "journaled — nothing was bound; retry against a "
+                "serving instance (your dedupe key makes it safe)")
+        with self._cond:
+            parent_ent = self._entries.get(parent_key)
+            if parent_ent is None:
+                raise WireError(
+                    "NOT_FOUND",
+                    f"unknown parent submission {parent_key!r} — "
+                    "'parent' is the parent's dedupe key")
+            # the child payload = parent payload + this append batch:
+            # the journal alone must reproduce the grown model on
+            # restart, so appends accumulate in the payload itself
+            child_payload = dict(parent_ent["payload"])
+            child_payload["appends"] = \
+                list(parent_ent["payload"].get("appends") or []) + [spec]
+        digest = wire.payload_digest(child_payload)
+        with self._cond:
+            ent = self._check_dedupe_locked(dedupe, digest, niter)
+        if ent is not None:
+            return self._ack_append(ent, dedupe, replayed=True)
+        with self._cond:
+            pstate = parent_ent.get("state")
+        if pstate == "superseded":
+            raise WireError(
+                "SUPERSEDED",
+                f"parent {parent_key!r} was already superseded by "
+                f"{parent_ent.get('superseded_by')!r} — append to the "
+                "newest generation instead")
+        if pstate in ("failed", "quarantined"):
+            raise WireError(
+                "BAD_REQUEST",
+                f"parent {parent_key!r} is {pstate} — a {pstate} job "
+                "cannot be grown; "
+                + ("an operator must requeue it first"
+                   if pstate == "quarantined" else
+                   "submit the grown dataset as a fresh job"))
+        # model build + bucket pre-flight OUTSIDE the lock (array
+        # construction and routing are the slow part); overflow is a
+        # typed 422 with the planner's migration hint attached, BEFORE
+        # anything is journaled
+        pta = self._build(child_payload)
+        from .buckets import probe_shape
+
+        self.svc.table.route(probe_shape(pta))
+        faults.fire("migrate.pre_journal", row=self._requests)
+        with self._cond:
+            ent = self._check_dedupe_locked(dedupe, digest, niter)
+            if ent is None:
+                job_id = f"g{self._next_seq:05d}"
+                self._next_seq += 1
+                outdir = self.root / "jobs" / job_id
+                ent = {"job_id": job_id,
+                       "tenant_id": int(parent_ent["tenant_id"]),
+                       "niter": int(niter), "payload": child_payload,
+                       "payload_sha256": digest, "outdir": str(outdir),
+                       "dedupe_key": dedupe, "state": "forking",
+                       "schema_version": ENTRY_SCHEMA,
+                       "parent_dedupe": parent_key,
+                       "parent_job_id": parent_ent["job_id"],
+                       "generation":
+                           int(parent_ent.get("generation", 0)) + 1,
+                       "deadline_unix": (None if deadline_s is None
+                                         else time.time() + deadline_s)}
+                self._entries[dedupe] = ent
+                self._by_job[job_id] = ent
+                self._unjournaled.add(dedupe)
+                self._cond.notify_all()
+                replayed = False
+            else:
+                replayed = True
+        return self._ack_append(ent, dedupe, replayed=replayed, pta=pta,
+                                deadline_s=deadline_s)
+
+    def _ack_append(self, ent, dedupe, replayed, pta=None,
+                    deadline_s=None) -> WireResponse:
+        """Durable-then-materialize: the ``forking`` intent journals
+        first (a kill after this point re-materializes from the
+        journal), then the fork/readmit runs, then the settled states
+        journal again and the ACK leaves."""
+        if not replayed or dedupe in self._unjournaled:
+            self._write_journal()
+            with self._cond:
+                self._unjournaled.discard(dedupe)
+        if replayed:
+            telemetry.incr("dedupe_hits")
+        faults.fire("migrate.post_journal", row=self._requests,
+                    outdir=ent["outdir"])
+        self._materialize_append(ent, pta=pta)
+        if deadline_s is not None:
+            with self._cond:
+                if ent.get("state") == "active":
+                    self._deadlines[ent["job_id"]] = \
+                        self._clock() + deadline_s
+        with self._cond:
+            it, state, _ = self._progress_locked(ent)
+            return WireResponse(body={
+                "job_id": ent["job_id"],
+                "tenant_id": int(ent["tenant_id"]),
+                "niter": int(ent["niter"]), "state": state,
+                "generation": int(ent.get("generation", 0)),
+                "parent_job_id": ent.get("parent_job_id"),
+                "cursor": int(it), "replayed": bool(replayed)})
+
+    def _materialize_append(self, ent, pta=None) -> None:
+        """Drain the parent, fork the child generation, readmit it,
+        flip the journal states (child ``forking -> active``, parent
+        ``-> superseded``).  Idempotent and serialized under
+        ``_mlock``: a replay or restart that finds the child already
+        active returns without touching anything."""
+        with self._mlock:
+            with self._cond:
+                if ent.get("state") != "forking":
+                    return
+                parent_ent = self._entries.get(ent["parent_dedupe"])
+                parent_job_id = ent.get("parent_job_id")
+            if pta is None:
+                pta = self._build(ent["payload"])
+            self.svc.append_job(
+                pta, int(ent["niter"]),
+                parent_id=parent_job_id,
+                parent_outdir=(parent_ent or {}).get("outdir"),
+                job_id=ent["job_id"], outdir=ent["outdir"],
+                dataset_sha256=ent["payload_sha256"],
+                journaled=True)
+            with self._cond:
+                ent["state"] = "active"
+                if parent_ent is not None \
+                        and parent_ent.get("state") not in \
+                        ("failed", "quarantined"):
+                    parent_ent["state"] = "superseded"
+                    parent_ent["superseded_by"] = ent["job_id"]
+                    self._deadlines.pop(parent_job_id, None)
+                self._cond.notify_all()
+            telemetry.incr("gateway_appends")
+            otrace.instant("gateway.append", job=ent["job_id"],
+                           parent=str(parent_job_id),
+                           generation=int(ent.get("generation", 0)))
+        self._write_journal()
 
     def _handle_body(self, ent, replayed) -> WireResponse:
         it, state, _ = self._progress_locked(ent)
@@ -712,10 +979,12 @@ class Gateway:
         """(it, state, job|None) under the lock.  The gateway overlay
         ('expired', terminal quarantine) wins over the raw job state."""
         job = self.svc.jobs.get(ent["job_id"])
-        if ent.get("state") == "expired":
+        if ent.get("state") in ("expired", "superseded"):
+            # gateway overlay wins: the underlying job may sit parked
+            # "queued" (drained parent) but it will never run again
             it = int(job.it) if job is not None \
                 else self._cold_rows(ent)[1]
-            return it, "expired", job
+            return it, str(ent["state"]), job
         if job is None:
             rows, it = self._cold_rows(ent)
             return it, str(ent.get("state", "unknown")), None
@@ -725,7 +994,8 @@ class Gateway:
         return int(job.it), state, job
 
     def _terminal(self, ent, state, job) -> bool:
-        if state in ("done", "failed", "expired", "drained"):
+        if state in ("done", "failed", "expired", "drained",
+                     "superseded"):
             return True
         return state == "quarantined" and (job is None
                                            or job.failure is not None)

@@ -1,10 +1,14 @@
 """Per-request state: lifecycle, PRNG identity, per-job checkpoints.
 
 A job's randomness is fully determined by ``(service_seed,
-tenant_id)``: the tenant base key is ``fold_in(key(service_seed),
-tenant_id)`` and every sweep folds the absolute iteration in-trace —
-so a job resumed after eviction, crash, or in a fresh process replays
-bit-identically, and two jobs never share a stream.
+tenant_id, generation)``: the tenant base key is
+``fold_in(key(service_seed), tenant_id)`` — with the generation
+counter folded on top for forked standing-model generations — and
+every sweep folds the absolute iteration in-trace, so a job resumed
+after eviction, crash, or in a fresh process replays bit-identically,
+and two jobs never share a stream (not even a child generation with
+its own parent: past the retained prefix their streams diverge by the
+generation fold).
 
 Each job owns a checkpoint directory with the standard verified set
 (``ChainStore``: chain.npy / bchain.npy / adapt.npz + manifest.json +
@@ -51,6 +55,11 @@ class Job:
     outdir: str
     state: str = "queued"
     failure: str | None = None
+
+    # standing-model lifecycle: 0 = root; a forked child generation
+    # carries its lineage section (runtime/lineage.py) for the manifest
+    generation: int = 0
+    lineage: dict | None = None
 
     # routing / compiled artifacts (populated at admission)
     bucket: object = None
@@ -103,13 +112,18 @@ class Job:
     def manifest_extra(self) -> dict:
         """Identity the next incarnation needs to readmit this job with
         the same PRNG stream and progress accounting."""
-        return {"serve": {
+        extra = {"serve": {
             "job_id": self.job_id,
             "tenant_id": int(self.tenant_id),
             "niter": int(self.niter),
             "bucket": list(self.bucket.as_tuple()),
             "state": self.state,
+            "generation": int(self.generation),
+            "pulsars": [str(p) for p in self.pta.pulsars],
         }}
+        if self.lineage is not None:
+            extra["lineage"] = dict(self.lineage)
+        return extra
 
     def adapt_state(self) -> dict:
         # ChainStore.save stamps ``iter`` itself (from ``upto``)
@@ -117,6 +131,7 @@ class Job:
             "x": np.asarray(self.x, np.float64),
             "b": np.asarray(self.b, np.float64),
             "tenant_id": np.asarray(self.tenant_id, np.int64),
+            "generation": np.asarray(self.generation, np.int64),
         }
 
     def checkpoint(self):
@@ -136,7 +151,8 @@ class Job:
         from ..runtime import integrity
 
         got = integrity.load_resume(self.outdir,
-                                    force_requeue=force_requeue)
+                                    force_requeue=force_requeue,
+                                    pta=self.pta)
         if got is None:
             return False
         chain, bchain, upto, adapt = got
@@ -145,6 +161,17 @@ class Job:
                 f"checkpoint in {self.outdir} belongs to tenant "
                 f"{int(adapt['tenant_id'])}, not {self.tenant_id} — "
                 "refusing a stream-crossing resume")
+        ck_gen = int(adapt["generation"]) if "generation" in adapt else 0
+        if ck_gen != int(self.generation):
+            raise RuntimeError(
+                f"checkpoint in {self.outdir} is generation {ck_gen}, "
+                f"not {self.generation} — refusing a generation-"
+                "crossing resume (streams are re-keyed per generation)")
+        if self.lineage is None:
+            man = integrity.read_manifest(self.outdir)
+            if isinstance(man, dict) and not man.get("corrupt") \
+                    and isinstance(man.get("lineage"), dict):
+                self.lineage = dict(man["lineage"])
         self.it = int(upto)
         self.chain[:self.it] = chain[:self.it]
         self.bchain[:self.it] = bchain[:self.it]
@@ -156,3 +183,99 @@ class Job:
         """Host record buffers (f64, like the facade's)."""
         self.chain = np.zeros((self.niter, nx), np.float64)
         self.bchain = np.zeros((self.niter, nb), np.float64)
+
+
+# -- standing-model migration ------------------------------------------------
+
+#: the migration state machine (audited by racecheck M1–M3; declared in
+#: contracts/racecheck.json).  ``planned → journaled`` happens at the
+#: gateway (the forking intent is durable before any checkpoint work);
+#: a service-level append with no journal goes ``planned → forked``
+#: directly.  ``aborted`` is reachable from every non-final state — a
+#: kill mid-migration leaves either the parent (nothing promoted) or
+#: the child (fork idempotent, readmit replayable), never a hybrid.
+MIGRATION_STATES = ("planned", "journaled", "forked", "readmitted",
+                    "aborted")
+
+
+class MigrationTicket:
+    """Tracks one append → fork → readmit migration through its
+    audited state machine (see :data:`MIGRATION_STATES`)."""
+
+    def __init__(self, job_id, plan=None):
+        self.job_id = str(job_id)
+        self.plan = plan
+        self.state = "planned"
+
+    def journaled(self):
+        if self.state == "planned":
+            self.state = "journaled"
+
+    def forked(self):
+        if self.state == "planned":
+            self.state = "forked"
+            return
+        if self.state == "journaled":
+            self.state = "forked"
+
+    def readmitted(self):
+        if self.state == "forked":
+            self.state = "readmitted"
+
+    def abort(self):
+        if self.state == "planned":
+            self.state = "aborted"
+            return
+        if self.state == "journaled":
+            self.state = "aborted"
+            return
+        if self.state == "forked":
+            self.state = "aborted"
+
+
+def repad_checkpoint(stage_dir, p_old, b_old, p_new, b_new):
+    """Re-embed a staged checkpoint's padded-basis axes from the parent
+    bucket's ``(P_old, Bmax_old)`` geometry into the child bucket's
+    ``(P_new, Bmax_new)``.
+
+    Pad slots are EXACT zeros by the compiled-sweep conventions
+    (``serve/buckets.py`` docstring), so zero-embedding the recorded
+    ``bchain`` rows and the ``b`` carry reproduces bit-for-bit what the
+    child bucket's program would have recorded for the same draws — the
+    retained-row prefix survives a cross-bucket migration bitwise.
+    ``chain.npy`` and ``x`` are untouched: the parameter vector depends
+    on the dataset, not the padding.  Runs against a non-live staging
+    dir (``lineage.fork_generation``'s transform hook), so plain
+    writes are fine.
+    """
+    import os
+    from pathlib import Path
+
+    if (p_new, b_new) == (p_old, b_old):
+        return
+    if p_new < p_old or b_new < b_old:
+        raise ValueError(
+            f"re-pad cannot shrink the padded geometry "
+            f"(({p_old}, {b_old}) -> ({p_new}, {b_new}))")
+    stage = Path(stage_dir)
+    bpath = stage / "bchain.npy"
+    if bpath.exists():
+        arr = np.load(bpath)
+        rows = arr.shape[0]
+        out = np.zeros((rows, p_new, b_new), arr.dtype)
+        out[:, :p_old, :b_old] = arr.reshape(rows, p_old, b_old)
+        np.save(stage / "bchain.npy.tmp.npy", out.reshape(rows, -1))
+        os.replace(stage / "bchain.npy.tmp.npy", bpath)
+    apath = stage / "adapt.npz"
+    if apath.exists():
+        with np.load(apath) as z:
+            d = {k: z[k] for k in z.files}
+        if "b" in d:
+            b = np.asarray(d["b"])
+            nb = np.zeros((p_new, b_new), b.dtype)
+            nb[:p_old, :b_old] = b.reshape(p_old, b_old)
+            d["b"] = nb
+        np.savez(stage / "adapt.npz.tmp.npz", **d)
+        os.replace(stage / "adapt.npz.tmp.npz", apath)
+    bnames = [f"b_p{p}_c{j}" for p in range(p_new) for j in range(b_new)]
+    (stage / "pars_bchain.txt").write_text("\n".join(bnames) + "\n")

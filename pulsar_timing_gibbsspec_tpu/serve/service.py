@@ -75,12 +75,20 @@ import numpy as np
 
 from ..obs import trace as otrace
 from ..runtime import faults, preemption, supervisor, telemetry
-from .buckets import BucketOverflow, BucketTable, probe_shape
+from .buckets import (BucketOverflow, BucketSpec, BucketTable,
+                      plan_migration, probe_shape)
 from .engine import ProgramCache, compile_bucket, stack_cms
-from .jobs import Job
+from .jobs import Job, MigrationTicket, repad_checkpoint
 
 #: tenant index of the inert filler stream (far above any real tenant)
 FILLER_TENANT = 0x7FFFFFFF
+
+#: fold-salt for standing-model generations: a child generation's key
+#: is ``fold_in(tenant_key, _GEN_SALT + generation)`` so generation g
+#: of tenant t never collides with iteration folds (small ints) or
+#: with another tenant's stream; generation 0 folds nothing (bitwise
+#: backward compatibility with every pre-lineage checkpoint)
+_GEN_SALT = 0x67656E
 
 
 class SamplerService:
@@ -199,10 +207,12 @@ class SamplerService:
     # -- request intake -----------------------------------------------------
 
     def submit(self, pta, niter, job_id=None, tenant_id=None,
-               outdir=None) -> Job:
+               outdir=None, generation=0, lineage=None) -> Job:
         """Queue an analysis request.  ``tenant_id`` (with the service
-        seed) IS the PRNG identity — pass the original value to readmit
-        a job in a fresh process, or leave None for a new stream.
+        seed, and the ``generation`` counter for forked standing-model
+        generations) IS the PRNG identity — pass the original values to
+        readmit a job in a fresh process, or leave None for a new
+        stream.
 
         Raises :class:`~..runtime.supervisor.CircuitOpen` when admission
         control rejects on queue-depth backpressure, or when the
@@ -223,7 +233,9 @@ class SamplerService:
         if outdir is None:
             outdir = self.root / job_id
         job = Job(job_id=job_id, pta=pta, niter=int(niter),
-                  tenant_id=int(tenant_id), outdir=str(outdir))
+                  tenant_id=int(tenant_id), outdir=str(outdir),
+                  generation=int(generation),
+                  lineage=dict(lineage) if lineage else None)
         self.jobs[job_id] = job
         self.queue.append(job)
         telemetry.gauge("queue_depth", float(len(self.queue)))
@@ -236,22 +248,33 @@ class SamplerService:
 
         return jr.key(self.service_seed)
 
-    def _tenant_key(self, tenant_id):
+    def _tenant_key(self, tenant_id, generation=0):
+        """Tenant base key; a forked standing-model generation folds
+        its counter (salted, so generation 1 never collides with a
+        sibling tenant id) on top.  Generation 0 keeps the historical
+        key exactly — every pre-lineage checkpoint replays bitwise."""
         import jax.random as jr
 
-        return jr.fold_in(self._service_key(), int(tenant_id))
+        k = jr.fold_in(self._service_key(), int(tenant_id))
+        if int(generation):
+            k = jr.fold_in(k, _GEN_SALT + int(generation))
+        return k
 
-    def _init_key(self, tenant_id):
+    def _init_key(self, tenant_id, generation=0):
         """Reserved iteration-0 key for the fresh-tenant b draw."""
         import jax.random as jr
 
-        return jr.fold_in(jr.fold_in(self._tenant_key(tenant_id), 0), 0)
+        return jr.fold_in(
+            jr.fold_in(self._tenant_key(tenant_id, generation), 0), 0)
 
     def _x0(self, job) -> np.ndarray:
-        """Deterministic per-(service_seed, tenant) initial state — part
-        of the stream identity, so solo and multiplexed runs agree."""
-        rng = np.random.default_rng([self.service_seed,
-                                     int(job.tenant_id)])
+        """Deterministic per-(service_seed, tenant, generation) initial
+        state — part of the stream identity, so solo and multiplexed
+        runs agree."""
+        seq = [self.service_seed, int(job.tenant_id)]
+        if int(job.generation):
+            seq.append(_GEN_SALT + int(job.generation))
+        rng = np.random.default_rng(seq)
         return np.asarray(job.pta.initial_sample(rng), np.float64)
 
     # -- admission / eviction ----------------------------------------------
@@ -316,7 +339,7 @@ class SamplerService:
                 with guards.planned_compile():
                     b = self.cache.init_fn()(
                         cm, jnp.asarray(job.x, cm.cdtype),
-                        self._init_key(job.tenant_id))
+                        self._init_key(job.tenant_id, job.generation))
                 job.b = np.asarray(b, np.float64)
         job.chunks_resident = 0
         job.admitted_at = time.monotonic()
@@ -488,7 +511,8 @@ class SamplerService:
                 cms.append(job.cm)
                 X.append(job.x)
                 B.append(job.b)
-                K.append(self._tenant_key(job.tenant_id))
+                K.append(self._tenant_key(job.tenant_id,
+                                          job.generation))
             else:
                 cms.append(canon)
                 X.append(fx)
@@ -654,7 +678,7 @@ class SamplerService:
                 with guards.planned_compile():
                     b = self.cache.init_fn()(
                         job.cm, jnp.asarray(job.x, job.cm.cdtype),
-                        self._init_key(job.tenant_id))
+                        self._init_key(job.tenant_id, job.generation))
                 job.b = np.asarray(b, np.float64)
         self._dirty = True
 
@@ -791,6 +815,123 @@ class SamplerService:
                 integrity.rollback(job.store.outdir)
             job.set_state("queued")     # resumable, not failed
         return True
+
+    def append_job(self, pta, niter, *, parent_id=None,
+                   parent_outdir=None, job_id=None, outdir=None,
+                   dataset_sha256=None, journaled=False) -> Job:
+        """Standing-model append: supersede a parent job with a child
+        generation warm-started from its verified checkpoint lineage.
+
+        ``pta`` is the GROWN dataset's model (same pulsars, same mode
+        count — only the TOA/basis axes may have grown; anything else
+        is a typed refusal from the migration planner).  The fork
+        source is the newest VERIFIED generation at or above the
+        parent's checkpoint dir (``lineage.resolve_verified`` — a
+        corrupted parent degrades to its newest verified ancestor), the
+        child is re-keyed by generation so streams never cross, and the
+        whole operation is idempotent: a replay finds the forked child
+        on disk (or the already-registered job) and just returns it.
+
+        A live parent is drained through its verified checkpoint first
+        and parked dormant (the supersede pattern — it never re-enters
+        the queue); terminal parents fork from whatever their directory
+        holds.  ``journaled=True`` tells the migration ticket the
+        caller (the gateway) made the forking intent durable before
+        calling — the service-level path goes planned → forked
+        directly.  Raises :class:`~.buckets.BucketOverflow` (hint
+        attached) when no bucket covers the grown shape, and
+        :class:`~..runtime.lineage.LineageError` when no generation of
+        the parent verifies.
+        """
+        from ..runtime import lineage
+
+        parent = self.jobs.get(parent_id) if parent_id else None
+        if parent_outdir is None:
+            if parent is None:
+                raise ValueError(
+                    f"append_job: unknown parent job {parent_id!r} and "
+                    "no parent_outdir given")
+            parent_outdir = parent.outdir
+        if job_id is None:
+            job_id = f"job{len(self.jobs):04d}"
+        existing = self.jobs.get(job_id)
+        if existing is not None:
+            return existing         # replayed append: one child job
+        if parent is not None and parent.state not in ("done", "failed"):
+            self.drain_job(parent_id, reason="superseded")
+        src, lin_report = lineage.resolve_verified(parent_outdir)
+        src_man = lineage.read_manifest(src)
+        pserve = src_man.get("serve") or {}
+        parent_gen = int((src_man.get("lineage") or {})
+                         .get("generation", 0))
+        generation = parent_gen + 1
+        tenant_id = int(pserve.get("tenant_id",
+                                   parent.tenant_id if parent else 0))
+        if pserve.get("bucket"):
+            pbucket = BucketSpec(*(int(v) for v in pserve["bucket"]))
+        elif parent is not None and parent.bucket is not None:
+            pbucket = parent.bucket
+        else:
+            raise lineage.LineageError(
+                f"{src}: checkpoint records no bucket (serve section "
+                "missing) — cannot plan a migration from it")
+        retained = int(src_man.get("rows", 0))
+        if int(niter) < retained:
+            raise ValueError(
+                f"append_job: child niter {int(niter)} is below the "
+                f"parent's {retained} retained rows — the child "
+                "continues the parent, it cannot un-record rows")
+        shape = probe_shape(pta)
+        plan = plan_migration(self.table, pbucket, shape)
+        ticket = MigrationTicket(job_id, plan=plan)
+        if journaled:
+            ticket.journaled()
+        if outdir is None:
+            outdir = self.root / job_id
+        try:
+            transform = None
+            if not plan.in_place:
+                p_old, _, b_old, _ = plan.parent_bucket.as_tuple()
+                p_new, _, b_new, _ = plan.child_bucket.as_tuple()
+
+                def transform(stage, _man):
+                    repad_checkpoint(stage, p_old, b_old, p_new, b_new)
+
+            child_man = lineage.fork_generation(
+                src, outdir,
+                dataset_sha256=dataset_sha256,
+                bucket=plan.child_bucket.as_tuple(),
+                serve_extra={"serve": {
+                    "job_id": job_id,
+                    "tenant_id": tenant_id,
+                    "niter": int(niter),
+                    "bucket": list(plan.child_bucket.as_tuple()),
+                    "state": "queued",
+                    "generation": generation,
+                    "pulsars": [str(p) for p in pta.pulsars],
+                }},
+                transform=transform,
+                adapt_overrides={
+                    "generation": np.asarray(generation, np.int64)})
+            ticket.forked()
+            faults.fire("migrate.pre_readmit", row=retained,
+                        outdir=outdir)
+            child = self.submit(pta, int(niter), job_id=job_id,
+                                tenant_id=tenant_id, outdir=outdir,
+                                generation=generation,
+                                lineage=child_man.get("lineage"))
+            child.bucket = plan.child_bucket
+            ticket.readmitted()
+        except Exception:
+            ticket.abort()
+            raise
+        telemetry.incr("migrations")
+        otrace.instant("serve.append_job", job=job_id,
+                       parent=str(parent_id or parent_outdir),
+                       generation=generation, kind=plan.kind,
+                       retained=retained,
+                       degraded=int(len(lin_report) > 1))
+        return child
 
     def step_supervised(self, defer_backoff=False) -> bool:
         """One scheduling round under the recovery ladder: runs

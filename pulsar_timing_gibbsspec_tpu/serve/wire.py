@@ -59,6 +59,8 @@ ERROR_STATUS = {
     "NOT_FOUND": 404,
     "DEDUPE_MISMATCH": 409,
     "STREAM_CROSSING": 409,
+    "SUPERSEDED": 409,
+    "LINEAGE_UNRESOLVED": 409,
     "PAYLOAD_TOO_LARGE": 413,
     "BUCKET_OVERFLOW": 422,
     "QUEUE_FULL": 429,
@@ -109,6 +111,7 @@ def classify_exception(exc) -> WireError:
     the queue drains vs. wait out THIS tenant's cooldown — so the code
     split here keys on the attached breaker, which backpressure
     rejections do not carry."""
+    from ..runtime.lineage import LineageError
     from ..runtime.supervisor import CircuitOpen
     from .buckets import BucketOverflow
 
@@ -116,6 +119,11 @@ def classify_exception(exc) -> WireError:
         return exc
     if isinstance(exc, BucketOverflow):
         return WireError("BUCKET_OVERFLOW", str(exc))
+    if isinstance(exc, LineageError):
+        # an append whose parent has NO verified generation: the client
+        # cannot fix it by retrying the same request — the parent needs
+        # rows on disk (or an operator) first
+        return WireError("LINEAGE_UNRESOLVED", str(exc))
     if isinstance(exc, CircuitOpen):
         if getattr(exc, "breaker", None) is None:
             return WireError("QUEUE_FULL", str(exc))

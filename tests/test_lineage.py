@@ -1,0 +1,518 @@
+"""Standing-model lifecycle: checkpoint lineage, append-TOAs migration.
+
+The fast tier pins everything that does not need a compiled sampler:
+the lineage hash chain (fork / walk / verify / degrade-to-ancestor),
+the typed layout refusal naming the FIRST mismatched pulsar, the
+migration planner's refusals and the ``BucketOverflow`` hint, the
+``MigrationTicket`` state machine (audited by racecheck), the
+journal's per-entry ``schema_version`` refusal, and the ``/v1/append``
+wire validation (hostile input binds nothing).
+
+The ``slow``-marked tests compile samplers: the facade fork under
+``record_every`` thinning stays bitwise; a service-level in-bucket
+append keeps the retained prefix bitwise; a cross-bucket migration's
+continuation is statistically indistinguishable from a cold run on the
+grown dataset (KS gate, same threshold as the backend-parity gates);
+the gateway append replays idempotently across a seam kill and a
+restart; and ``tools/chaos_probe.py --scenario append`` holds its
+contract end to end.
+"""
+
+import json
+import types
+
+import numpy as np
+import pytest
+
+NITER = 12
+
+
+def _chainstore(outdir, rows=6, nx=3, nb=4, extra=None, seed=0):
+    """A minimal verified checkpoint set (no sampler needed)."""
+    from pulsar_timing_gibbsspec_tpu.sampler.chains import ChainStore
+
+    rng = np.random.default_rng(seed)
+    store = ChainStore(outdir, [f"p{i}" for i in range(nx)],
+                       [f"b{i}" for i in range(nb)])
+    chain = rng.standard_normal((rows, nx))
+    bchain = rng.standard_normal((rows, nb))
+    adapt = {"x": chain[-1], "b": bchain[-1].reshape(2, 2),
+             "tenant_id": np.asarray(0, np.int64)}
+    store.save(chain, bchain, rows, adapt_state=adapt, extra=extra or {})
+    return chain, bchain
+
+
+# -- lineage hash chain ---------------------------------------------------
+
+def test_fork_walk_verify_and_degrade_to_ancestor(tmp_path):
+    """fork_generation chains the child to the parent's manifest hash;
+    a severed link degrades resolution to the newest verified ancestor;
+    a fully broken chain refuses typed with the per-generation report."""
+    from pulsar_timing_gibbsspec_tpu.runtime import faults, lineage
+
+    parent, child = tmp_path / "gen0", tmp_path / "gen1"
+    _chainstore(parent, extra={"layout": {"pulsars": ["A", "B"]}})
+    man = lineage.fork_generation(parent, child,
+                                  dataset_sha256="d" * 64,
+                                  bucket=(2, 40, 24, 3))
+    lin = man["lineage"]
+    assert lin["generation"] == 1 and lin["parent_dir"] == str(parent)
+    assert lin["dataset_sha256"] == "d" * 64
+    assert lineage.verify_generation(child)["ok"]
+    # the parent's extras ride along (nothing silently dropped)
+    assert man["layout"]["pulsars"] == ["A", "B"]
+
+    # idempotent: a second fork from the same parent state is a no-op
+    man2 = lineage.fork_generation(parent, child)
+    assert man2["lineage"]["parent_manifest_sha256"] == \
+        lin["parent_manifest_sha256"]
+
+    ancestry = lineage.walk(child)
+    assert [a["generation"] for a in ancestry] == [1, 0]
+    resolved, report = lineage.resolve_verified(child)
+    assert str(resolved) == str(child) and report[0]["ok"]
+
+    # sever the hash chain (both manifests, so .bak cannot heal it):
+    # resolution degrades to the verified parent, typed report attached
+    faults._corrupt_lineage(child)
+    degraded, report = lineage.resolve_verified(child)
+    assert str(degraded) == str(parent)
+    assert [(r["generation"], r["ok"]) for r in report] == \
+        [(1, False), (0, True)]
+    assert "hash chain broken" in report[0]["why"]
+
+    # break the ancestor too: LineageError carries the walk report
+    for name in ("manifest.json", "manifest.bak.json"):
+        p = parent / name
+        if p.exists():
+            p.write_text("{broken")
+    with pytest.raises(lineage.LineageError) as ei:
+        lineage.resolve_verified(child)
+    assert len(ei.value.report) == 2
+    assert not any(r["ok"] for r in ei.value.report)
+
+
+def test_fork_tolerates_pruned_ancestor(tmp_path):
+    """A deleted parent directory is a pruned ancestor: the child's
+    linkage still verifies (the chain is only as long as what's kept)."""
+    import shutil
+
+    from pulsar_timing_gibbsspec_tpu.runtime import lineage
+
+    parent, child = tmp_path / "gen0", tmp_path / "gen1"
+    _chainstore(parent)
+    lineage.fork_generation(parent, child)
+    shutil.rmtree(parent)
+    rep = lineage.verify_generation(child)
+    assert rep["ok"] and rep["generation"] == 1
+    resolved, _ = lineage.resolve_verified(child)
+    assert str(resolved) == str(child)
+
+
+# -- typed layout refusal (S1) --------------------------------------------
+
+def test_layout_mismatch_names_first_mismatched_pulsar(tmp_path):
+    from pulsar_timing_gibbsspec_tpu.runtime.integrity import (
+        LayoutMismatch, check_layout_pulsars)
+
+    # first mismatch wins, by index and by name
+    with pytest.raises(LayoutMismatch) as ei:
+        check_layout_pulsars(tmp_path, ["A", "B", "C"], ["A", "X", "C"])
+    err = ei.value
+    assert (err.index, err.expected, err.got) == (1, "B", "X")
+    assert "pulsar order mismatch at index 1" in str(err)
+    assert "'B'" in str(err) and "'X'" in str(err)
+
+    # a strict-prefix PTA refuses at the boundary
+    with pytest.raises(LayoutMismatch) as ei:
+        check_layout_pulsars(tmp_path, ["A", "B"], ["A"])
+    assert ei.value.index == 1 and ei.value.got == "<none>"
+
+    # equal layouts and layout-less checkpoints pass
+    check_layout_pulsars(tmp_path, ["A", "B"], ["A", "B"])
+    check_layout_pulsars(tmp_path, [], ["A", "B"])
+
+
+def test_load_resume_refuses_layout_disagreement(tmp_path):
+    from pulsar_timing_gibbsspec_tpu.runtime import integrity
+
+    _chainstore(tmp_path / "ck",
+                extra={"layout": {"pulsars": ["PSR0", "PSR1"]}})
+    pta = types.SimpleNamespace(pulsars=["PSR0", "OTHER"])
+    with pytest.raises(integrity.LayoutMismatch) as ei:
+        integrity.load_resume(tmp_path / "ck", pta=pta)
+    assert (ei.value.index, ei.value.expected, ei.value.got) == \
+        (1, "PSR1", "OTHER")
+    # matching layout loads fine
+    got = integrity.load_resume(
+        tmp_path / "ck", pta=types.SimpleNamespace(
+            pulsars=["PSR0", "PSR1"]))
+    assert got is not None and got[2] == 6
+
+
+# -- migration planner + overflow hint (S2) -------------------------------
+
+def test_bucket_overflow_hint_names_covering_bucket():
+    from pulsar_timing_gibbsspec_tpu.serve.buckets import (
+        BucketOverflow, BucketSpec, BucketTable, DatasetShape,
+        next_covering)
+
+    table = BucketTable([BucketSpec(2, 40, 24, 3)])
+    shape = DatasetShape(2, 99, 24, 3)
+    with pytest.raises(BucketOverflow) as ei:
+        table.route(shape)
+    exc = ei.value
+    assert "migration hint" in str(exc)
+    hint = exc.hint
+    assert hint.covers(shape)
+    assert str(hint.as_tuple()) in str(exc)
+    # axis-doubling from the nearest base, modes copied exactly
+    assert next_covering(shape, base=BucketSpec(2, 40, 24, 3)).modes == 3
+
+
+def test_plan_migration_kinds_and_typed_refusals():
+    from pulsar_timing_gibbsspec_tpu.serve.buckets import (
+        BucketSpec, BucketTable, DatasetShape, plan_migration)
+
+    table = BucketTable([BucketSpec(2, 40, 24, 3),
+                         BucketSpec(2, 64, 32, 3)])
+    parent = table.buckets[0]
+    grown_in = DatasetShape(2, 38, 24, 3)
+    grown_out = DatasetShape(2, 50, 24, 3)
+
+    plan = plan_migration(table, parent, grown_in)
+    assert plan.in_place and plan.child_bucket is parent
+
+    plan = plan_migration(table, parent, grown_out)
+    assert not plan.in_place
+    assert plan.child_bucket.as_tuple() == (2, 64, 32, 3)
+
+    # parameter-space changes are NOT migrations: typed refusals
+    with pytest.raises(ValueError, match="mode count"):
+        plan_migration(table, parent, DatasetShape(2, 38, 24, 4))
+    with pytest.raises(ValueError, match="pulsar"):
+        plan_migration(table, parent, DatasetShape(3, 38, 24, 3))
+
+
+# -- migration state machine (racecheck M1-M3) ----------------------------
+
+def test_migration_ticket_state_machine():
+    from pulsar_timing_gibbsspec_tpu.serve.jobs import (
+        MIGRATION_STATES, MigrationTicket)
+
+    t = MigrationTicket("j")
+    assert t.state == "planned" and t.state in MIGRATION_STATES
+    t.journaled()
+    assert t.state == "journaled"
+    t.forked()
+    assert t.state == "forked"
+    t.journaled()                       # illegal: forked stays forked
+    assert t.state == "forked"
+    t.readmitted()
+    assert t.state == "readmitted"
+    t.abort()                           # readmitted is final
+    assert t.state == "readmitted"
+
+    t2 = MigrationTicket("k")
+    t2.forked()                         # service path: no journal leg
+    assert t2.state == "forked"
+    t2.abort()
+    assert t2.state == "aborted"
+    t2.readmitted()                     # aborted is final
+    assert t2.state == "aborted"
+
+
+# -- journal entry schema_version (S3) ------------------------------------
+
+def _table():
+    from pulsar_timing_gibbsspec_tpu.serve.buckets import (BucketSpec,
+                                                           BucketTable)
+
+    return BucketTable([BucketSpec(2, 40, 24, 3),
+                        BucketSpec(2, 64, 32, 3)])
+
+
+def test_journal_refuses_unknown_entry_schema(tmp_path):
+    from pulsar_timing_gibbsspec_tpu.runtime.integrity import CheckpointError
+    from pulsar_timing_gibbsspec_tpu.serve.gateway import Gateway
+
+    gw = Gateway(tmp_path / "gw", _table())
+    with gw._cond:
+        gw._entries["k0"] = {
+            "job_id": "g00000", "tenant_id": 0, "niter": 4,
+            "payload": {"synthetic": {}}, "payload_sha256": "0" * 64,
+            "outdir": str(tmp_path / "gw" / "jobs" / "g00000"),
+            "dedupe_key": "k0", "state": "done",
+            "deadline_unix": None, "schema_version": 99}
+        gw._write_journal()
+    with pytest.raises(CheckpointError) as ei:
+        Gateway(tmp_path / "gw", _table())
+    msg = str(ei.value)
+    assert "schema_version" in msg and "99" in msg and "k0" in msg
+
+    # a version-1 entry (and a version-less pre-field entry) both load
+    with gw._cond:
+        gw._entries["k0"]["schema_version"] = 1
+        gw._entries["k1"] = dict(gw._entries["k0"], dedupe_key="k1",
+                                 job_id="g00001")
+        del gw._entries["k1"]["schema_version"]
+        gw._write_journal()
+    gw2 = Gateway(tmp_path / "gw", _table())
+    assert set(gw2._entries) == {"k0", "k1"}
+
+
+# -- /v1/append wire validation (fast: every path refuses pre-build) ------
+
+def test_append_wire_validation_binds_nothing(tmp_path):
+    from pulsar_timing_gibbsspec_tpu.runtime import faults
+    from pulsar_timing_gibbsspec_tpu.serve.gateway import Gateway
+    from pulsar_timing_gibbsspec_tpu.serve.wire import WireRequest
+
+    gw = Gateway(tmp_path / "gw", _table())
+
+    def post(doc):
+        return gw.handle(WireRequest(
+            "POST", "/v1/append", {}, {}, json.dumps(doc).encode()))
+
+    ok = {"dedupe_key": "apd", "parent": "par",
+          "append": {"add": 8, "seed": 1}, "niter": NITER}
+    assert post({**ok, "append": 7}).body["error"] == "BAD_REQUEST"
+    assert post({**ok, "niter": 0}).body["error"] == "BAD_REQUEST"
+    resp = post(ok)                       # unknown parent dedupe key
+    assert resp.status == 404 and resp.body["error"] == "NOT_FOUND"
+
+    # the drain race refuses typed BEFORE touching the journal
+    faults.clear()
+    faults.inject("append_during_drain", point="gateway.append", times=1)
+    try:
+        resp = post(ok)
+    finally:
+        faults.clear()
+    assert resp.status == 503 and resp.body["error"] == "DRAINING"
+    assert gw._entries == {} and gw.svc.jobs == {}
+
+
+# -- compiled tiers -------------------------------------------------------
+
+def _synth(n_psr=2, ntoa=24, seed=0, nmodes=3):
+    from pulsar_timing_gibbsspec_tpu.analysis.jaxprcheck.entries import (
+        build_model, synthetic_pulsars)
+
+    psrs = synthetic_pulsars(n_psr, ntoa, tm_cols=3, seed=seed)
+    return psrs, build_model(psrs, nmodes)
+
+
+@pytest.mark.slow
+def test_facade_fork_record_every_prefix_bitwise(tmp_path):
+    """An in-bucket fork of a thinned run (``record_every=2``) copies
+    the adapt carries bitwise — the resumed child continues exactly the
+    stream an uninterrupted run would have produced (S4)."""
+    from pulsar_timing_gibbsspec_tpu.runtime import lineage
+    from pulsar_timing_gibbsspec_tpu.sampler.gibbs import PTABlockGibbs
+
+    _, pta = _synth(ntoa=20)
+    x0 = pta.initial_sample(np.random.default_rng(0))
+    kw = dict(backend="jax", seed=7, progress=False, warmup_sweeps=2,
+              chunk_size=4, record_every=2)
+
+    ref = PTABlockGibbs(pta, **kw).sample(
+        x0, outdir=tmp_path / "ref", niter=32, save_every=4)
+    PTABlockGibbs(pta, **kw).sample(
+        x0, outdir=tmp_path / "gen0", niter=16, save_every=4)
+
+    lineage.fork_generation(tmp_path / "gen0", tmp_path / "gen1")
+    chain = PTABlockGibbs(pta, **kw).sample(
+        x0, outdir=tmp_path / "gen1", niter=32, resume=True,
+        save_every=4)
+    assert np.array_equal(chain, ref)
+
+    # the fork carried record_every: a mismatched resume still refuses
+    bad = PTABlockGibbs(pta, **{**kw, "record_every": 1})
+    with pytest.raises(Exception, match="record_every"):
+        bad.sample(x0, outdir=tmp_path / "gen1", niter=32, resume=True,
+                   save_every=4)
+
+
+@pytest.mark.slow
+def test_service_inplace_append_bitwise_prefix(tmp_path):
+    """A grown dataset that still fits the parent's bucket resumes in
+    place: retained rows bitwise, child re-keyed to generation 1, and
+    the whole append is idempotent at the service layer."""
+    from pulsar_timing_gibbsspec_tpu.serve import (BucketSpec,
+                                                   BucketTable,
+                                                   SamplerService)
+
+    psrs, pta = _synth()
+    grown = _grown_model(psrs, add=8)                 # ntoa 32 <= 40
+    table = BucketTable([BucketSpec(2, 40, 24, 3)])
+    svc = SamplerService(tmp_path, table, slots=2, chunk=4, save_every=1)
+    parent = svc.submit(pta, NITER, job_id="parent", tenant_id=0)
+    svc.run()
+    assert parent.state == "done"
+
+    child = svc.append_job(grown, 2 * NITER, parent_id="parent",
+                           job_id="child", outdir=tmp_path / "child")
+    assert child.generation == 1
+    assert svc.append_job(grown, 2 * NITER, parent_id="parent",
+                          job_id="child",
+                          outdir=tmp_path / "child") is child
+    svc.run()
+    assert child.state == "done"
+    assert np.array_equal(child.chain[:NITER], parent.chain[:NITER])
+    assert np.array_equal(
+        np.load(tmp_path / "child" / "chain.npy")[:NITER],
+        np.load(tmp_path / "parent" / "chain.npy"))
+    # past the prefix the child's stream is generation-keyed: it must
+    # NOT continue the parent's generation-0 stream
+    solo = SamplerService(tmp_path / "solo",
+                          BucketTable([BucketSpec(2, 40, 24, 3)]),
+                          slots=2, chunk=4, save_every=1)
+    cold = solo.submit(grown, 2 * NITER, job_id="cold", tenant_id=0)
+    solo.run()
+    assert not np.array_equal(child.chain[NITER:], cold.chain[NITER:])
+
+
+def _grown_model(psrs, add):
+    from pulsar_timing_gibbsspec_tpu.analysis.jaxprcheck.entries import (
+        build_model)
+    from pulsar_timing_gibbsspec_tpu.data import append_polynomial_toas
+
+    return build_model(append_polynomial_toas(psrs, add, seed=5), 3)
+
+
+@pytest.mark.slow
+def test_cross_bucket_append_ks_vs_cold(tmp_path):
+    """A re-bucketed warm start samples the same posterior as a cold
+    run on the grown dataset: retained prefix bitwise through the
+    re-pad, continuation KS-indistinguishable (p > 1e-4 per column,
+    the backend-parity threshold and burn/thin discipline of
+    ``test_jax_vs_numpy_posterior_ks``).  Gated on the conjugate
+    ``log10_rho`` columns — the EFAC/EQUAD random walks need the
+    white-vary gate's much larger sample budget to KS-compare even two
+    independent COLD runs."""
+    from scipy import stats
+
+    from pulsar_timing_gibbsspec_tpu.serve import (BucketSpec,
+                                                   BucketTable,
+                                                   SamplerService)
+
+    psrs, pta = _synth()
+    grown = _grown_model(psrs, add=24)                # ntoa 48 > 40
+    table = BucketTable([BucketSpec(2, 40, 24, 3),
+                         BucketSpec(2, 64, 32, 3)])
+    niter, total, burn, thin = 400, 2000, 600, 5
+    svc = SamplerService(tmp_path, table, slots=2, chunk=16,
+                         save_every=5)
+    parent = svc.submit(pta, niter, job_id="parent", tenant_id=0)
+    svc.run()
+    assert parent.state == "done"
+
+    child = svc.append_job(grown, total, parent_id="parent",
+                           job_id="child", outdir=tmp_path / "child")
+    svc.run()
+    assert child.state == "done"
+    assert tuple(child.bucket.as_tuple()) == (2, 64, 32, 3)
+    assert np.array_equal(child.chain[:niter], parent.chain[:niter])
+
+    cold = svc.submit(grown, total, job_id="cold", tenant_id=7)
+    svc.run()
+    assert cold.state == "done"
+
+    cols = [k for k, name in enumerate(grown.param_names)
+            if "log10_rho" in name]
+    assert len(cols) >= 6                 # per-pulsar red + common rho
+    warm = np.asarray(child.chain[burn:total:thin], np.float64)
+    ref = np.asarray(cold.chain[burn:total:thin], np.float64)
+    pvals = [stats.ks_2samp(warm[:, k], ref[:, k]).pvalue
+             for k in cols]
+    assert min(pvals) > 1e-4, pvals
+    assert np.median(pvals) > 0.05, pvals
+
+
+@pytest.mark.slow
+def test_gateway_append_replay_and_seam_kill(tmp_path):
+    """/v1/append through ``Gateway.handle``: idempotent replay, the
+    parent superseded (409 on a second append), a changed replay is a
+    DEDUPE_MISMATCH, and a kill at the re-pad seam recovers through a
+    restart + replay onto the ORIGINAL handle — never a torn child."""
+    from pulsar_timing_gibbsspec_tpu.runtime import faults
+    from pulsar_timing_gibbsspec_tpu.serve.gateway import Gateway
+    from pulsar_timing_gibbsspec_tpu.serve.wire import WireRequest
+
+    payload = {"synthetic": {"n_psr": 2, "ntoa": 24, "tm_cols": 3,
+                             "seed": 0, "nmodes": 3}}
+    apd = {"dedupe_key": "apd", "parent": "par",
+           "append": {"add": 20, "seed": 7}, "niter": 2 * NITER}
+
+    def post(gw, path, doc):
+        return gw.handle(WireRequest("POST", path, {}, {},
+                                     json.dumps(doc).encode()))
+
+    gw = Gateway(tmp_path / "gw", _table(),
+                 svc_kw={"slots": 2, "chunk": 4, "save_every": 1})
+    h = post(gw, "/v1/jobs", {"dedupe_key": "par", "payload": payload,
+                              "niter": NITER}).body
+    gw.svc.run()
+
+    # seam kill: the append dies typed, the child dir is never torn
+    faults.clear()
+    faults.inject("kill_mid_migration", point="migrate.mid_repad",
+                  times=1)
+    try:
+        resp = post(gw, "/v1/append", apd)
+    finally:
+        faults.clear()
+    assert resp.status == 500
+    ents = gw.report()["entries"]
+    assert ents["apd"]["state"] == "forking"
+    assert not (tmp_path / "gw" / "jobs" / ents["apd"]["job_id"]
+                / "manifest.json").exists()
+
+    # restart: the journaled forking intent re-materializes, and the
+    # client's replay resolves to the ORIGINAL new-generation handle
+    gw2 = Gateway(tmp_path / "gw", _table(),
+                  svc_kw={"slots": 2, "chunk": 4, "save_every": 1})
+    resp = post(gw2, "/v1/append", apd)
+    assert resp.status == 200 and resp.body["replayed"]
+    assert resp.body["job_id"] == ents["apd"]["job_id"]
+    assert resp.body["generation"] == 1
+    assert resp.body["parent_job_id"] == h["job_id"]
+    gw2.svc.run()
+
+    st = gw2.handle(WireRequest(
+        "GET", f"/v1/jobs/{resp.body['job_id']}", {}, {})).body
+    assert st["state"] == "done"
+    ents = gw2.report()["entries"]
+    assert ents["par"]["state"] == "superseded"
+    assert ents["par"]["superseded_by"] == resp.body["job_id"]
+    # retained prefix bitwise across kill + restart + re-bucket
+    pdir = tmp_path / "gw" / "jobs" / h["job_id"]
+    cdir = tmp_path / "gw" / "jobs" / resp.body["job_id"]
+    assert np.array_equal(np.load(cdir / "chain.npy")[:NITER],
+                          np.load(pdir / "chain.npy"))
+
+    # the superseded parent refuses further appends, typed
+    resp = post(gw2, "/v1/append", {**apd, "dedupe_key": "apd2"})
+    assert resp.status == 409 and resp.body["error"] == "SUPERSEDED"
+    # a replayed key with a different body is a DEDUPE_MISMATCH
+    resp = post(gw2, "/v1/append", {**apd, "niter": 3 * NITER})
+    assert resp.status == 409 and resp.body["error"] == "DEDUPE_MISMATCH"
+
+
+@pytest.mark.slow
+def test_chaos_probe_append_scenario(tmp_path):
+    """The packaged drill holds its contract (S4): kill at the re-pad
+    seam, idempotent re-fork, bitwise prefix, degrade-to-ancestor."""
+    import importlib.util
+    from pathlib import Path
+
+    spec = importlib.util.spec_from_file_location(
+        "chaos_probe", Path(__file__).resolve().parents[1]
+        / "tools" / "chaos_probe.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    args = types.SimpleNamespace(niter=NITER, save_every=4, at_row=6)
+    ok, detail = mod.scenario_append(args, tmp_path / "probe")
+    assert ok, detail
+    assert detail["prefix_bitwise"] and detail["torn_free_after_kill"]
+    assert detail["degrade_report"] == [(1, False), (0, True)]

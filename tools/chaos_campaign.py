@@ -24,6 +24,12 @@ shared bucket:
                    window (full campaign only — its compile is a
                    one-time cost across the whole campaign)
 
+Two deterministic transport legs run after the seeds in EVERY mode:
+the gateway kill/restart/reattach drill (``_gateway_drill``) and the
+standing-model append/migration drill (``_append_drill``) — a kill at
+any migration seam must recover to the parent or the child generation,
+never a torn hybrid, with co-residents bitwise untouched.
+
 Invariants checked after EVERY seed:
 
 1. every job reaches ``done`` and its chain/bchain is bitwise equal to
@@ -561,6 +567,222 @@ def _gateway_drill(root, cache):
     return fails
 
 
+def _append_drill(root, cache):
+    """The standing-model leg: an append-TOAs migration driven through
+    the gateway core, killed at EVERY migration seam in turn.
+
+    Per seam (``migrate.pre_journal`` / ``post_journal`` / ``mid_repad``
+    / ``pre_readmit``): a parent plus an untouched co-resident run to
+    done, the append is killed at the seam (HTTP 500), the gateway
+    drains gracefully, a fresh incarnation restarts from the journal,
+    and the client's dedupe-keyed replay lands on the ORIGINAL child
+    handle (or binds fresh when the kill preceded the journal write).
+    Asserts: the child completes at generation 1; the retained-row
+    prefix is **bitwise** the parent's chain through the re-bucketing;
+    the co-resident is bitwise its solo baseline (blast radius); the
+    parent entry is ``superseded``, every entry settled (zero orphaned
+    journal entries); zero unplanned steady retraces.  Then the race
+    and corruption legs: an append arriving during a drain refuses
+    typed (503, nothing bound), and a severed lineage hash chain
+    degrades resolution to the newest verified ancestor."""
+    from pulsar_timing_gibbsspec_tpu.profiling import recompile_counter
+    from pulsar_timing_gibbsspec_tpu.runtime import (faults, lineage,
+                                                     preemption)
+    from pulsar_timing_gibbsspec_tpu.serve.gateway import Gateway
+    from pulsar_timing_gibbsspec_tpu.serve.wire import WireRequest
+    import time
+
+    fails = []
+    svc_kw = dict(slots=2, chunk=4, quantum=100, save_every=1,
+                  cache=cache)
+    payload = {"synthetic": {"n_psr": 2, "ntoa": 24, "tm_cols": 3,
+                             "seed": 0, "nmodes": 3}}
+    co_payload = {"synthetic": {"n_psr": 2, "ntoa": 28, "tm_cols": 3,
+                                "seed": 1, "nmodes": 3}}
+    append_spec = {"add": 20, "seed": 7}    # ntoa 24 -> 44: rebucket
+    append_body = {"dedupe_key": "apd", "parent": "par",
+                   "append": append_spec, "niter": 2 * NITER}
+
+    def post(gw, path, body):
+        resp = gw.handle(WireRequest("POST", path, {}, {},
+                                     json.dumps(body).encode()))
+        return resp.status, resp.body or {}
+
+    def wait_entries(gw, want, deadline_s=60.0):
+        """Poll the journal until each dedupe key reaches its state."""
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < deadline_s:
+            ents = gw.report()["entries"]
+            if all(ents.get(k, {}).get("state") == s
+                   for k, s in want.items()):
+                return ents
+            time.sleep(0.02)
+        return gw.report()["entries"]
+
+    def shutdown(gw, tag):
+        preemption.request_drain(reason=f"append_drill_{tag}")
+        gw.join(timeout=30)
+        preemption.reset()
+        if gw.alive() or gw.state != "stopped":
+            fails.append(f"append[{tag}]: graceful drain did not park "
+                         f"the scheduler (state {gw.state!r})")
+
+    # solo ground truth for the co-resident (the gateway assigns
+    # tenant 1 to its second submission; streams are pure in
+    # (service_seed, tenant_id, iteration))
+    from pulsar_timing_gibbsspec_tpu.analysis.jaxprcheck.entries import (
+        build_model, synthetic_pulsars)
+
+    co_pta = build_model(synthetic_pulsars(2, 28, tm_cols=3, seed=1), 3)
+    co_svc = _service(root / "apsolo", cache, slots=2)
+    co_job = co_svc.submit(co_pta, NITER, job_id="apsolo", tenant_id=1)
+    co_svc.run()
+    if co_job.state != "done":
+        return [f"append: co-resident solo baseline failed "
+                f"({co_job.failure})"]
+    co_solo = co_job.chain.copy()
+
+    SEAMS = ("migrate.pre_journal", "migrate.post_journal",
+             "migrate.mid_repad", "migrate.pre_readmit")
+    preemption.reset()
+    faults.clear()
+    child_out = parent_out = None
+    try:
+        with recompile_counter() as rc:
+            rc.phase("steady")
+            for i, seam in enumerate(SEAMS):
+                r = root / f"ap{i}"
+                gw = Gateway(r, _table(), svc_kw=svc_kw,
+                             stop_when_idle=False).start()
+                st, _ = post(gw, "/v1/jobs", {
+                    "dedupe_key": "par", "payload": payload,
+                    "niter": NITER})
+                st2, _ = post(gw, "/v1/jobs", {
+                    "dedupe_key": "co", "payload": co_payload,
+                    "niter": NITER})
+                if st != 200 or st2 != 200:
+                    fails.append(f"append[{seam}]: submits HTTP "
+                                 f"{st}/{st2}")
+                    shutdown(gw, seam)
+                    continue
+                ents = wait_entries(gw, {"par": "done", "co": "done"})
+                if ents.get("par", {}).get("state") != "done":
+                    fails.append(f"append[{seam}]: parent never "
+                                 "finished")
+                    shutdown(gw, seam)
+                    continue
+
+                # kill at the seam: the append must die typed, binding
+                # either nothing or a journaled forking intent — never
+                # a torn child
+                faults.inject("kill_mid_migration", point=seam, times=1)
+                st, body = post(gw, "/v1/append", append_body)
+                faults.clear()
+                if st != 500:
+                    fails.append(f"append[{seam}]: seam kill returned "
+                                 f"HTTP {st}, expected 500")
+                shutdown(gw, f"{seam}_kill")
+
+                # fresh incarnation + the client's dedupe-keyed replay
+                gw2 = Gateway(r, _table(), svc_kw=svc_kw,
+                              stop_when_idle=False).start()
+                st, body = post(gw2, "/v1/append", append_body)
+                if st != 200:
+                    fails.append(f"append[{seam}]: replay after "
+                                 f"restart HTTP {st}: {body}")
+                    shutdown(gw2, seam)
+                    continue
+                want_replay = seam != "migrate.pre_journal"
+                if bool(body.get("replayed")) != want_replay:
+                    fails.append(
+                        f"append[{seam}]: replayed="
+                        f"{body.get('replayed')} (a kill "
+                        + ("after" if want_replay else "before")
+                        + " the journal write must "
+                        + ("replay the original handle"
+                           if want_replay else "bind fresh"))
+                if int(body.get("generation", -1)) != 1:
+                    fails.append(f"append[{seam}]: child generation "
+                                 f"{body.get('generation')}, not 1")
+                ents = wait_entries(gw2, {"apd": "done"})
+                if ents.get("apd", {}).get("state") != "done":
+                    fails.append(f"append[{seam}]: child never "
+                                 "finished after replay")
+                if ents.get("par", {}).get("state") != "superseded":
+                    fails.append(
+                        f"append[{seam}]: parent state "
+                        f"{ents.get('par', {}).get('state')!r}, "
+                        "not superseded")
+                orphans = {k: e["state"] for k, e in ents.items()
+                           if e["state"] not in ("done", "superseded")}
+                if orphans:
+                    fails.append(f"append[{seam}]: orphaned journal "
+                                 f"entries {orphans}")
+
+                parent_out = Path(ents["par"]["outdir"])
+                child_out = Path(ents["apd"]["outdir"])
+                pchain = np.load(parent_out / "chain.npy")
+                cchain = np.load(child_out / "chain.npy")
+                if not np.array_equal(cchain[:NITER], pchain):
+                    fails.append(f"append[{seam}]: retained prefix is "
+                                 "not bitwise through the migration")
+                co_chain = np.load(Path(ents["co"]["outdir"])
+                                   / "chain.npy")
+                if not np.array_equal(co_chain, co_solo):
+                    fails.append(f"append[{seam}]: co-resident "
+                                 "diverged from its solo baseline "
+                                 "(migration blast radius leaked)")
+
+                if seam == SEAMS[-1]:
+                    # the drain race: an append that arrives after the
+                    # drain began refuses typed, binding nothing
+                    faults.inject("append_during_drain",
+                                  point="gateway.append", times=1)
+                    st, body = post(gw2, "/v1/append", {
+                        "dedupe_key": "apd2", "parent": "apd",
+                        "append": {"add": 4, "seed": 9},
+                        "niter": 2 * NITER})
+                    faults.clear()
+                    if st != 503 or body.get("error") != "DRAINING":
+                        fails.append(
+                            f"append: append-during-drain got HTTP "
+                            f"{st} {body.get('error')!r}, want "
+                            "503 DRAINING")
+                    if "apd2" in gw2.report()["entries"]:
+                        fails.append("append: a refused drain-race "
+                                     "append was journaled anyway")
+                shutdown(gw2, seam)
+        unplanned = rc.unplanned("steady")
+        if unplanned:
+            fails.append(f"append: {unplanned} unplanned steady "
+                         "retrace(s) across the migration drills")
+    finally:
+        faults.clear()
+        preemption.reset()
+
+    # the corruption leg (pure on-disk): sever the child's lineage
+    # hash chain — both manifests, so .bak cannot heal it — and the
+    # resolver must degrade to the newest verified ancestor
+    if child_out is not None and not fails:
+        faults._corrupt_lineage(child_out)
+        try:
+            degraded, report = lineage.resolve_verified(child_out)
+        except lineage.LineageError as exc:
+            fails.append(f"append: corrupted lineage did not degrade "
+                         f"to an ancestor ({exc})")
+        else:
+            if str(degraded) != str(parent_out):
+                fails.append(
+                    f"append: corrupted generation resolved to "
+                    f"{degraded}, not the verified parent "
+                    f"{parent_out}")
+            if not (report and report[0]["ok"] is False
+                    and report[-1]["ok"] is True):
+                fails.append(f"append: degrade report malformed: "
+                             f"{report}")
+    return fails
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         description="seeded chaos campaign over the serving tier")
@@ -621,6 +843,17 @@ def main(argv=None):
     failures.extend(gw_fails)
     records.append({"leg": "gateway", "failures": gw_fails})
     print(f"[campaign] gateway {'ok' if not gw_fails else 'FAIL'}",
+          flush=True)
+
+    # the standing-model leg also runs in every mode: a kill at ANY
+    # migration seam must land on the parent or the child generation,
+    # never a torn hybrid — exactly what CI must hold
+    print("[campaign] append leg: seam-kill migration drill ...",
+          flush=True)
+    ap_fails = _append_drill(root, cache)
+    failures.extend(ap_fails)
+    records.append({"leg": "append", "failures": ap_fails})
+    print(f"[campaign] append {'ok' if not ap_fails else 'FAIL'}",
           flush=True)
 
     report = {"seeds": args.seeds, "quick": bool(args.quick),

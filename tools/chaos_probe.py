@@ -30,8 +30,15 @@ Scenarios (``--scenario``):
   readmits every in-flight job from its own verified checkpoint dir and
   each finishes bit-identical to its uninterrupted solo baseline (jax
   backend).
+- ``append``: the standing-model drill — a finished job's dataset
+  grows past its bucket, the cross-bucket migration is killed at the
+  re-pad seam (``kill_mid_migration``), a fresh incarnation re-forks
+  idempotently from the parent's verified checkpoint, the retained-row
+  prefix survives **bitwise** through the re-bucketing, and a
+  corrupted lineage link (``corrupt_lineage``) degrades resolution to
+  the newest verified ancestor (jax backend).
 
-Usage: python tools/chaos_probe.py [--scenario fault|preempt|stall|reshard|tenant_evict]
+Usage: python tools/chaos_probe.py [--scenario fault|preempt|stall|reshard|tenant_evict|append]
        [--fault kill|truncate|corrupt|nan|xla] [--niter N]
        [--save-every N] [--at-row N] [--devices N] [--outdir DIR]
 """
@@ -356,12 +363,97 @@ def scenario_tenant_evict(args, base):
     }
 
 
+def scenario_append(args, base):
+    """Append-TOAs migration killed at the re-pad seam: recovery must
+    land on the parent (nothing torn), a re-fork must be idempotent,
+    the retained prefix bitwise, and a severed lineage link must
+    degrade to the newest verified ancestor."""
+    from pulsar_timing_gibbsspec_tpu.analysis.jaxprcheck.entries import (
+        build_model, synthetic_pulsars)
+    from pulsar_timing_gibbsspec_tpu.data import append_polynomial_toas
+    from pulsar_timing_gibbsspec_tpu.runtime import (
+        faults, lineage, telemetry)
+    from pulsar_timing_gibbsspec_tpu.runtime.faults import InjectedCrash
+    from pulsar_timing_gibbsspec_tpu.serve import (
+        BucketSpec, BucketTable, SamplerService)
+
+    psrs = synthetic_pulsars(2, 24, tm_cols=3, seed=0)
+    pta = build_model(psrs, 3)
+    grown = build_model(append_polynomial_toas(psrs, 24, seed=5), 3)
+    # ntoa 24 -> 48 overflows the first bucket; the second grows BOTH
+    # padded axes (TOAs and basis), so the re-pad zero-embed is real
+    table = BucketTable([BucketSpec(2, 40, 24, 3),
+                         BucketSpec(2, 64, 32, 3)])
+    svc_kw = dict(slots=2, chunk=4, save_every=1)
+    root = base / "svc"
+    pdir, cdir = root / "parent", root / "child"
+
+    telemetry.reset()
+    faults.clear()
+    svc = SamplerService(root, table, **svc_kw)
+    parent = svc.submit(pta, args.niter, job_id="parent", tenant_id=0)
+    svc.run()
+    if parent.state != "done":
+        return False, {"error": f"parent failed: {parent.failure}"}
+    parent_rows = np.load(pdir / "chain.npy").copy()
+
+    # kill mid-re-pad: the child dir must be ABSENT afterwards (the
+    # fork stages + atomically renames), never a torn hybrid
+    faults.inject("kill_mid_migration", point="migrate.mid_repad",
+                  times=1)
+    died = False
+    try:
+        svc.append_job(grown, 2 * args.niter, parent_id="parent",
+                       job_id="child", outdir=cdir)
+    except InjectedCrash:
+        died = True
+    finally:
+        faults.clear()
+    torn_free = not (cdir / "manifest.json").exists()
+
+    # fresh incarnation knows only the parent's directory: re-append,
+    # run the child generation to done
+    svc2 = SamplerService(root, table, **svc_kw)
+    child = svc2.append_job(grown, 2 * args.niter, parent_outdir=pdir,
+                            job_id="child", outdir=cdir)
+    svc2.run()
+    prefix = bool(np.array_equal(np.load(cdir / "chain.npy")[:args.niter],
+                                 parent_rows))
+    ancestry = lineage.walk(cdir)
+    resolved, _ = lineage.resolve_verified(cdir)
+
+    # sever the hash chain (both manifests, so .bak cannot heal it):
+    # resolution must degrade to the verified parent, with the report
+    faults._corrupt_lineage(cdir)
+    degraded, report = lineage.resolve_verified(cdir)
+    ok = (died and torn_free and child.state == "done"
+          and int(child.generation) == 1
+          and tuple(child.bucket.as_tuple()) == (2, 64, 32, 3)
+          and prefix and len(ancestry) == 2
+          and str(resolved) == str(cdir) and str(degraded) == str(pdir))
+    return ok, {
+        "service_died": died,
+        "torn_free_after_kill": torn_free,
+        "child_state": child.state,
+        "child_generation": int(child.generation),
+        "child_bucket": list(child.bucket.as_tuple()),
+        "prefix_bitwise": prefix,
+        "ancestry_generations": [a["generation"] for a in ancestry],
+        "resolved": str(resolved),
+        "degraded_to": str(degraded),
+        "degrade_report": [(r["generation"], r["ok"]) for r in report],
+        "lineage_degrades": telemetry.get("lineage_degrades"),
+        "migrations": telemetry.get("migrations"),
+    }
+
+
 SCENARIOS = {"fault": scenario_fault, "preempt": scenario_preempt,
              "stall": scenario_stall, "reshard": scenario_reshard,
-             "tenant_evict": scenario_tenant_evict}
+             "tenant_evict": scenario_tenant_evict,
+             "append": scenario_append}
 #: jax-backed scenarios run chunked; small defaults keep them quick
 _JAX_DEFAULTS = {"stall": (16, 4), "reshard": (16, 4),
-                 "tenant_evict": (12, 4)}
+                 "tenant_evict": (12, 4), "append": (12, 4)}
 
 
 def main():
