@@ -40,6 +40,14 @@ Entry kinds (the ``entry`` field of a contract):
   entry *raises* (-> an ``error`` violation) when routing diverges, the
   cache fails to warm-hit, or the stacked pytree's treedef/avals drift
   — the static zero-retrace contract (``serve_buckets``).
+- ``serve_placement`` — the multiplexed steady chunk of ONE placement
+  slice, carries committed on the slice's carved chain submesh of a
+  2-d mesh (``serve_placement``).  Host assertions pin the carving
+  invariants (disjoint device sets, per-slice slots divisibility,
+  both groups route); ``isolate_axis`` on the traced program proves
+  tenant rows never talk across the chain axis — a device loss on the
+  neighboring slice cannot perturb this slice's streams at the SPMD
+  level.
 """
 
 from __future__ import annotations
@@ -333,6 +341,91 @@ def _serve_mux_entry(spec):
     return mux_body(spec.get("chunk", 2)), (stack, x, b, tkeys, it0), {}
 
 
+def _serve_placement_entry(spec):
+    """One placement slice's multiplexed steady chunk on its carved
+    chain submesh.  Builds the full placement geometry host-side — a
+    2-d parent mesh carved into two disjoint chain-span slices hosting
+    two DIFFERENT buckets with different slot counts — and asserts the
+    carving invariants (disjoint device sets, per-slice divisibility,
+    distinct routed buckets, warm cache behavior) before tracing the
+    second slice's program with carries committed on ITS submesh.  The
+    contract's ``isolate_axis`` then proves the traced program moves
+    nothing across the chain (tenant) axis: slices share no devices
+    AND no slice's program could use a cross-row collective even if
+    they did."""
+    import jax.numpy as jnp
+    import jax.random as jr
+
+    from ...parallel.sharding import (carve_chain_slices,
+                                      chain_submesh_size, make_mesh,
+                                      shard_carry)
+    from ...serve.buckets import BucketSpec, BucketTable
+    from ...serve.engine import (ProgramCache, compile_bucket, mux_body,
+                                 stack_cms)
+
+    shape = tuple(int(s) for s in spec.get("mesh", (4, 2)))
+    mesh = make_mesh(shape)
+    spans = [int(s) for s in spec.get("spans", (2, 2))]
+    slots = [int(s) for s in spec.get("slots", (2, 4))]
+    subs = carve_chain_slices(mesh, spans)
+    devsets = [set(d.id for d in sub.devices.flat) for sub in subs]
+    for i in range(len(subs)):
+        for j in range(i + 1, len(subs)):
+            if devsets[i] & devsets[j]:
+                raise AssertionError(
+                    f"slices {i} and {j} share devices "
+                    f"{sorted(devsets[i] & devsets[j])} — fault "
+                    "domains must be disjoint")
+    for i, sub in enumerate(subs):
+        nc = chain_submesh_size(sub)
+        if slots[i] % nc:
+            raise AssertionError(
+                f"slice {i}: slots={slots[i]} does not divide over "
+                f"its {nc} chain rows")
+    bspecs = [BucketSpec(*b) for b in
+              spec.get("buckets", ((2, 40, 24, 3), (2, 48, 24, 3)))]
+    table = BucketTable(bspecs)
+    cache = ProgramCache()
+    # group A occupies slice 0 (compiled + adopted, never traced here);
+    # group B's stack is the traced program, on slice 1's submesh
+    groups = []
+    for g, (bucket, T) in enumerate(zip(bspecs, slots)):
+        cms = []
+        for i in range(T):
+            # shapes sit strictly inside this bucket but past the next
+            # smaller one, so route_pta (smallest cover wins) keeps the
+            # groups on their own buckets
+            ntoa = bucket.toas - 2 - 4 * (i % 2)
+            pta = build_model(
+                synthetic_pulsars(spec.get("n_psr", 2), ntoa,
+                                  tm_cols=spec.get("tm_cols", 3),
+                                  seed=10 * g + i),
+                spec.get("nmodes", 3))
+            routed = table.route_pta(pta)
+            if routed != bucket:
+                raise AssertionError(
+                    f"group {g} dataset {i} routed to {routed}, not "
+                    f"its own bucket {bucket} — groups must stay "
+                    "disjoint")
+            cm, warm = cache.adopt(routed, compile_bucket(pta, routed))
+            if warm != (i > 0):
+                raise AssertionError(
+                    f"group {g} dataset {i}: cache "
+                    f"{'missed' if i else 'hit'} — per-group grafting "
+                    "broke")
+            cms.append(cm)
+        groups.append(cms)
+    cms = groups[1]
+    stack = stack_cms(cms)
+    T, cm0 = len(cms), cms[0]
+    x = jnp.zeros((T, cm0.nx), cm0.cdtype)
+    b = jnp.zeros((T, cm0.P, cm0.Bmax), cm0.cdtype)
+    tkeys = jr.split(jr.key(spec.get("seed", 0)), T)
+    it0 = jnp.ones((T,), jnp.int32)
+    x, b, tkeys = shard_carry(subs[1], (x, b, tkeys), T)
+    return mux_body(spec.get("chunk", 2)), (stack, x, b, tkeys, it0), {}
+
+
 def _ensemble_chunk_entry(spec):
     """The ensemble-mixing steady chunk (``crn_ensemble``): same
     synthetic CRN model as ``chunk``, driver built with ``ensemble=True``
@@ -360,7 +453,8 @@ _ENTRIES = {"gram": _gram_entry, "chunk": _chunk_entry,
             "sharded_step": _sharded_step_entry,
             "sharded_2d": _sharded_2d_entry,
             "ensemble_chunk": _ensemble_chunk_entry,
-            "serve_mux": _serve_mux_entry}
+            "serve_mux": _serve_mux_entry,
+            "serve_placement": _serve_placement_entry}
 
 
 def resolve_entry(spec: dict):

@@ -101,6 +101,51 @@ def chain_submesh_size(mesh) -> int:
     return int(mesh.devices.shape[list(mesh.axis_names).index("chain")])
 
 
+def chain_slice(mesh, lo: int, hi: int):
+    """Carve chain-axis rows ``[lo, hi)`` of a 2-d ``(chain, pulsar)``
+    mesh into a standalone submesh — the slice-carving primitive of the
+    serving placement engine.  The carved mesh keeps the parent's axis
+    names and pulsar width, so every sharding helper above applies
+    unchanged; chains are collective-free by construction (measured,
+    ``crn_2d_mesh``), so programs on disjoint slices share no devices
+    and no collectives: each slice is an isolated fault domain."""
+    from jax.sharding import Mesh
+
+    if mesh is None:
+        return None
+    if "chain" not in mesh.axis_names:
+        raise ValueError(
+            "chain_slice needs a 2-d (chain, pulsar) mesh; got axes "
+            f"{tuple(mesh.axis_names)} — build one with "
+            "make_mesh((n_chain, n_pulsar))")
+    nc = chain_submesh_size(mesh)
+    lo, hi = int(lo), int(hi)
+    if not 0 <= lo < hi <= nc:
+        raise ValueError(
+            f"chain_slice rows [{lo}, {hi}) fall outside the mesh's "
+            f"chain axis ({nc} rows, mesh {tuple(mesh.devices.shape)})")
+    return Mesh(mesh.devices[lo:hi], mesh.axis_names)
+
+
+def carve_chain_slices(mesh, spans):
+    """Carve consecutive chain-row spans (an iterable of row counts)
+    into disjoint submeshes, in order from row 0.  Raises when the
+    spans overrun the chain axis; leftover rows stay uncarved (spare
+    capacity for rebalancing)."""
+    out = []
+    lo = 0
+    nc = chain_submesh_size(mesh)
+    for c in spans:
+        c = int(c)
+        if lo + c > nc:
+            raise ValueError(
+                f"carve_chain_slices: spans {list(spans)} need "
+                f"{lo + c} chain rows but the mesh has {nc}")
+        out.append(chain_slice(mesh, lo, lo + c))
+        lo += c
+    return out
+
+
 def mesh_layout(mesh):
     """JSON-serializable description of a mesh placement.
 

@@ -65,7 +65,9 @@ Fault kinds:
 - ``"device_loss"`` raise :class:`DeviceLost` at a fire point, carrying
   ``devices`` = the surviving device count — the serving tier's
   evacuation drill (drain residents, rebuild on the surviving submesh,
-  re-admit).
+  re-admit).  With ``slice=<id>`` the loss is attributed to one
+  placement slice: only that fault domain evacuates and re-places
+  (capped re-place budget), co-resident slices keep sampling bitwise.
 
 Migration seams (the standing-model append path — ``serve/gateway.py``
 ``/v1/append`` and ``SamplerService.append_job`` →
@@ -167,11 +169,19 @@ class DeviceLost(RuntimeError):
     (``devices``, or None when unknown) and resume there.  The serving
     tier's :meth:`~..serve.service.SamplerService.evacuate` and the
     single-tenant ``integrity.reshard_restore`` are the two consumers.
+
+    On a multi-slice service (``placement=``), ``slice_id`` attributes
+    the loss to ONE placement slice: the supervised path then evacuates
+    and re-places only that fault domain (capped by its re-place
+    budget) while every other slice keeps sampling bitwise.  Without
+    attribution (``slice_id=None``) the whole service evacuates, as
+    before.
     """
 
-    def __init__(self, msg, devices=None):
+    def __init__(self, msg, devices=None, slice_id=None):
         super().__init__(msg)
         self.devices = devices
+        self.slice_id = slice_id
 
 
 @dataclass
@@ -185,6 +195,7 @@ class _Fault:
     seconds: float = 0.0        # stall sleep / drain deadline
     devices: int | None = None  # device_count override / survivors
     tenant: int | None = None   # victim tenant for serve-tier kinds
+    slice: int | None = None    # victim placement slice (device_loss)
     fired: int = 0
 
 
@@ -193,11 +204,11 @@ _lock = threading.Lock()
 
 
 def inject(kind, point=None, at_row=None, times=1, backend=None, path=None,
-           seconds=0.0, devices=None, tenant=None):
+           seconds=0.0, devices=None, tenant=None, slice=None):
     """Arm a fault; returns the handle (remove with :func:`clear`)."""
     f = _Fault(kind=kind, point=point, at_row=at_row, times=times,
                backend=backend, path=path, seconds=seconds, devices=devices,
-               tenant=tenant)
+               tenant=tenant, slice=slice)
     with _lock:
         _armed.append(f)
     return f
@@ -271,10 +282,12 @@ def fire(point, row=None, backend=None, outdir=None):
             raise InjectedCrash(
                 f"injected {f.kind} at {point} (row {row})")
         if f.kind == "device_loss":
+            where = "" if f.slice is None else f" on slice {f.slice}"
             raise DeviceLost(
-                f"injected device loss at {point} (row {row}): "
+                f"injected device loss{where} at {point} (row {row}): "
                 f"{f.devices if f.devices is not None else '?'} "
-                "device(s) survive", devices=f.devices)
+                "device(s) survive", devices=f.devices,
+                slice_id=f.slice)
         raise XlaRuntimeError(
             f"INTERNAL: injected device failure at {point} (row {row})")
 

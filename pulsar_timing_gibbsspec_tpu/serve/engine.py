@@ -71,6 +71,15 @@ def model_signature(cm) -> tuple:
     )
 
 
+def group_key(bucket, cm) -> tuple:
+    """Canonical ``(bucket, signature)`` group identity.  Jobs with
+    equal group keys multiplex through ONE compiled program and may
+    share a resident slot stack; the placement engine pins each group
+    to at most one slice (its fault domain), so this key is also the
+    routing key of :meth:`..service.SamplerService._admissions`."""
+    return (bucket, model_signature(cm))
+
+
 def adopt_static(cm, canon):
     """Graft ``canon``'s static box onto ``cm`` so the two share every
     jit cache entry.  Verifies the full trace-relevant static surface

@@ -636,7 +636,8 @@ class Gateway:
                         "jobs": len(self._entries),
                         "queue_depth": len(self.svc.queue),
                         "residents": sum(1 for j in self.svc.residents
-                                         if j is not None)}
+                                         if j is not None),
+                        "placement": self.svc.placement_summary()}
             return WireResponse(body=body)
         m = _JOB_ROUTE.match(path)
         if m and req.method == "GET":

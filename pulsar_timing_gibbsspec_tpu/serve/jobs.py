@@ -65,6 +65,7 @@ class Job:
     bucket: object = None
     cm: object = None            # grafted CompiledPTA
     store: object = None         # ChainStore over outdir
+    slice_id: int | None = None  # fault domain of the last residency
 
     # progress
     it: int = 0                  # recorded rows so far
@@ -121,6 +122,10 @@ class Job:
             "generation": int(self.generation),
             "pulsars": [str(p) for p in self.pta.pulsars],
         }}
+        if self.slice_id is not None:
+            # the fault domain the checkpoint was cut in: forensic only
+            # (readmission re-routes by group key, never by old slice)
+            extra["serve"]["slice"] = int(self.slice_id)
         if self.lineage is not None:
             extra["lineage"] = dict(self.lineage)
         return extra

@@ -1,0 +1,404 @@
+"""Placement engine: concurrent resident groups on fault-domain slices.
+
+The service historically held exactly ONE ``(bucket, signature)``
+group resident at a time: a job routing to a different bucket waited
+for a full group drain, so one hot tenant class head-of-line-blocked
+every other model shape — and a single group was a single blast radius
+spanning the whole mesh.  The blocked-Gibbs structure that makes
+chains embarrassingly parallel (the 2-d ``(chain, pulsar)`` mesh
+carries ZERO chain-axis collectives — measured, ``crn_2d_mesh``) makes
+disjoint chain-submesh slices natural *fault domains*: programs on
+different slices share no devices and no collectives, so a device
+loss, quarantine storm or compile stall on one slice cannot perturb
+another slice's bitwise streams.
+
+This module owns the *geometry and lifecycle*; the
+:class:`~.service.SamplerService` owns the jobs and drives it:
+
+- :class:`Slice` — one fault domain: a contiguous span of chain-axis
+  device rows carved into a standalone submesh
+  (``parallel.sharding.chain_slice``), a fixed tenant-axis width
+  (``slots``), and the per-slice scheduling state (residents, active
+  group, stacked carries, warmed-program set).  ``slots`` must divide
+  over the slice's chain rows — the quotient is the *chains sub-axis*:
+  ``slots // chains`` tenant rows ride each chain device, so slices
+  with different chain counts can coexist on one mesh.
+- :class:`PlacementPlan` — the audited lifecycle of a slice
+  (``planned → warming → resident → draining → migrating → failed``),
+  declared in ``contracts/racecheck.json`` and M1–M3-checked: every
+  transition below is a literal guarded assignment, so a new edge
+  cannot land without a diff to the contract.
+- :class:`PlacementEngine` — carves slices from a parent mesh
+  (explicit layout or one whole-mesh slice), validates the chains
+  sub-axis divisibility with typed refusals (:class:`PlacementError`
+  naming the slice, the required multiple and the nearest legal slot
+  count), splits/merges slices for rebalancing, and enforces the
+  capped per-slice re-place budget (``replace_max`` losses within
+  ``replace_window`` seconds) with deterministic per-slice backoff.
+
+Pre-warming policy (driven by the service): the ``compile_stalls`` /
+``warm_hit_rate`` gauges plus queue composition pick a queued cold
+bucket that cannot be placed this step and compile it inside a
+*planned* window while resident slices keep dispatching — hard-capped
+(one compile per step, ``prewarm`` outstanding buckets) and suspended
+during an admission-controller compile storm, so pre-warming can never
+starve a resident group's step.
+"""
+
+from __future__ import annotations
+
+#: audited slice lifecycle (contracts/racecheck.json machine
+#: "placement"); module-level tuple so racecheck M1 pins it
+PLACEMENT_STATES = ("planned", "warming", "resident", "draining",
+                    "migrating", "failed")
+
+
+class PlacementError(ValueError):
+    """Typed placement refusal (a ``user``-class failure for the
+    supervisor taxonomy: re-raised, never retried).  Carries the
+    offending ``slice_id``, the ``required_multiple`` the slot count
+    must satisfy (the slice's chain-device count) and the ``nearest``
+    legal slot count when the refusal is a divisibility error."""
+
+    def __init__(self, msg, *, slice_id=None, required_multiple=None,
+                 nearest=None):
+        super().__init__(msg)
+        self.slice_id = slice_id
+        self.required_multiple = required_multiple
+        self.nearest = nearest
+
+
+class PlacementPlan:
+    """Audited slice lifecycle.  Transitions are guarded assignments
+    (the racecheck M3 pattern): calling a method outside its legal
+    source states is a silent no-op, so replayed/raced calls cannot
+    fabricate an undeclared edge."""
+
+    def __init__(self, slice_id):
+        self.slice_id = int(slice_id)
+        self.state = "planned"
+
+    def warming(self):
+        """A group starts admitting onto the slice (fresh placement)
+        or re-placing after a device loss."""
+        if self.state == "planned":
+            self.state = "warming"
+            return
+        if self.state == "migrating":
+            self.state = "warming"
+
+    def resident(self):
+        """First multiplexed chunk wrote back: the group is live."""
+        if self.state == "warming":
+            self.state = "resident"
+
+    def draining(self):
+        """The slice's group is leaving (drain/done/evict to empty)."""
+        if self.state == "resident":
+            self.state = "draining"
+
+    def drained(self):
+        """Empty again: the slice returns to the allocatable pool."""
+        if self.state == "draining":
+            self.state = "planned"
+
+    def migrating(self):
+        """Device loss / rebalance: the slice's jobs are being
+        re-placed through their verified checkpoints."""
+        if self.state == "resident":
+            self.state = "migrating"
+            return
+        if self.state == "warming":
+            self.state = "migrating"
+
+    def fail(self):
+        """Re-place budget exhausted: the slice parks terminally."""
+        if self.state == "migrating":
+            self.state = "failed"
+
+
+class Slice:
+    """One fault domain: geometry + the per-slice scheduling state the
+    service mutates between chunks.  ``chains`` is the number of
+    chain-axis device rows ([``chain_lo``, ``chain_lo + chains``) of
+    the parent mesh); 0 means unplaced (no mesh — the chains sub-axis
+    is trivially 1 and any slot count is legal)."""
+
+    def __init__(self, slice_id, slots, chains=0, chain_lo=0, mesh=None):
+        self.slice_id = int(slice_id)
+        self.slots = int(slots)
+        self.chains = int(chains)
+        self.chain_lo = int(chain_lo)
+        self.mesh = mesh                 # carved submesh (or None)
+        self.plan = PlacementPlan(slice_id)
+        # scheduling state (owned by SamplerService)
+        self.residents = [None] * self.slots
+        self.active = None               # (bucket, signature) group
+        self.dirty = True
+        self.stack = None
+        self.X = self.B = self.K = None
+        self.warmed = set()              # (chunk, group) combos compiled
+        self.chunks = 0                  # dispatches on this slice
+        # fault-domain bookkeeping
+        self.losses = 0
+        self.loss_times = []             # clock times within the window
+
+    def live(self):
+        return sum(1 for j in self.residents if j is not None)
+
+
+def _validate_slice(sl, mesh_shape=None):
+    """Chains sub-axis divisibility with the typed refusal the
+    service's constructor surfaces (message keeps the historical
+    "multiple of N" phrasing)."""
+    from ..parallel.sharding import chain_submesh_size
+
+    nc = chain_submesh_size(sl.mesh)
+    if nc > 1 and sl.slots % nc:
+        nearest = -(-sl.slots // nc) * nc
+        where = (f"mesh {tuple(mesh_shape)}" if mesh_shape is not None
+                 else "its submesh")
+        raise PlacementError(
+            f"slice {sl.slice_id}: slots={sl.slots} does not divide "
+            f"over the slice's chain sub-axis ({nc} devices, {where}): "
+            "the tenant axis is the chain axis on a 2-d serving mesh — "
+            f"pass slots as a multiple of {nc} (e.g. slots={nearest}) "
+            "or shrink the slice's chain span",
+            slice_id=sl.slice_id, required_multiple=nc, nearest=nearest)
+
+
+class PlacementEngine:
+    """Carves, validates and rebalances the service's slices.
+
+    ``layout=None`` keeps the historical single-group service: ONE
+    slice spanning the whole mesh with all ``slots``.  An explicit
+    layout (``[{"slots": 2, "chains": 2}, {"slots": 4, "chains": 2}]``)
+    carves the chain axis into disjoint contiguous spans in order —
+    groups with different chain counts coexist because each slice
+    validates its own chains sub-axis.  On an unplaced service
+    (``mesh=None``) the layout still creates independent slices (the
+    chains sub-axis is trivially 1), so multi-group scheduling and the
+    chaos drills run without devices."""
+
+    def __init__(self, mesh, layout=None, slots=2, *, replace_max=1,
+                 replace_window=30.0, clock=None):
+        import time as _time
+
+        from ..parallel.sharding import chain_slice, chain_submesh_size
+
+        self.mesh = mesh
+        self.replace_max = int(replace_max)
+        self.replace_window = float(replace_window)
+        self._clock = clock if clock is not None else _time.monotonic
+        self._next_id = 0
+        self.slices: list[Slice] = []
+        nc = chain_submesh_size(mesh)
+        shape = tuple(mesh.devices.shape) if mesh is not None else None
+        if layout is None:
+            sl = Slice(self._take_id(), int(slots),
+                       chains=(nc if mesh is not None else 0),
+                       chain_lo=0, mesh=mesh)
+            _validate_slice(sl, shape)
+            self.slices.append(sl)
+            return
+        specs = list(layout)
+        if not specs:
+            raise PlacementError("placement layout is empty")
+        lo = 0
+        for spec in specs:
+            s = int(spec.get("slots", 2))
+            c = int(spec.get("chains", 0) or 0)
+            sub = None
+            if mesh is not None and "chain" in mesh.axis_names and nc > 1:
+                c = c or 1
+                if lo + c > nc:
+                    raise PlacementError(
+                        f"slice {self._next_id}: chain span "
+                        f"[{lo}, {lo + c}) exceeds the mesh's chain "
+                        f"axis ({nc} rows, mesh {shape}) — shrink the "
+                        "layout's chain counts or grow the mesh",
+                        slice_id=self._next_id)
+                sub = chain_slice(mesh, lo, lo + c)
+            elif mesh is not None:
+                c = 0
+                sub = mesh      # 1-d mesh: no chain axis to carve
+            else:
+                c = 0
+            sl = Slice(self._take_id(), s, chains=c, chain_lo=lo,
+                       mesh=sub)
+            _validate_slice(sl, shape)
+            self.slices.append(sl)
+            lo += c
+
+    def _take_id(self):
+        i, self._next_id = self._next_id, self._next_id + 1
+        return i
+
+    @property
+    def total_slots(self):
+        return sum(sl.slots for sl in self.slices)
+
+    def slice_by_id(self, slice_id):
+        for sl in self.slices:
+            if sl.slice_id == int(slice_id):
+                return sl
+        return None
+
+    # -- fault-domain budget -------------------------------------------------
+
+    def note_loss(self, sl) -> int:
+        """Record a device loss on ``sl``; returns the retry ordinal
+        for the deterministic backoff, or raises the typed terminal
+        :class:`PlacementError` when more than ``replace_max`` losses
+        land within ``replace_window`` seconds."""
+        now = self._clock()
+        sl.losses += 1
+        sl.loss_times = [t for t in sl.loss_times
+                         if now - t < self.replace_window]
+        sl.loss_times.append(now)
+        if len(sl.loss_times) > self.replace_max:
+            raise PlacementError(
+                f"slice {sl.slice_id}: re-place budget exhausted "
+                f"({len(sl.loss_times)} device losses within "
+                f"{self.replace_window:g}s > replace_max="
+                f"{self.replace_max}) — the slice parks failed and its "
+                "jobs keep their verified checkpoints; resubmit after "
+                "operator intervention", slice_id=sl.slice_id)
+        return len(sl.loss_times)
+
+    # -- rebalancing geometry ------------------------------------------------
+
+    def split_slice(self, slice_id, *, slots=None, chains=None):
+        """Split one (empty) slice into two; returns the new pair.
+        Defaults halve both axes.  The service is responsible for
+        draining the slice's residents through verified checkpoints
+        BEFORE calling — geometry never mutates under a live group."""
+        from ..parallel.sharding import chain_slice
+
+        sl = self.slice_by_id(slice_id)
+        if sl is None:
+            raise PlacementError(f"split: unknown slice {slice_id}",
+                                 slice_id=slice_id)
+        if sl.live():
+            raise PlacementError(
+                f"split: slice {sl.slice_id} still holds "
+                f"{sl.live()} resident job(s) — drain it first",
+                slice_id=sl.slice_id)
+        s1 = int(slots) if slots is not None else sl.slots // 2
+        if not 0 < s1 < sl.slots:
+            raise PlacementError(
+                f"split: slice {sl.slice_id} slots={sl.slots} cannot "
+                f"split at {s1}", slice_id=sl.slice_id)
+        if sl.chains:
+            c1 = int(chains) if chains is not None else sl.chains // 2
+            if not 0 < c1 < sl.chains:
+                raise PlacementError(
+                    f"split: slice {sl.slice_id} chains={sl.chains} "
+                    f"cannot split at {c1}", slice_id=sl.slice_id)
+        else:
+            c1 = 0
+        idx = self.slices.index(sl)
+        parts = []
+        spans = [(sl.chain_lo, c1, s1),
+                 (sl.chain_lo + c1, sl.chains - c1, sl.slots - s1)]
+        shape = (tuple(self.mesh.devices.shape)
+                 if self.mesh is not None else None)
+        for lo, c, s in spans:
+            sub = (chain_slice(self.mesh, lo, lo + c)
+                   if c and self.mesh is not None else
+                   (self.mesh if sl.mesh is self.mesh else None))
+            part = Slice(self._take_id(), s, chains=c, chain_lo=lo,
+                         mesh=sub)
+            _validate_slice(part, shape)
+            parts.append(part)
+        self.slices[idx:idx + 1] = parts
+        return tuple(parts)
+
+    def merge_slices(self, a_id, b_id):
+        """Merge two adjacent (empty) slices into one; returns it."""
+        from ..parallel.sharding import chain_slice
+
+        a, b = self.slice_by_id(a_id), self.slice_by_id(b_id)
+        if a is None or b is None:
+            raise PlacementError(
+                f"merge: unknown slice in ({a_id}, {b_id})")
+        ia, ib = self.slices.index(a), self.slices.index(b)
+        if abs(ia - ib) != 1:
+            raise PlacementError(
+                f"merge: slices {a_id} and {b_id} are not adjacent",
+                slice_id=a_id)
+        for sl in (a, b):
+            if sl.live():
+                raise PlacementError(
+                    f"merge: slice {sl.slice_id} still holds "
+                    f"{sl.live()} resident job(s) — drain it first",
+                    slice_id=sl.slice_id)
+        lo = min(a.chain_lo, b.chain_lo)
+        chains = a.chains + b.chains
+        sub = (chain_slice(self.mesh, lo, lo + chains)
+               if chains and self.mesh is not None else
+               (self.mesh if a.mesh is self.mesh or b.mesh is self.mesh
+                else None))
+        merged = Slice(self._take_id(), a.slots + b.slots, chains=chains,
+                       chain_lo=lo, mesh=sub)
+        _validate_slice(merged, tuple(self.mesh.devices.shape)
+                        if self.mesh is not None else None)
+        i0 = min(ia, ib)
+        self.slices[i0:i0 + 2] = [merged]
+        return merged
+
+    def recarve(self, mesh):
+        """Re-derive slice submeshes after a global evacuation changed
+        the parent mesh.  Single slice follows the mesh; a multi-slice
+        layout re-carves the same chain spans when they still fit and
+        degrades every slice to unplaced when they do not (streams are
+        pure in the tenant identity, so placement changes never change
+        bits)."""
+        from ..parallel.sharding import chain_slice, chain_submesh_size
+
+        self.mesh = mesh
+        if len(self.slices) == 1:
+            sl = self.slices[0]
+            sl.mesh = mesh
+            sl.chains = (chain_submesh_size(mesh)
+                         if mesh is not None else 0)
+            sl.chain_lo = 0
+            return
+        nc = chain_submesh_size(mesh)
+        need = sum(sl.chains for sl in self.slices)
+        if mesh is None or need == 0 or nc < need or \
+                "chain" not in mesh.axis_names:
+            for sl in self.slices:
+                sl.mesh = None
+                sl.chains = 0
+            return
+        lo = 0
+        for sl in self.slices:
+            sl.chain_lo = lo
+            sl.mesh = chain_slice(mesh, lo, lo + sl.chains)
+            lo += sl.chains
+
+    # -- reporting -----------------------------------------------------------
+
+    def report(self):
+        out = []
+        for sl in self.slices:
+            group = None
+            if sl.active is not None:
+                try:
+                    group = list(sl.active[0].as_tuple())
+                except Exception:       # noqa: BLE001
+                    group = str(sl.active[0])
+            out.append({
+                "slice": sl.slice_id,
+                "state": sl.plan.state,
+                "slots": sl.slots,
+                "chains": int(sl.chains),
+                "chain_rows": ([sl.chain_lo, sl.chain_lo + sl.chains]
+                               if sl.chains else None),
+                "residents": sl.live(),
+                "group": group,
+                "chunks": int(sl.chunks),
+                "losses": int(sl.losses),
+            })
+        return out

@@ -1,28 +1,50 @@
 """Fair-share scheduler: requests → batch rows of one compiled sweep.
 
-The service owns T resident *slots* (the tenant/vmap axis width of the
-compiled program, fixed at construction so occupancy changes never
-change shapes), a FIFO queue, and the :class:`~.engine.ProgramCache`.
-Each :meth:`step` runs one multiplexed chunk for the resident jobs,
-with admission/eviction strictly *between* chunks:
+The service owns resident *slots* grouped into placement **slices**
+(:mod:`~.placement`), a FIFO queue, and the
+:class:`~.engine.ProgramCache`.  Each slice is one fault domain: a
+fixed tenant-axis width (the vmap width of its compiled program, fixed
+at construction so occupancy changes never change shapes) pinned to a
+contiguous span of chain-axis device rows.  By default there is ONE
+slice spanning the whole mesh — the historical single-group service,
+bit-for-bit.  With ``placement=[{"slots": ..., "chains": ...}, ...]``
+several ``(bucket, signature)`` groups sample CONCURRENTLY on disjoint
+chain-submesh slices (different chain counts coexist: each slice's
+``slots`` divides over its own chain rows — the chains sub-axis).
+Each :meth:`step` runs one multiplexed chunk per occupied slice, with
+admission/eviction strictly *between* chunks:
 
 - **admission** fills free slots from the queue head.  All residents
-  must share one (bucket, model-signature) program; a queued job that
-  routes elsewhere waits until the current group drains (its compile
-  still happens once, at first consideration, and is cached).
-- **fair share** when the queue is non-empty, a resident that has held
-  its slot for ``quantum`` chunks is checkpointed and requeued
-  (``tenant_evictions`` gauge) — no request can starve the queue.
+  of a slice share one (bucket, model-signature) program; a queued job
+  routes to the slice already hosting its group, claims an empty slice
+  otherwise, and only waits when every slice is busy with another
+  group (its compile still happens once, at first consideration, and
+  is cached) — no more whole-service head-of-line blocking behind one
+  hot tenant class.
+- **fair share** when the queue holds work for a slice, a resident
+  that has held its slot for ``quantum`` chunks is checkpointed and
+  requeued (``tenant_evictions`` gauge) — no request can starve the
+  queue.
 - **empty slots** carry an inert filler row (the bucket's canonical
   model with a fixed filler stream): rows are mathematically
   independent under vmap, so fillers cost compute but never touch a
   tenant's values, and the program never retraces for occupancy.
+- **pre-warming** (``prewarm=N``) — predictive upgrade of the
+  reactive compile-storm deferral: when the ``compile_stalls`` /
+  ``warm_hit_rate`` gauges show cold compiles hurt and the queue holds
+  a cold bucket that cannot be placed this step, its bucket compiles
+  inside a *planned* window while residents keep dispatching — hard
+  capped (one compile per step, N outstanding buckets) and suspended
+  during an admission-controller compile storm, so pre-warming never
+  starves a resident group's step.
 
 Failure handling maps onto the supervisor taxonomy
-(``runtime/supervisor.classify_failure``) with per-row blast-radius
-isolation as the organizing principle — tenant rows are independent
-conditional chains under vmap, so one bad tenant must never perturb a
-neighbor's bits:
+(``runtime/supervisor.classify_failure``) with blast-radius isolation
+as the organizing principle — tenant rows are independent conditional
+chains under vmap, and slices share no devices and no collectives
+(the chain axis is collective-free, measured in ``crn_2d_mesh``), so
+one bad tenant must never perturb a neighbor's bits and one lost
+slice must never perturb another slice's stream:
 
 - **quarantine** — the jitted chunk returns a per-tenant-row health
   vector (finite / move_frac / rho_ok, ``runtime.sentinels``); a row
@@ -45,11 +67,19 @@ neighbor's bits:
   (``runtime.supervisor.AdmissionController``, driven by the
   ``compile_stalls``/``queue_depth``/``time_to_first_sample_ms``
   gauges the service already publishes).
-- **device loss** — ``faults.DeviceLost`` triggers
-  :meth:`~SamplerService.evacuate`: every resident checkpoints its
-  intact host rows, programs rebuild on the surviving submesh, and the
-  jobs re-admit — same recovery shape as ``reshard_restore`` for the
-  single-tenant driver, applied per job.
+- **device loss** — ``faults.DeviceLost`` carrying a ``slice_id`` (on
+  a multi-slice service) evacuates and re-places ONLY the lost
+  slice's group (:meth:`evacuate_slice`): its jobs checkpoint their
+  intact host rows and requeue at the head, only that slice's warmed
+  programs and stacked carries drop — the shared
+  :class:`~.engine.ProgramCache` and every survivor slice's programs
+  stay untouched (survivors are provably not retraced), with
+  deterministic per-slice backoff and a capped re-place budget
+  (``replace_max`` losses within ``replace_window`` seconds → a typed
+  terminal :class:`~.placement.PlacementError` and the slice parks
+  ``failed``).  A loss without slice attribution evacuates the whole
+  service (:meth:`evacuate`) exactly as before: programs rebuild on
+  the surviving submesh and the jobs re-admit.
 - **whole-step failures** — device/crash classes still retry the whole
   step with deterministic backoff after reverting every resident to
   its verified checkpoint; ``user`` errors re-raise immediately.  A
@@ -58,12 +88,20 @@ neighbor's bits:
   :class:`~..runtime.preemption.Preempted` (``EXIT_PREEMPTED=75``
   semantics preserved per job).
 
+Rebalancing (:meth:`split_slice` / :meth:`merge_slices`) goes through
+verified checkpoints with the never-a-torn-hybrid guarantee of the
+standing-model migrations: every affected resident drains (checkpoint
++ ``integrity.verify``) BEFORE the geometry mutates, and the in-memory
+layout is ephemeral — a restart sees only per-job checkpoints, never a
+half-moved hybrid.
+
 Chaos seams: ``faults.fire("serve.chunk", row=<global chunk>)`` runs
 before every dispatch; ``faults.tenant_evict_request`` forces an
 eviction (per-tenant targetable); ``faults.poison_tenant_rows`` NaN-
-poisons one tenant's chunk rows — the drills in
+poisons one tenant's chunk rows; ``faults.inject("device_loss",
+slice=<id>)`` targets one slice — the drills in
 ``tools/chaos_probe.py`` and the seeded campaign in
-``tools/chaos_campaign.py``.
+``tools/chaos_campaign.py`` (multi-group legs included).
 """
 
 from __future__ import annotations
@@ -79,6 +117,7 @@ from .buckets import (BucketOverflow, BucketSpec, BucketTable,
                       plan_migration, probe_shape)
 from .engine import ProgramCache, compile_bucket, stack_cms
 from .jobs import Job, MigrationTicket, repad_checkpoint
+from .placement import PlacementEngine, PlacementError
 
 #: tenant index of the inert filler stream (far above any real tenant)
 FILLER_TENANT = 0x7FFFFFFF
@@ -92,16 +131,17 @@ _GEN_SALT = 0x67656E
 
 
 class SamplerService:
-    """Resident multi-tenant sampler over one device program.
+    """Resident multi-tenant sampler over per-slice device programs.
 
     ``slots`` is the tenant-axis width (compiled once per bucket);
     ``chunk`` the sweeps per dispatch; ``save_every`` the checkpoint
     cadence in chunks; ``quantum`` the fair-share slice in chunks.
 
     ``mesh`` (optional) places the service on a device mesh: on a 2-d
-    ``(chain, pulsar)`` mesh the tenant axis IS the chain axis —
-    ``slots`` must divide over it, the stacked per-tenant carries are
-    committed with ``parallel.sharding.shard_carry`` (rows are
+    ``(chain, pulsar)`` mesh the tenant axis IS the chain axis — each
+    slice's ``slots`` must divide over its chain rows, the stacked
+    per-tenant carries are committed with
+    ``parallel.sharding.shard_carry`` on the slice's submesh (rows are
     mathematically independent under vmap, so tenant traffic never
     crosses the chain axis), and :meth:`report` records the layout.
     Placement never touches a tenant's PRNG stream and mesh-placed
@@ -110,14 +150,25 @@ class SamplerService:
     service the values agree at the f64 reduction-order class — GSPMD
     regroups within-sweep reductions for the per-shard program — not
     bitwise (tests/test_serve.py).
-    """
+
+    ``placement`` (optional) carves the mesh into concurrent fault-
+    domain slices: a list of ``{"slots": s, "chains": c}`` specs,
+    consumed in order from chain row 0 (``chains`` is ignored on an
+    unplaced service — slices still schedule independently).  Omitted,
+    the service keeps its historical shape: one slice, one resident
+    group at a time, behavior identical to every prior release.
+    ``prewarm`` enables predictive bucket pre-compilation (N
+    outstanding buckets, hard-capped); ``replace_max`` /
+    ``replace_window`` bound the per-slice device-loss re-place
+    budget."""
 
     def __init__(self, root, table: BucketTable, *, slots=2, chunk=4,
                  save_every=1, quantum=8, service_seed=0, max_retries=2,
                  backoff_base=0.0, cache: ProgramCache | None = None,
                  mesh=None, ensemble=False, pt_ladder=1, perf=False,
                  quarantine_max=2, breaker=None, admission=None,
-                 evac_max=2, clock=time.monotonic):
+                 evac_max=2, placement=None, prewarm=0, replace_max=1,
+                 replace_window=30.0, clock=time.monotonic):
         # the multiplexed chunk is vmap(sharded_sweep_step) over the
         # TENANT axis — rows are unrelated analyses, so any cross-chain
         # ensemble stage (stretch pairing, tempering swaps) would couple
@@ -133,21 +184,13 @@ class SamplerService:
         self.root = Path(root)
         self.root.mkdir(parents=True, exist_ok=True)
         self.table = table
-        self.slots = int(slots)
         self.mesh = mesh
-        if mesh is not None:
-            from ..parallel.sharding import chain_submesh_size
-
-            nc = chain_submesh_size(mesh)
-            if nc > 1 and self.slots % nc:
-                raise ValueError(
-                    f"slots={self.slots} does not divide over the "
-                    f"mesh's chain axis ({nc} devices, mesh "
-                    f"{tuple(mesh.devices.shape)}): the tenant axis is "
-                    "the chain axis on a 2-d serving mesh — pass slots "
-                    f"as a multiple of {nc} (e.g. slots="
-                    f"{-(-self.slots // nc) * nc}) or shrink the chain "
-                    "axis with make_mesh((n_chain, n_pulsar))")
+        self._engine = PlacementEngine(
+            mesh, layout=placement, slots=int(slots),
+            replace_max=int(replace_max),
+            replace_window=float(replace_window), clock=clock)
+        self._slices = self._engine.slices
+        self.slots = self._engine.total_slots
         self.chunk = int(chunk)
         self.save_every = max(1, int(save_every))
         self.quantum = max(1, int(quantum))
@@ -160,14 +203,8 @@ class SamplerService:
         self.cache = ProgramCache() if cache is None else cache
         self.jobs: dict[str, Job] = {}
         self.queue: list[Job] = []
-        self.residents: list[Job | None] = [None] * self.slots
         self.global_chunk = 0
-        self._active = None          # (bucket, signature) of residents
-        self._dirty = True           # membership changed since last stack
-        self._stack = None
-        self._X = self._B = self._K = None
-        self._warmed: set = set()    # (chunk, active) combos already compiled
-        self._fillers: dict = {}     # active-key -> (x, b) host filler state
+        self._fillers: dict = {}     # group-key -> (x, b) host filler state
         self._diags: dict = {}       # job_id -> (RollingDiag, channel idx)
         self._evictions = 0
         self._compile_stalls = 0
@@ -193,6 +230,14 @@ class SamplerService:
         self._evacuations = 0
         self._quarantine_log: list[dict] = []
 
+        # predictive pre-warming: budget of outstanding pre-compiled
+        # buckets (0 = off, the historical reactive-only behavior)
+        self._prewarm_max = int(prewarm)
+        self._prewarmed: set = set()
+        self._prewarms = 0
+        self._group_warmth: dict = {}   # bucket -> [hits, misses]
+        self._max_groups = 0            # concurrency high-water mark
+
         # perf=True hangs the streaming stage aggregator off the trace
         # seams: every serve.prepare/dispatch/d2h/writeback span folds
         # into dispatch_ms{stage=...,job="svc"} gauges that prometheus()
@@ -203,6 +248,24 @@ class SamplerService:
             from ..obs.perf import StageAggregator
 
             self._stage_agg = StageAggregator(job="svc").install()
+
+    # -- residency views ----------------------------------------------------
+
+    @property
+    def residents(self):
+        """Flat resident view across every slice (read-only snapshot —
+        internal scheduling mutates the per-slice lists)."""
+        out = []
+        for sl in self._slices:
+            out.extend(sl.residents)
+        return out
+
+    def placement_summary(self):
+        """Compact per-slice residency (the gateway's healthz body)."""
+        keep = ("slice", "state", "slots", "chains", "residents",
+                "group")
+        return [{k: ent[k] for k in keep}
+                for ent in self._engine.report()]
 
     # -- request intake -----------------------------------------------------
 
@@ -310,6 +373,8 @@ class SamplerService:
             cm = compile_bucket(job.pta, job.bucket)
             cm, warm = self.cache.adopt(job.bucket, cm)
         job.cm = cm
+        g = self._group_warmth.setdefault(job.bucket, [0, 0])
+        g[0 if warm else 1] += 1
         if not warm:
             self._compile_stalls += 1
             telemetry.gauge("compile_stalls", float(self._compile_stalls))
@@ -319,16 +384,24 @@ class SamplerService:
         return True
 
     def _group_key(self, job):
-        from .engine import model_signature
+        from .engine import group_key
 
-        return (job.bucket, model_signature(job.cm))
+        return group_key(job.bucket, job.cm)
 
-    def _admit(self, job, slot):
+    def _claimed_elsewhere(self, key, sl) -> bool:
+        """True when another slice already hosts this group — a group
+        is pinned to at most one slice, so its jobs queue there rather
+        than splitting the group's program across fault domains."""
+        return any(o is not sl and o.active == key
+                   for o in self._slices)
+
+    def _admit(self, job, sl, slot):
         import jax.numpy as jnp
 
         from ..analysis import guards
 
         job.set_state("warming")
+        sl.plan.warming()
         cm = job.cm
         if job.chain is None:
             job.alloc(cm.nx, cm.P * cm.Bmax)
@@ -343,20 +416,22 @@ class SamplerService:
                 job.b = np.asarray(b, np.float64)
         job.chunks_resident = 0
         job.admitted_at = time.monotonic()
-        self.residents[slot] = job
+        job.slice_id = sl.slice_id
+        sl.residents[slot] = job
         job.set_state("sampling")
-        self._dirty = True
+        sl.dirty = True
+        self._prewarmed.discard(job.bucket)
 
-    def _evict(self, slot, reason):
-        job = self.residents[slot]
+    def _evict(self, sl, slot, reason):
+        job = sl.residents[slot]
         job.checkpoint()
         job.set_state("queued")
-        self.residents[slot] = None
+        sl.residents[slot] = None
         self.queue.append(job)
         self._evictions += 1
         telemetry.gauge("tenant_evictions", float(self._evictions))
         telemetry.gauge("queue_depth", float(len(self.queue)))
-        self._dirty = True
+        sl.dirty = True
 
     def _tenant_breaker(self, tenant_id, create=False):
         """The tenant's circuit breaker (None when breakers are off)."""
@@ -369,7 +444,7 @@ class SamplerService:
                                           **self._breaker_cfg)
         return br
 
-    def _quarantine(self, slot, why):
+    def _quarantine(self, sl, slot, why):
         """Blast-radius isolation for one poisoned row: drop the job
         from its slot (an inert filler swaps in at the restack — the
         next chunk boundary), discard the poisoned chunk (it never
@@ -386,7 +461,7 @@ class SamplerService:
         will breach forever, and ``integrity.load_resume`` refuses the
         directory until an operator passes ``force_requeue``.
         """
-        job = self.residents[slot]
+        job = sl.residents[slot]
         job.quarantines += 1
         self._quarantines += 1
         telemetry.incr("sentinel_trips")
@@ -400,8 +475,8 @@ class SamplerService:
         br = self._tenant_breaker(job.tenant_id, create=True)
         if br is not None:
             br.record_failure()
-        self.residents[slot] = None
-        self._dirty = True
+        sl.residents[slot] = None
+        sl.dirty = True
         otrace.instant("serve.quarantine", job=job.job_id,
                        tenant=int(job.tenant_id), why=why,
                        count=int(job.quarantines))
@@ -421,62 +496,133 @@ class SamplerService:
         telemetry.gauge("queue_depth", float(len(self.queue)))
 
     def _admissions(self):
-        """Fill free slots from the queue head, constrained to one
-        (bucket, signature) group at a time.  A quarantined job waits
-        for its tenant's breaker (half-open probe after the cooldown);
+        """Fill free slots from the queue head, one (bucket, signature)
+        group per slice.  A job routes to the slice hosting its group,
+        claims an empty slice otherwise, and waits only when every
+        slice is busy with another group.  A quarantined job waits for
+        its tenant's breaker (half-open probe after the cooldown);
         during a compile storm, cold dataset shapes are deferred so a
         burst of novel buckets cannot serialize warm tenants behind
         back-to-back XLA compiles."""
-        if not any(self.residents):
-            self._active = None
-        for slot in range(self.slots):
-            if self.residents[slot] is not None:
-                continue
-            take = None
-            for job in self.queue:
-                if job.state == "quarantined":
-                    # non-consuming gate: the half-open probe must only
-                    # be claimed when the job is actually admitted — a
-                    # group-key mismatch after allow() would strand the
-                    # breaker half-open with its probe spent, starving
-                    # the tenant forever
-                    br = self._tenant_breaker(job.tenant_id)
-                    if br is not None and not br.would_allow():
-                        continue        # wait out the cooldown
-                if (self._admission is not None and job.cm is None):
-                    if not self._route(job):
-                        continue        # failed routing; skip
-                    if self._admission.defer_cold(
-                            self.cache.has_bucket(job.bucket)):
-                        continue        # compile storm: hold cold shapes
-                if not self._prepare(job):
-                    continue            # failed routing; skip
-                key = self._group_key(job)
-                if self._active is None:
-                    self._active = key
-                if key == self._active:
-                    take = job
+        for sl in self._slices:
+            if not any(sl.residents):
+                # empty slice returns to the allocatable pool (guarded
+                # no-ops outside resident→draining→planned)
+                sl.plan.draining()
+                sl.plan.drained()
+                sl.active = None
+        for sl in self._slices:
+            if sl.plan.state == "failed":
+                continue        # parked fault domain: never refills
+            for slot in range(sl.slots):
+                if sl.residents[slot] is not None:
+                    continue
+                take = None
+                for job in self.queue:
+                    if job.state == "quarantined":
+                        # non-consuming gate: the half-open probe must
+                        # only be claimed when the job is actually
+                        # admitted — a group-key mismatch after allow()
+                        # would strand the breaker half-open with its
+                        # probe spent, starving the tenant forever
+                        br = self._tenant_breaker(job.tenant_id)
+                        if br is not None and not br.would_allow():
+                            continue        # wait out the cooldown
+                    if (self._admission is not None and job.cm is None):
+                        if not self._route(job):
+                            continue        # failed routing; skip
+                        if self._admission.defer_cold(
+                                self.cache.has_bucket(job.bucket)):
+                            continue    # compile storm: hold cold shapes
+                    if not self._prepare(job):
+                        continue            # failed routing; skip
+                    key = self._group_key(job)
+                    if sl.active is None:
+                        if self._claimed_elsewhere(key, sl):
+                            continue        # queued for its own slice
+                        sl.active = key
+                    if key == sl.active:
+                        take = job
+                        break
+                if take is None:
                     break
-            if take is None:
-                break
-            if take.state == "quarantined":
-                br = self._tenant_breaker(take.tenant_id)
-                if br is not None and not br.allow():
-                    break   # probe raced away; retry next round
-            self.queue.remove(take)
-            self.queue[:] = [j for j in self.queue
-                             if j.state != "failed"]
-            telemetry.gauge("queue_depth", float(len(self.queue)))
-            self._admit(take, slot)
+                if take.state == "quarantined":
+                    br = self._tenant_breaker(take.tenant_id)
+                    if br is not None and not br.allow():
+                        break   # probe raced away; retry next round
+                self.queue.remove(take)
+                self.queue[:] = [j for j in self.queue
+                                 if j.state != "failed"]
+                telemetry.gauge("queue_depth", float(len(self.queue)))
+                self._admit(take, sl, slot)
         # drop failed-routing jobs that never got picked
         self.queue[:] = [j for j in self.queue if j.state != "failed"]
 
+    # -- predictive pre-warming --------------------------------------------
+
+    def _job_waiting(self, job) -> bool:
+        """True when the routed job cannot be placed this step: every
+        slice is busy with another group and no matching slot is free.
+        Pre-warming overlaps the compile with that wait instead of
+        stalling the eventual admission."""
+        for sl in self._slices:
+            if sl.plan.state == "failed":
+                continue
+            if not any(sl.residents):
+                return False        # an empty slice will take it
+            if sl.active is not None and sl.active[0] == job.bucket \
+                    and any(r is None for r in sl.residents):
+                return False        # its group has a free slot
+        return True
+
+    def _prewarm(self):
+        """Predictive bucket pre-compilation, driven by the gauges the
+        service already publishes (``compile_stalls``,
+        ``warm_hit_rate``) plus queue composition: pick the first
+        queued cold bucket that must wait anyway and compile it inside
+        a *planned* window.  Hard-capped so it can never starve a
+        resident group: at most ONE compile per step, at most
+        ``prewarm`` outstanding buckets, and fully suspended while the
+        admission controller reports a compile storm."""
+        if not self._prewarm_max or not self.queue:
+            return
+        if self._admission is not None and self._admission.storming():
+            return      # storm: reactive deferral already shields us
+        if len(self._prewarmed) >= self._prewarm_max:
+            return
+        if not (self._compile_stalls > 0
+                or self.cache.warm_hit_rate() < 1.0):
+            return      # no evidence cold compiles hurt: stay reactive
+        from ..analysis import guards
+
+        for job in list(self.queue):
+            if job.cm is not None or job.state == "quarantined":
+                continue
+            if not self._route(job):
+                continue
+            if self.cache.has_bucket(job.bucket) or \
+                    job.bucket in self._prewarmed:
+                continue
+            if not self._job_waiting(job):
+                continue
+            with guards.planned_compile(), \
+                    otrace.span("serve.prewarm", job=job.job_id,
+                                bucket=str(job.bucket.as_tuple())):
+                cm = compile_bucket(job.pta, job.bucket)
+                self.cache.adopt(job.bucket, cm)
+            self._prewarmed.add(job.bucket)
+            self._prewarms += 1
+            telemetry.incr("serve_prewarms")
+            telemetry.gauge("serve_prewarms", float(self._prewarms))
+            if self._admission is not None:
+                self._admission.note_compile()
+            return      # hard cap: at most one prewarm compile per step
+
     # -- filler rows --------------------------------------------------------
 
-    def _filler_state(self, canon):
-        """Host (x, b) for the inert filler stream of the active group
+    def _filler_state(self, key, canon):
+        """Host (x, b) for the inert filler stream of one group
         (prior-midpoint state, reserved-iteration b draw)."""
-        key = self._active
         got = self._fillers.get(key)
         if got is not None:
             return got
@@ -499,14 +645,14 @@ class SamplerService:
 
     # -- the multiplexed chunk ---------------------------------------------
 
-    def _build_stack(self):
+    def _build_stack(self, sl):
         import jax.numpy as jnp
 
-        live = [j for j in self.residents if j is not None]
+        live = [j for j in sl.residents if j is not None]
         canon = self.cache.canonical(live[0].bucket, live[0].cm)
-        fx, fb = self._filler_state(canon)
+        fx, fb = self._filler_state(sl.active, canon)
         cms, X, B, K = [], [], [], []
-        for job in self.residents:
+        for job in sl.residents:
             if job is not None:
                 cms.append(job.cm)
                 X.append(job.x)
@@ -519,50 +665,56 @@ class SamplerService:
                 B.append(fb)
                 K.append(self._tenant_key(FILLER_TENANT))
         cdtype = canon.cdtype
-        self._stack = stack_cms(cms)
-        self._X = jnp.asarray(np.stack(X), cdtype)
-        self._B = jnp.asarray(np.stack(B), cdtype)
-        self._K = jnp.stack(K)
-        if self.mesh is not None:
+        sl.stack = stack_cms(cms)
+        sl.X = jnp.asarray(np.stack(X), cdtype)
+        sl.B = jnp.asarray(np.stack(B), cdtype)
+        sl.K = jnp.stack(K)
+        if sl.mesh is not None:
             from ..parallel.sharding import shard_carry
 
-            self._X, self._B, self._K = shard_carry(
-                self.mesh, (self._X, self._B, self._K), self.slots)
-        self._dirty = False
+            sl.X, sl.B, sl.K = shard_carry(
+                sl.mesh, (sl.X, sl.B, sl.K), sl.slots)
+        sl.dirty = False
 
-    def _it0(self):
+    def _it0(self, sl):
         import jax.numpy as jnp
 
         vals = [(j.it + 1) if j is not None else 1
-                for j in self.residents]
+                for j in sl.residents]
         return jnp.asarray(vals, jnp.int32)
 
-    def _dispatch(self):
-        """One compiled multiplexed chunk; scatter rows to job buffers."""
+    def _dispatch(self, sl):
+        """One compiled multiplexed chunk on one slice; scatter rows to
+        job buffers.  Slices share nothing on device — disjoint chain
+        rows, zero chain-axis collectives — so per-slice dispatches
+        never interact."""
         from ..analysis import guards
 
-        if self._dirty:
+        if sl.dirty:
             # membership change: restacking compiles small staging
             # programs (jnp.stack per leaf) — planned, not a retrace
-            with guards.planned_compile(), otrace.span("serve.restack"):
-                self._build_stack()
+            with guards.planned_compile(), \
+                    otrace.span("serve.restack", slice=sl.slice_id):
+                self._build_stack(sl)
         mux = self.cache.mux(self.chunk)
-        warm_key = (self.chunk, self._active)
-        if warm_key not in self._warmed:
+        warm_key = (self.chunk, sl.active)
+        if warm_key not in sl.warmed:
             with guards.planned_compile(), \
                     otrace.span("serve.compile_dispatch",
-                                chunk=self.global_chunk):
-                args = (self._stack, self._X, self._B, self._K,
-                        self._it0())
+                                chunk=self.global_chunk,
+                                slice=sl.slice_id):
+                args = (sl.stack, sl.X, sl.B, sl.K, self._it0(sl))
                 X, B, xs, bs, health = mux(*args)
-            self._warmed.add(warm_key)
+            sl.warmed.add(warm_key)
         else:
             # the zero-retrace contract lives HERE: a steady chunk with
             # a warmed (chunk, group) must compile nothing
-            with otrace.span("serve.dispatch", chunk=self.global_chunk):
-                X, B, xs, bs, health = mux(self._stack, self._X, self._B,
-                                           self._K, self._it0())
-        self._X, self._B = X, B
+            with otrace.span("serve.dispatch", chunk=self.global_chunk,
+                             slice=sl.slice_id):
+                X, B, xs, bs, health = mux(sl.stack, sl.X, sl.B,
+                                           sl.K, self._it0(sl))
+        sl.X, sl.B = X, B
+        sl.chunks += 1
         with otrace.span("serve.d2h", chunk=self.global_chunk):
             # OWNED host copies, not np.asarray views: on the CPU
             # backend a view aliases the XLA output buffer of a
@@ -574,15 +726,17 @@ class SamplerService:
             h_fin = np.array(health["finite"])     # (T,) per-row verdict
             h_rho = np.array(health["rho_ok"])
         # chaos seam: NaN-poison one tenant's host rows (simulated
-        # single-tenant divergence — the blast-radius drill trigger)
+        # single-tenant divergence — the blast-radius drill trigger);
+        # the maps are slice-local, so a fault targeting a tenant on
+        # another slice stays armed until THAT slice dispatches
         live = {int(j.tenant_id): (s, j.chunks_resident)
-                for s, j in enumerate(self.residents) if j is not None}
+                for s, j in enumerate(sl.residents) if j is not None}
         np_xs, np_bs, _poisoned = faults.poison_tenant_rows(
             np_xs, np_bs, {t: s for t, (s, _) in live.items()},
             {t: r for t, (_, r) in live.items()})
         now = time.monotonic()
         with otrace.span("serve.writeback", chunk=self.global_chunk):
-            for slot, job in enumerate(self.residents):
+            for slot, job in enumerate(sl.residents):
                 if job is None:
                     continue
                 rows = np_xs[:, slot]
@@ -601,7 +755,7 @@ class SamplerService:
                           and np.isfinite(brows[:take]).all()):
                     breach = "non-finite chunk rows (host)"
                 if breach is not None:
-                    self._quarantine(slot, breach)
+                    self._quarantine(sl, slot, breach)
                     continue
                 job.chain[job.it:job.it + take] = rows[:take]
                 job.bchain[job.it:job.it + take] = brows[:take]
@@ -617,6 +771,7 @@ class SamplerService:
                 if br is not None:
                     br.record_success()
                 self._observe_job(job, rows[:take], now)
+        sl.plan.resident()
 
     def _observe_job(self, job, rows, now):
         """Feed the job's live diagnostics window and publish its SLO
@@ -635,6 +790,18 @@ class SamplerService:
         telemetry.gauge("serve_rhat_max", diag.rhat_max(), **lab)
         telemetry.gauge("serve_accept_rate", diag.accept_rate(), **lab)
 
+    def _slice_gauges(self):
+        """Per-slice residency/health series, slice-labeled so the
+        Prometheus scrape separates fault domains."""
+        for sl in self._slices:
+            lab = {"slice": str(sl.slice_id)}
+            telemetry.gauge("serve_slice_residents", float(sl.live()),
+                            **lab)
+            telemetry.gauge("serve_slice_chunks", float(sl.chunks),
+                            **lab)
+            telemetry.gauge("serve_slice_losses", float(sl.losses),
+                            **lab)
+
     # -- drain / recovery ---------------------------------------------------
 
     def _drain(self):
@@ -646,17 +813,18 @@ class SamplerService:
         all_ok = True
         with otrace.span("serve.drain",
                          jobs=sum(1 for j in self.residents if j)):
-            for slot, job in enumerate(self.residents):
-                if job is None:
-                    continue
-                job.set_state("draining")
-                job.checkpoint()
-                res = integrity.verify(job.store.outdir)
-                if not res["ok"]:
-                    all_ok = integrity.rollback(job.store.outdir) \
-                        and all_ok
-                rows += job.it
-                job.set_state("queued")     # resumable, not failed
+            for sl in self._slices:
+                for slot, job in enumerate(sl.residents):
+                    if job is None:
+                        continue
+                    job.set_state("draining")
+                    job.checkpoint()
+                    res = integrity.verify(job.store.outdir)
+                    if not res["ok"]:
+                        all_ok = integrity.rollback(job.store.outdir) \
+                            and all_ok
+                    rows += job.it
+                    job.set_state("queued")     # resumable, not failed
         preemption.mark_drained()
         raise preemption.Preempted(
             f"service drained {sum(1 for j in self.residents if j)} "
@@ -665,28 +833,31 @@ class SamplerService:
     def _revert_residents(self):
         """Roll every resident back to its last verified checkpoint
         (retry path: the replay from there is bit-exact)."""
-        for slot, job in enumerate(self.residents):
-            if job is None:
-                continue
-            job.it = 0
-            if not job.try_resume():
-                job.x = self._x0(job)
-                import jax.numpy as jnp
+        for sl in self._slices:
+            for job in sl.residents:
+                if job is None:
+                    continue
+                job.it = 0
+                if not job.try_resume():
+                    job.x = self._x0(job)
+                    import jax.numpy as jnp
 
-                from ..analysis import guards
+                    from ..analysis import guards
 
-                with guards.planned_compile():
-                    b = self.cache.init_fn()(
-                        job.cm, jnp.asarray(job.x, job.cm.cdtype),
-                        self._init_key(job.tenant_id, job.generation))
-                job.b = np.asarray(b, np.float64)
-        self._dirty = True
+                    with guards.planned_compile():
+                        b = self.cache.init_fn()(
+                            job.cm, jnp.asarray(job.x, job.cm.cdtype),
+                            self._init_key(job.tenant_id,
+                                           job.generation))
+                    job.b = np.asarray(b, np.float64)
+            sl.dirty = True
 
     # -- scheduler loop -----------------------------------------------------
 
     def step(self) -> bool:
-        """One scheduling round: seam, churn, admission, one chunk,
-        checkpoints.  Returns False when there is nothing to run."""
+        """One scheduling round: seam, churn, admission, pre-warm, one
+        chunk per occupied slice, checkpoints.  Returns False when
+        there is nothing to run."""
         if preemption.drain_requested() and any(self.residents):
             self._drain()
         self.global_chunk += 1
@@ -696,63 +867,110 @@ class SamplerService:
             job_rows={int(j.tenant_id): j.chunks_resident
                       for j in self.residents if j is not None})
         if evict_req:
-            for slot, job in enumerate(self.residents):
-                if job is None:
-                    continue
-                if evict_req is True:
-                    # untargeted (historical): evict any one resident
-                    self._evict(slot, "injected")
-                    break
-                if int(job.tenant_id) in evict_req:
-                    self._evict(slot, "injected")
-        # fair share: the longest-resident tenant yields to a non-empty
-        # queue after its quantum
+            evicted_any = False
+            for sl in self._slices:
+                for slot, job in enumerate(sl.residents):
+                    if job is None:
+                        continue
+                    if evict_req is True:
+                        # untargeted (historical): evict any one
+                        if not evicted_any:
+                            self._evict(sl, slot, "injected")
+                            evicted_any = True
+                    elif int(job.tenant_id) in evict_req:
+                        self._evict(sl, slot, "injected")
+        # fair share: the longest-resident tenant of a pressured slice
+        # yields to a non-empty queue after its quantum (single-slice:
+        # any queued work is pressure — the historical behavior)
         if self.queue:
-            held = [(j.chunks_resident, s)
-                    for s, j in enumerate(self.residents) if j is not None]
-            if held:
+            for sl in self._slices:
+                held = [(j.chunks_resident, s)
+                        for s, j in enumerate(sl.residents)
+                        if j is not None]
+                if not held:
+                    continue
+                if len(self._slices) > 1 and \
+                        not self._slice_pressure(sl):
+                    continue
                 most, slot = max(held)
                 if most >= self.quantum:
-                    self._evict(slot, "quantum")
+                    self._evict(sl, slot, "quantum")
         self._admissions()
+        self._prewarm()
+        groups = {sl.active for sl in self._slices
+                  if sl.active is not None and any(sl.residents)}
+        if len(groups) > self._max_groups:
+            self._max_groups = len(groups)
         if not any(self.residents):
             return False
-        self._dispatch()
-        for slot, job in enumerate(self.residents):
-            if job is None:
-                continue
-            if job.done:
-                job.checkpoint()
-                job.set_state("done")
-                self.residents[slot] = None
-                self._dirty = True
-            elif job.chunks_resident % self.save_every == 0:
-                job.checkpoint()
+        for sl in self._slices:
+            if any(sl.residents):
+                self._dispatch(sl)
+        for sl in self._slices:
+            for slot, job in enumerate(sl.residents):
+                if job is None:
+                    continue
+                if job.done:
+                    job.checkpoint()
+                    job.set_state("done")
+                    sl.residents[slot] = None
+                    sl.dirty = True
+                elif job.chunks_resident % self.save_every == 0:
+                    job.checkpoint()
+        self._slice_gauges()
         telemetry.gauge("queue_depth", float(len(self.queue)))
         return True
 
-    def evacuate(self, devices=None) -> None:
-        """Device-loss recovery: drain every resident through its own
-        verified checkpoint (the host row buffers are intact — the lost
-        device only held carries and compiled programs), drop every
-        device-resident artifact, rebuild on the surviving submesh and
-        re-admit the drained jobs at the queue head.  The per-job
-        analogue of the single-tenant ``integrity.reshard_restore``
-        path: streams are pure in (service_seed, tenant_id, iteration),
-        so the re-admitted jobs replay bit-identically on the new
-        topology."""
+    def _slice_pressure(self, sl) -> bool:
+        """Fair-share pressure on one slice: a queued job that is
+        unrouted (could land anywhere) or routed to this slice's
+        group.  Without pressure, a multi-slice resident never yields
+        its quantum to work that another slice will serve — the
+        no-cross-group-drain-waits half of the placement contract."""
+        for j in self.queue:
+            if j.cm is None:
+                return True
+            if sl.active is not None and \
+                    self._group_key(j) == sl.active:
+                return True
+        return False
+
+    # -- device-loss fault domains ------------------------------------------
+
+    def evacuate(self, devices=None, slice_id=None) -> None:
+        """Device-loss recovery.  With ``slice_id`` on a multi-slice
+        service, delegate to :meth:`evacuate_slice`: ONLY that fault
+        domain's group re-places, every survivor slice keeps its warmed
+        programs and its bitwise stream.  Otherwise (whole-service
+        loss) drain every resident through its own verified checkpoint
+        (the host row buffers are intact — the lost device only held
+        carries and compiled programs), drop every device-resident
+        artifact, rebuild on the surviving submesh and re-admit the
+        drained jobs at the queue head.  The per-job analogue of the
+        single-tenant ``integrity.reshard_restore`` path: streams are
+        pure in (service_seed, tenant_id, iteration), so the
+        re-admitted jobs replay bit-identically on the new topology."""
+        if slice_id is not None and len(self._slices) > 1:
+            sl = self._engine.slice_by_id(slice_id)
+            if sl is None:
+                raise PlacementError(
+                    f"evacuate: unknown slice {slice_id}",
+                    slice_id=slice_id)
+            self.evacuate_slice(sl)
+            return
         with otrace.span("serve.evacuate",
                          jobs=sum(1 for j in self.residents if j),
                          devices=devices):
             drained = []
-            for slot, job in enumerate(self.residents):
-                if job is None:
-                    continue
-                job.checkpoint()
-                job.set_state("queued")
-                job.cm = None          # recompile on the new topology
-                self.residents[slot] = None
-                drained.append(job)
+            for sl in self._slices:
+                for slot, job in enumerate(sl.residents):
+                    if job is None:
+                        continue
+                    job.checkpoint()
+                    job.set_state("queued")
+                    job.cm = None      # recompile on the new topology
+                    sl.residents[slot] = None
+                    drained.append(job)
             self.queue[:0] = drained
             telemetry.gauge("queue_depth", float(len(self.queue)))
             # compiled programs, canonical statics and filler carries
@@ -760,11 +978,12 @@ class SamplerService:
             self.cache = ProgramCache()
             for job in self.jobs.values():
                 job.cm = None
-            self._warmed.clear()
+            for sl in self._slices:
+                sl.warmed.clear()
+                sl.stack = sl.X = sl.B = sl.K = None
+                sl.active = None
+                sl.dirty = True
             self._fillers.clear()
-            self._stack = self._X = self._B = self._K = None
-            self._active = None
-            self._dirty = True
             if devices is None or int(devices) <= 1:
                 self.mesh = None
             else:
@@ -779,6 +998,133 @@ class SamplerService:
                     self.mesh = mesh
                 except Exception:
                     self.mesh = None  # survivors can't form a mesh
+            self._engine.recarve(self.mesh)
+
+    def evacuate_slice(self, sl) -> None:
+        """Slice-scoped device-loss recovery: the lost slice's
+        residents checkpoint their intact host rows and requeue at the
+        head; only THIS slice's warmed programs and stacked carries
+        drop.  The shared :class:`~.engine.ProgramCache`, the jobs'
+        grafted programs and every other slice's state stay untouched —
+        survivors keep dispatching their already-warm programs without
+        a single retrace, and their streams (pure in the tenant
+        identity) stay bitwise."""
+        with otrace.span("serve.evacuate_slice", slice=sl.slice_id,
+                         jobs=sl.live()):
+            sl.plan.migrating()
+            drained = []
+            for slot, job in enumerate(sl.residents):
+                if job is None:
+                    continue
+                job.checkpoint()
+                job.set_state("queued")
+                sl.residents[slot] = None
+                drained.append(job)
+            self.queue[:0] = drained
+            telemetry.gauge("queue_depth", float(len(self.queue)))
+            sl.warmed.clear()
+            sl.stack = sl.X = sl.B = sl.K = None
+            sl.active = None
+            sl.dirty = True
+            self._slice_gauges()
+
+    def _slice_loss(self, sl, exc, defer_backoff) -> bool:
+        """The supervised slice-loss path: budget check (typed terminal
+        :class:`~.placement.PlacementError` when more than
+        ``replace_max`` losses land within ``replace_window``),
+        slice-scoped evacuation, deterministic per-slice backoff."""
+        sl.plan.migrating()
+        try:
+            retry = self._engine.note_loss(sl)
+        except PlacementError as perr:
+            # budget exhausted: the slice parks failed, its jobs park
+            # failed with verified checkpoints intact (resubmit after
+            # operator intervention) — the typed terminal report
+            sl.plan.fail()
+            for slot, job in enumerate(sl.residents):
+                if job is None:
+                    continue
+                job.checkpoint()
+                job.failure = (f"slice {sl.slice_id} re-place budget "
+                               f"exhausted: {exc}")
+                job.set_state("failed")
+                sl.residents[slot] = None
+            sl.dirty = True
+            self._slice_gauges()
+            raise perr from exc
+        self._evacuations += 1
+        telemetry.incr("device_evacuations")
+        self.evacuate_slice(sl)
+        delay = supervisor.backoff_delay(
+            retry, base=self.backoff_base, jitter=0.0,
+            seed=self.service_seed + sl.slice_id)
+        if defer_backoff:
+            self._pending_backoff = float(delay)
+        else:
+            time.sleep(delay)
+        return True
+
+    # -- rebalancing ---------------------------------------------------------
+
+    def _vacate_slice(self, sl, reason):
+        """Drain one slice's residents through VERIFIED checkpoints and
+        requeue them — the rebalance prerequisite.  Like the
+        standing-model migrations there is never a torn hybrid: the
+        geometry only mutates after every affected job is resumable
+        from its own verified directory, and the in-memory layout is
+        ephemeral (a restart sees only per-job checkpoints)."""
+        from ..runtime import integrity
+
+        with otrace.span("serve.vacate", slice=sl.slice_id,
+                         reason=reason, jobs=sl.live()):
+            for slot, job in enumerate(sl.residents):
+                if job is None:
+                    continue
+                job.set_state("draining")
+                job.checkpoint()
+                res = integrity.verify(job.store.outdir)
+                if not res["ok"]:
+                    integrity.rollback(job.store.outdir)
+                job.set_state("queued")
+                sl.residents[slot] = None
+                self.queue.append(job)
+            sl.plan.draining()
+            sl.plan.drained()
+            sl.warmed.clear()
+            sl.stack = sl.X = sl.B = sl.K = None
+            sl.active = None
+            sl.dirty = True
+            telemetry.gauge("queue_depth", float(len(self.queue)))
+
+    def split_slice(self, slice_id, *, slots=None, chains=None):
+        """Rebalance: split one slice into two (load shifted toward
+        more, smaller groups).  Residents drain through verified
+        checkpoints FIRST, then the geometry mutates; the drained jobs
+        re-admit onto the new slices and replay bit-exactly (streams
+        are pure in the tenant identity).  Returns the new slices."""
+        sl = self._engine.slice_by_id(slice_id)
+        if sl is None:
+            raise PlacementError(f"split: unknown slice {slice_id}",
+                                 slice_id=slice_id)
+        self._vacate_slice(sl, "split")
+        parts = self._engine.split_slice(slice_id, slots=slots,
+                                         chains=chains)
+        self.slots = self._engine.total_slots
+        return parts
+
+    def merge_slices(self, a_id, b_id):
+        """Rebalance: merge two adjacent slices (load shifted toward
+        one wider group).  Same verified-checkpoint ordering as
+        :meth:`split_slice`.  Returns the merged slice."""
+        for sid in (a_id, b_id):
+            sl = self._engine.slice_by_id(sid)
+            if sl is None:
+                raise PlacementError(f"merge: unknown slice {sid}",
+                                     slice_id=sid)
+            self._vacate_slice(sl, "merge")
+        merged = self._engine.merge_slices(a_id, b_id)
+        self.slots = self._engine.total_slots
+        return merged
 
     def drain_job(self, job_id, reason="request") -> bool:
         """Per-request drain of ONE job through its verified
@@ -799,10 +1145,11 @@ class SamplerService:
         if job in self.queue:
             self.queue.remove(job)
             telemetry.gauge("queue_depth", float(len(self.queue)))
-        for slot, res in enumerate(self.residents):
-            if res is job:
-                self.residents[slot] = None
-                self._dirty = True
+        for sl in self._slices:
+            for slot, res in enumerate(sl.residents):
+                if res is job:
+                    sl.residents[slot] = None
+                    sl.dirty = True
         if job.store is None:
             # never admitted: nothing on disk to verify, nothing held
             otrace.instant("serve.drain_job", job=job_id, reason=reason)
@@ -838,8 +1185,12 @@ class SamplerService:
         holds.  ``journaled=True`` tells the migration ticket the
         caller (the gateway) made the forking intent durable before
         calling — the service-level path goes planned → forked
-        directly.  Raises :class:`~.buckets.BucketOverflow` (hint
-        attached) when no bucket covers the grown shape, and
+        directly.  On a multi-slice service the child routes by its
+        GROUP like any admission: it lands on the slice hosting its
+        (bucket, signature), or claims an empty slice — never "the
+        active group" (there is no global one).  Raises
+        :class:`~.buckets.BucketOverflow` (hint attached) when no
+        bucket covers the grown shape, and
         :class:`~..runtime.lineage.LineageError` when no generation of
         the parent verifies.
         """
@@ -936,15 +1287,18 @@ class SamplerService:
     def step_supervised(self, defer_backoff=False) -> bool:
         """One scheduling round under the recovery ladder: runs
         :meth:`step` and absorbs the retryable failure classes the
-        supervisor taxonomy allows — device loss evacuates onto the
-        surviving submesh (up to ``evac_max``), device/crash/stall
-        classes revert every resident to its verified checkpoint and
-        back off deterministically (up to ``max_retries``).  ``user``/
-        ``unknown`` errors, exhausted budgets and ``Preempted`` re-
-        raise.  Returns False when there was nothing to run — both
-        :meth:`run` and the gateway scheduler thread are thin loops
-        over this, so in-process and network-fronted serving share one
-        recovery path.
+        supervisor taxonomy allows — a device loss attributed to one
+        slice (multi-slice service) evacuates and re-places ONLY that
+        fault domain under its capped budget; an unattributed loss
+        evacuates the whole service onto the surviving submesh (up to
+        ``evac_max``); device/crash/stall classes revert every resident
+        to its verified checkpoint and back off deterministically (up
+        to ``max_retries``).  ``user``/``unknown`` errors (including
+        the typed :class:`~.placement.PlacementError` budget trip),
+        exhausted budgets and ``Preempted`` re-raise.  Returns False
+        when there was nothing to run — both :meth:`run` and the
+        gateway scheduler thread are thin loops over this, so
+        in-process and network-fronted serving share one recovery path.
 
         ``defer_backoff=True`` parks the retry delay in
         :meth:`take_backoff` instead of sleeping inline — the gateway
@@ -955,6 +1309,11 @@ class SamplerService:
         except preemption.Preempted:
             raise
         except faults.DeviceLost as exc:
+            sid = getattr(exc, "slice_id", None)
+            if sid is not None and len(self._slices) > 1:
+                sl = self._engine.slice_by_id(sid)
+                if sl is not None:
+                    return self._slice_loss(sl, exc, defer_backoff)
             if self._evacuations >= self.evac_max:
                 raise
             self._evacuations += 1
@@ -989,9 +1348,9 @@ class SamplerService:
         """Drive every submitted job to done/failed.  Retries
         retryable step failures (device/crash/stall classes) with
         deterministic backoff after reverting residents to their
-        checkpoints; evacuates onto the surviving submesh on device
-        loss (up to ``evac_max`` times); re-raises ``user`` errors and
-        ``Preempted``."""
+        checkpoints; evacuates the lost slice (or the whole service)
+        on device loss under the capped budgets; re-raises ``user``
+        errors and ``Preempted``."""
         while True:
             worked = self.step_supervised()
             if not worked:
@@ -1007,7 +1366,8 @@ class SamplerService:
         """Prometheus text-format exposition of the process telemetry
         registry — counters (``_total``) and gauges, labels preserved,
         including the per-job ``serve_ess_per_sec`` /
-        ``serve_rhat_max`` / ``serve_accept_rate`` SLO series."""
+        ``serve_rhat_max`` / ``serve_accept_rate`` SLO series and the
+        slice-labeled ``serve_slice_*`` fault-domain series."""
         from ..obs import metrics
 
         return metrics.render_telemetry()
@@ -1038,6 +1398,19 @@ class SamplerService:
             "admission": (None if self._admission is None
                           else self._admission.snapshot()),
             "mesh": mesh_layout(self.mesh),
+            "placement": {
+                "slices": self._engine.report(),
+                "groups": {
+                    str(tuple(b.as_tuple())): {
+                        "hits": int(h), "misses": int(m),
+                        "warm_hit_rate": (h / (h + m)) if (h + m)
+                        else 0.0}
+                    for b, (h, m) in self._group_warmth.items()},
+                "max_concurrent_groups": int(self._max_groups),
+                "prewarms": int(self._prewarms),
+                "replace_max": int(self._engine.replace_max),
+                "replace_window": float(self._engine.replace_window),
+            },
             "gauges": telemetry.gauges(),
         }
         if self._stage_agg is not None:

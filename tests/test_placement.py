@@ -1,0 +1,400 @@
+"""Placement-engine contracts (serve/placement.py + the service's
+per-slice scheduler).
+
+The load-bearing claims, each tested end-to-end on tiny synthetics:
+
+- slice geometry: an explicit layout carves disjoint chain-submesh
+  slices; per-slice slot widths the chain sub-axis cannot split refuse
+  with a typed :class:`PlacementError` naming the slice, the required
+  multiple and the nearest legal slot count;
+- TWO ``(bucket, signature)`` groups with different chain counts AND
+  different slot widths sample CONCURRENTLY on their own slices —
+  deterministic across incarnations, ULP-close to unplaced solos
+  (GSPMD reduction regrouping, same class as the single-group mesh
+  contract), with zero unplanned serve-phase retraces;
+- a slice-attributed device loss evacuates and re-places ONLY the
+  victim slice's group (survivors bitwise, not retraced); a second
+  loss inside ``replace_window`` trips the capped re-place budget with
+  a typed terminal report while co-resident groups keep sampling;
+- split/merge rebalancing drains residents through verified
+  checkpoints first and the drained jobs replay bit-exactly;
+- predictive pre-warming compiles a queued-but-unplaceable bucket
+  under its hard cap, so the group admits warm when a slice frees;
+- the slice-labeled ``serve_slice_*`` gauges ride the Prometheus
+  exposition with parseable (escaped) label values;
+- a gateway restart with TWO groups journaled re-routes each group to
+  its own slice and finishes both.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from pulsar_timing_gibbsspec_tpu.serve.buckets import BucketSpec, BucketTable
+from pulsar_timing_gibbsspec_tpu.serve.placement import (PlacementEngine,
+                                                         PlacementError)
+
+NITER = 8
+
+
+def _mk(ntoa, seed, nmodes=3):
+    from pulsar_timing_gibbsspec_tpu.analysis.jaxprcheck.entries import (
+        build_model, synthetic_pulsars)
+
+    return build_model(synthetic_pulsars(2, ntoa, tm_cols=3, seed=seed),
+                       nmodes)
+
+
+_CACHE = None
+
+
+def _service(root, table, **kw):
+    """Fresh service sharing the module-wide program cache so the
+    suite compiles each (bucket, width) once, not per service."""
+    global _CACHE
+    from pulsar_timing_gibbsspec_tpu.serve import ProgramCache, SamplerService
+
+    if _CACHE is None:
+        _CACHE = ProgramCache()
+    kw.setdefault("cache", _CACHE)
+    kw.setdefault("chunk", 4)
+    kw.setdefault("quantum", 100)
+    kw.setdefault("save_every", 1)
+    return SamplerService(root, table, **kw)
+
+
+@pytest.fixture(scope="module")
+def table2():
+    return BucketTable([BucketSpec(2, 40, 24, 3),
+                        BucketSpec(2, 48, 24, 3)])
+
+
+@pytest.fixture(scope="module")
+def group_ptas():
+    """Group A (bucket 40): tenants 0-1.  Group B (bucket 48):
+    tenants 2-4 — strictly past bucket 40 so smallest-cover routing
+    keeps the groups apart."""
+    return ([_mk(24, 0), _mk(30, 1)],
+            [_mk(44, 2), _mk(46, 3), _mk(48, 4)])
+
+
+@pytest.fixture(scope="module")
+def solo_chains(group_ptas, table2, tmp_path_factory):
+    """Uninterrupted solo baselines, tenant_id = index, in the
+    two-slice UNPLACED geometry (unplaced runs are bitwise regardless
+    of slot/placement geometry, so these are exact references for
+    every unplaced drill below and ULP references for the mesh one)."""
+    base = tmp_path_factory.mktemp("placement_solo")
+    out = []
+    for i, pta in enumerate(group_ptas[0] + group_ptas[1]):
+        svc = _service(base / f"s{i}", table2,
+                       placement=[{"slots": 2}, {"slots": 2}])
+        job = svc.submit(pta, NITER, job_id=f"solo{i}", tenant_id=i)
+        svc.run()
+        assert job.state == "done"
+        out.append((job.chain.copy(), job.bchain.copy()))
+    return out
+
+
+def _submit_groups(svc, group_ptas, niter=NITER, nb=None):
+    """Group A first (claims slice 0), then group B (claims slice 1)."""
+    ptas_a, ptas_b = group_ptas
+    jobs = [svc.submit(p, niter, job_id=f"a{i}", tenant_id=i)
+            for i, p in enumerate(ptas_a)]
+    for i, p in enumerate(ptas_b[:nb] if nb else ptas_b):
+        jobs.append(svc.submit(p, niter, job_id=f"b{i}",
+                               tenant_id=len(ptas_a) + i))
+    return jobs
+
+
+# -- geometry and typed refusals -------------------------------------------
+
+def test_engine_carves_disjoint_fault_domains():
+    """An explicit layout carves consecutive chain spans into
+    standalone submeshes sharing NO devices, validates per-slice, and
+    refuses spans past the chain axis."""
+    from pulsar_timing_gibbsspec_tpu.parallel.sharding import make_mesh
+
+    mesh = make_mesh((4, 2))
+    eng = PlacementEngine(mesh, layout=[{"slots": 2, "chains": 1},
+                                        {"slots": 3, "chains": 3}])
+    assert eng.total_slots == 5
+    ids = [set(d.id for d in sl.mesh.devices.flat) for sl in eng.slices]
+    assert ids[0] & ids[1] == set()
+    assert len(ids[0] | ids[1]) == 8
+    rows = [r["chain_rows"] for r in eng.report()]
+    assert rows == [[0, 1], [1, 4]]
+    with pytest.raises(PlacementError, match="exceeds the mesh"):
+        PlacementEngine(mesh, layout=[{"slots": 3, "chains": 3},
+                                      {"slots": 2, "chains": 2}])
+    with pytest.raises(PlacementError, match="empty"):
+        PlacementEngine(mesh, layout=[])
+    # split/merge guardrails: unknown ids, non-adjacency
+    with pytest.raises(PlacementError, match="unknown slice"):
+        eng.split_slice(99)
+    eng2 = PlacementEngine(None, layout=[{"slots": 2}, {"slots": 2},
+                                         {"slots": 2}])
+    a, _, c = eng2.slices
+    with pytest.raises(PlacementError, match="not adjacent"):
+        eng2.merge_slices(a.slice_id, c.slice_id)
+
+
+def test_divisibility_refusal_is_typed(table2, tmp_path):
+    """A per-slice slot width the slice's chain sub-axis cannot split
+    refuses at the SERVICE boundary with the historical "multiple of N"
+    message, and the typed error carries the slice, the required
+    multiple and the nearest legal slot count (satellite: the old
+    global slots-vs-mesh check misfired for per-group slices)."""
+    from pulsar_timing_gibbsspec_tpu.parallel.sharding import make_mesh
+    from pulsar_timing_gibbsspec_tpu.serve import SamplerService
+
+    mesh = make_mesh((4, 2))
+    with pytest.raises(PlacementError, match="multiple of 2") as ei:
+        SamplerService(tmp_path / "bad", table2, mesh=mesh,
+                       placement=[{"slots": 2, "chains": 2},
+                                  {"slots": 3, "chains": 2}])
+    assert ei.value.slice_id == 1
+    assert ei.value.required_multiple == 2
+    assert ei.value.nearest == 4
+    assert isinstance(ei.value, ValueError)      # historical contract
+
+
+# -- concurrent groups on mesh slices --------------------------------------
+
+def test_two_groups_concurrent_on_mesh_slices(group_ptas, table2,
+                                              solo_chains, tmp_path):
+    """The acceptance drill: two groups with different buckets AND
+    different chain counts (1 vs 3 chain rows) and slot widths (2 vs 3)
+    resident CONCURRENTLY on disjoint slices of a (4, 2) mesh.  Two
+    incarnations are bitwise identical; vs the unplaced solos the
+    chains agree at the f64 reduction-order class (same bar as the
+    single-group mesh contract); zero unplanned serve retraces."""
+    from pulsar_timing_gibbsspec_tpu.parallel.sharding import make_mesh
+    from pulsar_timing_gibbsspec_tpu.profiling import recompile_counter
+
+    mesh = make_mesh((4, 2))
+    layout = [{"slots": 2, "chains": 1}, {"slots": 3, "chains": 3}]
+
+    def run(root):
+        svc = _service(tmp_path / root, table2, mesh=mesh,
+                       placement=layout)
+        jobs = _submit_groups(svc, group_ptas)
+        report = svc.run()
+        return report, jobs, [j.chain.copy() for j in jobs]
+
+    with recompile_counter() as rc:
+        rc.phase("serve")
+        report, jobs, chains = run("mesh_a")
+        _, _, chains_b = run("mesh_b")
+    assert rc.unplanned("serve") == 0
+    for i, job in enumerate(jobs):
+        assert job.state == "done"
+        np.testing.assert_array_equal(chains[i], chains_b[i])
+        scale = np.abs(solo_chains[i][0]).max()
+        assert np.abs(chains[i] - solo_chains[i][0]).max() < 1e-12 * scale
+    pl = report["placement"]
+    assert pl["max_concurrent_groups"] >= 2
+    assert [s["chains"] for s in pl["slices"]] == [1, 3]
+    assert sorted(tuple(s["group"]) for s in pl["slices"]
+                  if s["group"]) == []            # drained at the end
+    assert all(s["chunks"] > 0 for s in pl["slices"])
+
+
+# -- fault domains ---------------------------------------------------------
+
+def test_slice_loss_evacuates_victim_only(group_ptas, table2,
+                                          solo_chains, tmp_path):
+    """A slice-attributed device loss re-places ONLY the victim
+    slice's group: every job still finishes, every chain is bitwise vs
+    its solo, the survivor slice records zero losses and nothing
+    retraces (satellite: evacuate→placement reuse)."""
+    from pulsar_timing_gibbsspec_tpu.profiling import recompile_counter
+    from pulsar_timing_gibbsspec_tpu.runtime import faults
+
+    svc = _service(tmp_path / "loss", table2,
+                   placement=[{"slots": 2}, {"slots": 2}])
+    faults.clear()
+    faults.inject("device_loss", point="serve.chunk", at_row=2, times=1,
+                  slice=0)
+    try:
+        with recompile_counter() as rc:
+            rc.phase("serve")
+            jobs = _submit_groups(svc, group_ptas, nb=2)
+            report = svc.run()
+    finally:
+        faults.clear()
+    assert rc.unplanned("serve") == 0            # survivor not retraced
+    for i, job in enumerate(jobs):
+        assert job.state == "done"
+        np.testing.assert_array_equal(job.chain, solo_chains[i][0])
+        np.testing.assert_array_equal(job.bchain, solo_chains[i][1])
+    assert report["evacuations"] == 1
+    losses = {s["slice"]: s["losses"] for s in report["placement"]["slices"]}
+    assert losses == {0: 1, 1: 0}
+
+
+def test_replace_budget_trips_typed_terminal(group_ptas, table2,
+                                             solo_chains, tmp_path):
+    """A second loss on the same slice inside ``replace_window`` trips
+    the capped re-place budget: the victim slice parks ``failed`` with
+    a typed terminal report and its jobs park ``failed`` with verified
+    checkpoints intact, while the co-resident group finishes bitwise."""
+    from pulsar_timing_gibbsspec_tpu.runtime import faults
+
+    niter = 24
+    base = tmp_path / "budget"
+    refs = []
+    for i, pta in enumerate(group_ptas[1][:2]):
+        s = _service(base / f"solo{i}", table2,
+                     placement=[{"slots": 2}, {"slots": 2}])
+        j = s.submit(pta, niter, job_id=f"solo{i}", tenant_id=2 + i)
+        s.run()
+        refs.append(j.chain.copy())
+
+    svc = _service(base / "svc", table2,
+                   placement=[{"slots": 2}, {"slots": 2}],
+                   clock=lambda: 0.0)            # losses never age out
+    faults.clear()
+    faults.inject("device_loss", point="serve.chunk", at_row=3, times=1,
+                  slice=0)
+    faults.inject("device_loss", point="serve.chunk", at_row=7, times=1,
+                  slice=0)
+    jobs = _submit_groups(svc, group_ptas, niter=niter, nb=2)
+    try:
+        with pytest.raises(PlacementError,
+                           match="re-place budget exhausted") as ei:
+            svc.run()
+    finally:
+        faults.clear()
+    assert ei.value.slice_id == 0
+    victims = jobs[:2]
+    for job in victims:
+        assert job.state == "failed"
+        assert "re-place budget exhausted" in job.failure
+    states = {s["slice"]: s["state"] for s in svc.report()["placement"]
+              ["slices"]}
+    assert states[0] == "failed"
+    # the surviving fault domain picks up where the raise left it
+    report = svc.run()
+    for i, job in enumerate(jobs[2:]):
+        assert job.state == "done"
+        np.testing.assert_array_equal(job.chain, refs[i])
+    assert report["placement"]["slices"][1]["losses"] == 0
+
+
+# -- rebalancing -----------------------------------------------------------
+
+def test_split_merge_through_verified_checkpoints(group_ptas, table2,
+                                                  solo_chains, tmp_path):
+    """Mid-run split drains the residents through verified checkpoints
+    BEFORE the geometry mutates; they re-admit onto the new slices and
+    replay bit-exactly.  Merging the (empty) pair restores one slice."""
+    svc = _service(tmp_path / "rebal", table2,
+                   placement=[{"slots": 4}])
+    jobs = _submit_groups(svc, group_ptas, nb=0)     # group A only
+    assert svc.step()                # chunk 1 of 2: residents mid-run
+    assert any(j is not None
+               for j in svc._engine.slices[0].residents)
+    parts = svc.split_slice(0)
+    assert len(svc._engine.slices) == 2
+    assert [p.slots for p in parts] == [2, 2]
+    assert svc.slots == 4
+    svc.run()
+    for i, job in enumerate(jobs):
+        assert job.state == "done"
+        np.testing.assert_array_equal(job.chain, solo_chains[i][0])
+    merged = svc.merge_slices(parts[0].slice_id, parts[1].slice_id)
+    assert len(svc._engine.slices) == 1
+    assert merged.slots == 4
+
+
+# -- predictive pre-warming ------------------------------------------------
+
+def test_prewarm_compiles_waiting_bucket_under_cap(group_ptas, table2,
+                                                   tmp_path):
+    """With every slot held by group A, a queued group-B job cannot
+    place; the warmth gauges (cold cache → ``warm_hit_rate`` < 1) pick
+    its bucket for a PLANNED pre-warm compile, so B admits with zero
+    misses when the slice frees.  The hard cap holds (one outstanding
+    prewarm bucket)."""
+    from pulsar_timing_gibbsspec_tpu.serve import ProgramCache
+
+    svc = _service(tmp_path / "prewarm", table2,
+                   cache=ProgramCache(),         # cold on purpose
+                   placement=[{"slots": 2}], prewarm=1)
+    jobs = _submit_groups(svc, group_ptas, nb=1)
+    report = svc.run()
+    assert all(j.state == "done" for j in jobs)
+    pl = report["placement"]
+    assert pl["prewarms"] == 1
+    bucket_b = str(tuple(BucketSpec(2, 48, 24, 3).as_tuple()))
+    assert pl["groups"][bucket_b]["misses"] == 0
+    assert pl["groups"][bucket_b]["warm_hit_rate"] == 1.0
+
+
+# -- observability ---------------------------------------------------------
+
+def test_slice_gauges_ride_prometheus_with_labels(group_ptas, table2,
+                                                  tmp_path):
+    """The per-slice fault-domain series are slice-labeled in the
+    Prometheus exposition and parse cleanly back through
+    ``metrics.split_key`` — the same escaped-label path the hostile
+    tenant-name series travel (PR 17)."""
+    from pulsar_timing_gibbsspec_tpu.obs import metrics
+    from pulsar_timing_gibbsspec_tpu.runtime import telemetry
+
+    telemetry.reset()
+    svc = _service(tmp_path / "gauges", table2,
+                   placement=[{"slots": 2}, {"slots": 2}])
+    jobs = _submit_groups(svc, group_ptas, nb=2)
+    svc.run()
+    assert all(j.state == "done" for j in jobs)
+    body = svc.prometheus()
+    seen = {}
+    for line in body.splitlines():
+        if not line.startswith("ptgibbs_serve_slice_"):
+            continue
+        name, labels = metrics.split_key(line.rsplit(" ", 1)[0]
+                                         .removeprefix("ptgibbs_"))
+        seen.setdefault(name, set()).add(labels["slice"])
+    for fam in ("serve_slice_residents", "serve_slice_chunks",
+                "serve_slice_losses"):
+        assert seen[fam] == {"0", "1"}
+    telemetry.reset()
+
+
+# -- gateway restart with two groups journaled -----------------------------
+
+def test_gateway_restart_readmits_two_groups_to_own_slices(table2,
+                                                           tmp_path):
+    """Satellite: the ``_readmit`` path under multi-group placement.
+    Two journaled jobs of DIFFERENT buckets re-materialize on restart
+    and route each to its own slice (routing is by group key — there
+    is no global active group to misroute to), both finishing."""
+    from pulsar_timing_gibbsspec_tpu.serve.gateway import Gateway
+    from pulsar_timing_gibbsspec_tpu.serve.wire import WireRequest
+
+    svc_kw = dict(chunk=4, quantum=100, save_every=1,
+                  placement=[{"slots": 2}, {"slots": 2}])
+    gw = Gateway(tmp_path / "gw", table2, svc_kw=svc_kw)
+    for key, ntoa, seed in (("ga", 24, 0), ("gb", 44, 2)):
+        body = json.dumps({
+            "dedupe_key": key, "niter": NITER,
+            "payload": {"synthetic": {
+                "n_psr": 2, "ntoa": ntoa, "tm_cols": 3, "seed": seed,
+                "nmodes": 3}}}).encode()
+        resp = gw.handle(WireRequest("POST", "/v1/jobs", {}, {}, body))
+        assert resp.status == 200
+    # never started: both entries journaled active — the restart sees
+    # only the journal, exactly the crashed-scheduler window
+    gw2 = Gateway(tmp_path / "gw", table2, svc_kw=svc_kw,
+                  stop_when_idle=True)
+    assert len(gw2.svc.jobs) == 2                # both re-materialized
+    gw2.start()
+    gw2.join(timeout=300)
+    ents = gw2.report()["entries"]
+    assert {e["state"] for e in ents.values()} == {"done"}
+    pl = gw2.report()["service"]["placement"]
+    assert pl["max_concurrent_groups"] >= 2
+    assert len(pl["groups"]) == 2

@@ -30,6 +30,18 @@ standing-model append/migration drill (``_append_drill``) — a kill at
 any migration seam must recover to the parent or the child generation,
 never a torn hybrid, with co-residents bitwise untouched.
 
+A multigroup leg then runs in EVERY mode (including --quick): two
+``(bucket, signature)`` groups resident concurrently on disjoint
+placement slices, with every seeded fault (device loss, poison, crash,
+storm) aimed at slice 0 only.  The co-resident group on slice 1 must
+finish bitwise vs its solo baseline — the fault-domain claim of the
+placement engine — with per-slice loss counters confirming the blast
+radius never crossed the slice boundary, ≥2 groups concurrently
+resident, and pre-warming under a storm capped so it never starves a
+resident.  A final multigroup gateway drill kills the scheduler with
+two groups journaled and requires the restarted incarnation to re-route
+each group to its own slice and finish both bitwise with zero orphans.
+
 Invariants checked after EVERY seed:
 
 1. every job reaches ``done`` and its chain/bchain is bitwise equal to
@@ -783,6 +795,345 @@ def _append_drill(root, cache):
     return fails
 
 
+MG_GROUP_A = ((24, 0), (28, 1))    # first bucket's group (slice 0)
+MG_GROUP_B = ((44, 2), (46, 3))    # second bucket's group (slice 1)
+MG_STORM = (52, 9)                 # third, cold bucket (storm/pre-warm)
+
+
+def _mg_table():
+    from pulsar_timing_gibbsspec_tpu.serve.buckets import (BucketSpec,
+                                                           BucketTable)
+
+    return BucketTable([BucketSpec(2, 40, 24, 3),
+                        BucketSpec(2, 48, 24, 3),
+                        BucketSpec(2, 56, 24, 3)])
+
+
+def _mg_service(root, cache, **kw):
+    """Two-slice placement service (two slots each, unplaced — bitwise
+    holds regardless of slot geometry, so solo baselines and seeded
+    runs compare exactly)."""
+    from pulsar_timing_gibbsspec_tpu.serve import SamplerService
+
+    kw.setdefault("chunk", 4)
+    kw.setdefault("quantum", 100)
+    kw.setdefault("save_every", 1)
+    kw.setdefault("placement", [{"slots": 2}, {"slots": 2}])
+    return SamplerService(root, _mg_table(), cache=cache, **kw)
+
+
+def _mg_models():
+    from pulsar_timing_gibbsspec_tpu.analysis.jaxprcheck.entries import (
+        build_model, synthetic_pulsars)
+
+    def mk(ntoa, seed):
+        return build_model(
+            synthetic_pulsars(2, ntoa, tm_cols=3, seed=seed), 3)
+
+    return ([mk(*t) for t in MG_GROUP_A],
+            [mk(*t) for t in MG_GROUP_B], mk(*MG_STORM))
+
+
+def _mg_solos(root, cache, ptas_a, ptas_b, storm_pta):
+    """Solo baselines in the SAME two-slice geometry (shares the
+    slots=2 multiplexed programs with every seeded run)."""
+    out = {}
+    pairs = list(zip(ptas_a, MG_GROUP_A)) + list(zip(ptas_b, MG_GROUP_B))
+    pairs.append((storm_pta, MG_STORM))
+    for pta, (_, tenant) in pairs:
+        svc = _mg_service(root / f"mgsolo{tenant}", cache)
+        job = svc.submit(pta, NITER, job_id=f"mgsolo{tenant}",
+                         tenant_id=tenant)
+        svc.run()
+        if job.state != "done":
+            raise RuntimeError(
+                f"multigroup solo baseline (tenant {tenant}) failed: "
+                f"{job.failure}")
+        out[tenant] = (job.chain.copy(), job.bchain.copy())
+    return out
+
+
+def _mg_schedule(rng, quick):
+    """A seeded fault draw targeting SLICE 0 (group A) while group B is
+    co-resident on slice 1.  Bounded like :func:`_draw_schedule`: one
+    slice-targeted device loss max (replace budget), one poison per
+    victim, retryable crashes within the service budget."""
+    kinds = ["device_loss", "poison", "crash", "storm"]
+    n = 1 if quick else int(rng.integers(1, 3))
+    sched, lost, crashed, poisoned = [], 0, 0, set()
+    for _ in range(n):
+        kind = str(rng.choice(kinds))
+        if kind == "device_loss" and lost >= 1:
+            kind = "poison"
+        if kind == "crash" and crashed >= 2:
+            kind = "poison"
+        tenant = int(rng.choice([t for _, t in MG_GROUP_A]))
+        if kind == "poison" and tenant in poisoned:
+            kind = "crash" if crashed < 2 else "storm"
+        at = int(rng.integers(1, 3))
+        if kind == "device_loss":
+            lost += 1
+        elif kind == "crash":
+            crashed += 1
+        elif kind == "poison":
+            poisoned.add(tenant)
+        sched.append((kind, {"tenant": tenant, "at": at}))
+    return sched
+
+
+def _mg_arm(sched):
+    """Arm a multigroup schedule; device losses carry ``slice=0`` so
+    only group A's fault domain evacuates."""
+    from pulsar_timing_gibbsspec_tpu.runtime import faults
+
+    handles = []
+    for kind, kw in sched:
+        if kind == "device_loss":
+            handles.append(faults.inject(
+                "device_loss", point="serve.chunk",
+                at_row=kw["at"] + 1, times=1, slice=0))
+        elif kind == "poison":
+            handles.append(faults.inject(
+                "poison_rows", tenant=kw["tenant"],
+                at_row=kw["at"], times=1))
+        elif kind == "crash":
+            handles.append(faults.inject(
+                "crash", point="serve.chunk", at_row=kw["at"] + 1,
+                times=1))
+        else:
+            handles.append(None)      # storm: no registry entry
+    return handles
+
+
+def _run_mg_seed(seed, args, root, cache, ptas_a, ptas_b, storm_pta,
+                 solos):
+    """One seeded multigroup drill: group A on slice 0, group B on
+    slice 1, faults aimed at slice 0 only.  Invariants: every job done
+    and bitwise vs its solo (group B's bitwise equality IS the
+    fault-domain proof), zero unplanned steady retraces, counters
+    consistent, ≥2 groups were concurrently resident, pre-warming under
+    a storm never blocked a resident step (the storm tenant completes
+    and the prewarm counter respects its cap)."""
+    from pulsar_timing_gibbsspec_tpu.profiling import recompile_counter
+    from pulsar_timing_gibbsspec_tpu.runtime import faults
+
+    rng = np.random.default_rng([args.campaign_seed, 1000 + seed])
+    sched = _mg_schedule(rng, args.quick)
+    with_storm = any(k == "storm" for k, _ in sched)
+    fails = []
+
+    kw = {}
+    if with_storm:
+        kw["admission"] = {"max_queue": 16, "storm_compiles": 1,
+                           "storm_window_s": 0.1}
+        kw["prewarm"] = 1
+    svc = _mg_service(root / f"mg{seed}", cache, **kw)
+    faults.clear()
+    handles = _mg_arm(sched)
+    jobs = []
+    try:
+        with recompile_counter() as rc:
+            rc.phase("steady")
+            # submission order pins the slice assignment: group A
+            # claims slice 0, group B slice 1
+            for pta, (_, tenant) in zip(ptas_a, MG_GROUP_A):
+                jobs.append(svc.submit(pta, NITER,
+                                       job_id=f"mga{tenant}",
+                                       tenant_id=tenant))
+            for pta, (_, tenant) in zip(ptas_b, MG_GROUP_B):
+                jobs.append(svc.submit(pta, NITER,
+                                       job_id=f"mgb{tenant}",
+                                       tenant_id=tenant))
+            if with_storm:
+                jobs.append(svc.submit(storm_pta, NITER,
+                                       job_id="mgstorm",
+                                       tenant_id=MG_STORM[1]))
+            report = svc.run()
+    except Exception as exc:                      # noqa: BLE001
+        faults.clear()
+        return {"seed": seed, "leg": "multigroup", "schedule": sched,
+                "error": repr(exc)}, \
+            [f"mg seed {seed}: run raised {exc!r}"]
+    finally:
+        faults.clear()
+
+    # completion + bitwise isolation for EVERY tenant; group B's
+    # equality while slice 0 took the faults is the fault-domain claim
+    for job in jobs:
+        if job.state != "done":
+            fails.append(f"mg seed {seed}: {job.job_id} "
+                         f"state={job.state!r} ({job.failure})")
+            continue
+        ref_c, ref_b = solos[int(job.tenant_id)]
+        if not (np.array_equal(job.chain, ref_c)
+                and np.array_equal(job.bchain, ref_b)):
+            fails.append(f"mg seed {seed}: {job.job_id} diverged from "
+                         "its solo baseline (cross-slice blast radius)")
+
+    fired_poison = [kw_ for (k, kw_), h in zip(sched, handles)
+                    if k == "poison" and h is not None and h.fired]
+    qlog = report["quarantine_log"]
+    if len(qlog) != len(fired_poison):
+        fails.append(f"mg seed {seed}: {len(fired_poison)} poison(s) "
+                     f"fired but {len(qlog)} quarantine(s) logged")
+    n_loss = sum(1 for (k, _), h in zip(sched, handles)
+                 if k == "device_loss" and h is not None and h.fired)
+    if report["evacuations"] != n_loss:
+        fails.append(f"mg seed {seed}: evacuations "
+                     f"{report['evacuations']} != injected slice "
+                     f"losses {n_loss}")
+    pl = report["placement"]
+    losses0 = next(s["losses"] for s in pl["slices"] if s["slice"] == 0)
+    losses1 = next(s["losses"] for s in pl["slices"] if s["slice"] == 1)
+    if losses0 != n_loss or losses1 != 0:
+        fails.append(f"mg seed {seed}: per-slice losses ({losses0}, "
+                     f"{losses1}) != ({n_loss}, 0) — the loss was not "
+                     "confined to its fault domain")
+    if pl["max_concurrent_groups"] < 2:
+        fails.append(f"mg seed {seed}: max_concurrent_groups "
+                     f"{pl['max_concurrent_groups']} < 2 — groups were "
+                     "serialized")
+    if with_storm and pl["prewarms"] > 1:
+        fails.append(f"mg seed {seed}: prewarms {pl['prewarms']} "
+                     "exceeded the cap")
+    unplanned = rc.unplanned("steady")
+    if unplanned:
+        fails.append(f"mg seed {seed}: {unplanned} unplanned steady "
+                     "retrace(s)")
+    if svc.queue:
+        fails.append(f"mg seed {seed}: queue not drained "
+                     f"({len(svc.queue)} left)")
+
+    rec = {"seed": seed, "leg": "multigroup", "schedule": sched,
+           "quarantines": report["quarantines"],
+           "evacuations": report["evacuations"],
+           "max_concurrent_groups": pl["max_concurrent_groups"],
+           "prewarms": pl["prewarms"],
+           "unplanned_retraces": unplanned, "ok": not fails}
+    return rec, fails
+
+
+def _mg_gateway_drill(root, cache):
+    """Gateway restart with TWO groups journaled: both jobs (different
+    buckets) sample concurrently on their own slices, the gateway is
+    killed mid-run, and the restarted incarnation re-materializes both
+    from the journal — each re-routed to its own group's slice (no
+    'global active group' to misroute to), both finishing bitwise with
+    zero orphaned journal entries and zero unplanned steady retraces."""
+    from pulsar_timing_gibbsspec_tpu.profiling import recompile_counter
+    from pulsar_timing_gibbsspec_tpu.runtime import faults, preemption
+    from pulsar_timing_gibbsspec_tpu.serve.gateway import Gateway
+    from pulsar_timing_gibbsspec_tpu.serve.wire import WireRequest
+    import time
+
+    from pulsar_timing_gibbsspec_tpu.analysis.jaxprcheck.entries import (
+        build_model, synthetic_pulsars)
+
+    fails = []
+    gniter = 4 * NITER
+    svc_kw = dict(chunk=4, quantum=100, save_every=1, cache=cache,
+                  placement=[{"slots": 2}, {"slots": 2}])
+    pay_a = {"synthetic": {"n_psr": 2, "ntoa": 24, "tm_cols": 3,
+                           "seed": 0, "nmodes": 3}}
+    pay_b = {"synthetic": {"n_psr": 2, "ntoa": 44, "tm_cols": 3,
+                           "seed": 2, "nmodes": 3}}
+
+    def post(gw, path, body):
+        resp = gw.handle(WireRequest("POST", path, {}, {},
+                                     json.dumps(body).encode()))
+        return resp.status, resp.body or {}
+
+    # solo ground truth (the gateway assigns tenants 0, 1 in
+    # submission order; streams are pure in the tenant identity)
+    solos = {}
+    for tenant, (ntoa, dseed) in ((0, (24, 0)), (1, (44, 2))):
+        pta = build_model(
+            synthetic_pulsars(2, ntoa, tm_cols=3, seed=dseed), 3)
+        svc = _mg_service(root / f"mggwsolo{tenant}", cache)
+        job = svc.submit(pta, gniter, job_id=f"mggwsolo{tenant}",
+                         tenant_id=tenant)
+        svc.run()
+        if job.state != "done":
+            return [f"mg gateway: solo baseline {tenant} failed "
+                    f"({job.failure})"]
+        solos[tenant] = job.chain.copy()
+
+    preemption.reset()
+    faults.clear()
+    try:
+        with recompile_counter() as rc:
+            rc.phase("steady")
+            r = root / "mggw"
+            gw = Gateway(r, _mg_table(), svc_kw=svc_kw,
+                         stop_when_idle=False).start()
+            st, ha = post(gw, "/v1/jobs", {
+                "dedupe_key": "mga", "payload": pay_a, "niter": gniter})
+            st2, hb = post(gw, "/v1/jobs", {
+                "dedupe_key": "mgb", "payload": pay_b, "niter": gniter})
+            if st != 200 or st2 != 200:
+                fails.append(f"mg gateway: submits HTTP {st}/{st2}")
+            # wait until BOTH groups are concurrently resident, then
+            # kill the scheduler with no goodbye
+            t0 = time.monotonic()
+            while time.monotonic() - t0 < 30:
+                summ = gw.svc.placement_summary()
+                if sum(1 for s in summ if s["residents"]) >= 2:
+                    break
+                time.sleep(0.02)
+            else:
+                fails.append("mg gateway: two groups never became "
+                             "concurrently resident")
+            faults.inject("gateway_kill", point="gateway.step",
+                          at_row=gw._steps + 2, times=1)
+            t0 = time.monotonic()
+            while gw.alive() and time.monotonic() - t0 < 30:
+                time.sleep(0.02)
+            if gw.alive():
+                fails.append("mg gateway: injected kill did not stop "
+                             "the scheduler")
+
+            # restart: both journaled groups re-materialize, each onto
+            # its own slice
+            gw2 = Gateway(r, _mg_table(), svc_kw=svc_kw,
+                          stop_when_idle=False).start()
+            t0 = time.monotonic()
+            while time.monotonic() - t0 < 60 and not gw2._all_settled():
+                time.sleep(0.05)
+            ents = gw2.report()["entries"]
+            bad = {k: e["state"] for k, e in ents.items()
+                   if e["state"] != "done"}
+            if bad:
+                fails.append(f"mg gateway: orphaned journal entries "
+                             f"after restart: {bad}")
+            groups = [s["group"] for s in gw2.report()["service"]
+                      ["placement"]["slices"]]
+            for key, tenant in (("mga", 0), ("mgb", 1)):
+                ent = ents.get(key)
+                if ent is None:
+                    continue
+                chain = np.load(Path(ent["outdir"]) / "chain.npy")
+                if not np.array_equal(chain, solos[tenant]):
+                    fails.append(f"mg gateway: {key} not bitwise vs "
+                                 "its solo after the restart")
+            _ = groups
+            if gw2.svc.queue:
+                fails.append(f"mg gateway: queue not drained "
+                             f"({len(gw2.svc.queue)} left)")
+            preemption.request_drain(reason="mg_gateway_teardown")
+            gw2.join(timeout=30)
+            if gw2.alive() or gw2.state != "stopped":
+                fails.append("mg gateway: graceful drain did not park "
+                             f"the scheduler (state {gw2.state!r})")
+        unplanned = rc.unplanned("steady")
+        if unplanned:
+            fails.append(f"mg gateway: {unplanned} unplanned steady "
+                         "retrace(s) across the restart")
+    finally:
+        faults.clear()
+        preemption.reset()
+    return fails
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         description="seeded chaos campaign over the serving tier")
@@ -855,6 +1206,31 @@ def main(argv=None):
     records.append({"leg": "append", "failures": ap_fails})
     print(f"[campaign] append {'ok' if not ap_fails else 'FAIL'}",
           flush=True)
+
+    # multigroup leg: faults aimed at one slice while a second group
+    # is co-resident — the survivor's bitwise equality is the
+    # fault-domain claim.  Runs in every mode (incl. --quick).
+    mg_cache = ProgramCache()
+    ptas_a, ptas_b, mg_storm_pta = _mg_models()
+    print("[campaign] multigroup leg: building solo baselines ...",
+          flush=True)
+    mg_solos = _mg_solos(root, mg_cache, ptas_a, ptas_b, mg_storm_pta)
+    for seed in range(args.seeds):
+        rec, fails = _run_mg_seed(seed, args, root, mg_cache, ptas_a,
+                                  ptas_b, mg_storm_pta, mg_solos)
+        records.append(rec)
+        failures.extend(fails)
+        tag = "ok" if not fails else "FAIL"
+        kinds = [k for k, _ in rec.get("schedule", [])]
+        print(f"[campaign] mg seed {seed:3d} {tag:4s} faults={kinds}",
+              flush=True)
+    print("[campaign] multigroup gateway leg: two groups journaled, "
+          "kill/restart ...", flush=True)
+    mg_gw_fails = _mg_gateway_drill(root, mg_cache)
+    failures.extend(mg_gw_fails)
+    records.append({"leg": "mg_gateway", "failures": mg_gw_fails})
+    print(f"[campaign] mg gateway "
+          f"{'ok' if not mg_gw_fails else 'FAIL'}", flush=True)
 
     report = {"seeds": args.seeds, "quick": bool(args.quick),
               "campaign_seed": args.campaign_seed,

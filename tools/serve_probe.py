@@ -18,8 +18,16 @@ warm-hit scrape all travel HTTP (``serve.gateway`` over
 ``serve.wire.HttpTransport``), so the probe proves the transport
 frontend does not cost a single retrace or a warm miss.
 
+``--multigroup`` submits ``--jobs`` analyses in EACH of two buckets and
+requires both groups to reach a warm steady state concurrently on
+their own placement slices: per-group ``warm_hit_rate`` ≥
+``(jobs - 1) / jobs``, ``max_concurrent_groups`` ≥ 2 (no cross-group
+drain waits), and the slice-labeled ``serve_slice_*`` fault-domain
+gauges present in the Prometheus scrape.
+
 Usage: python tools/serve_probe.py [--jobs N] [--niter N] [--slots N]
        [--chunk N] [--quantum N] [--outdir DIR] [--gateway]
+       [--multigroup]
 """
 
 from __future__ import annotations
@@ -146,6 +154,95 @@ def _gateway_probe(args):
         sys.exit(1)
 
 
+def _multigroup_probe(args):
+    """Drive TWO ``(bucket, signature)`` groups concurrently on their
+    own placement slices and hold the placement contract: both groups
+    reach a warm steady state (per-group ``warm_hit_rate`` ≥
+    ``(jobs - 1) / jobs``), ≥2 groups were concurrently resident (no
+    cross-group drain waits — pre-placement, a second bucket had to
+    wait for the active group to drain), zero unplanned serve-phase
+    retraces, and the slice-labeled ``serve_slice_*`` gauges flow
+    through the Prometheus exposition."""
+    from pulsar_timing_gibbsspec_tpu.analysis.jaxprcheck.entries import (
+        build_model, synthetic_pulsars)
+    from pulsar_timing_gibbsspec_tpu.profiling import recompile_counter
+    from pulsar_timing_gibbsspec_tpu.runtime import telemetry
+    from pulsar_timing_gibbsspec_tpu.serve import (
+        BucketTable, SamplerService, probe_shape)
+
+    base = Path(args.outdir)
+    if base.exists():
+        shutil.rmtree(base)
+
+    # two TOA rungs of one ladder: group A fills the first bucket,
+    # group B sits strictly inside the second (past the first), so
+    # route_pta keeps the groups on their own buckets
+    toas_a = 24 + 6 * args.jobs
+    ptas_a = [build_model(
+        synthetic_pulsars(args.n_psr, 24 + 6 * i, tm_cols=3, seed=i),
+        args.nmodes) for i in range(args.jobs)]
+    ptas_b = [build_model(
+        synthetic_pulsars(args.n_psr, toas_a + 2 + 6 * i, tm_cols=3,
+                          seed=10 + i),
+        args.nmodes) for i in range(args.jobs)]
+    basis = probe_shape(ptas_a[0]).basis   # same structure, same basis
+    table = BucketTable.ladder(
+        args.nmodes, pulsars=(args.n_psr,),
+        toas=(toas_a, toas_a + 2 + 6 * args.jobs),
+        basis=(basis, basis))
+
+    telemetry.reset()
+    svc = SamplerService(
+        base, table, chunk=args.chunk, quantum=args.quantum,
+        placement=[{"slots": args.slots}, {"slots": args.slots}])
+    with recompile_counter() as rc:
+        rc.phase("serve")
+        jobs = [svc.submit(pta, args.niter, tenant_id=i)
+                for i, pta in enumerate(ptas_a + ptas_b)]
+        t0 = time.monotonic()
+        report = svc.run()
+        wall = time.monotonic() - t0
+
+    scrape = svc.prometheus()
+    slice_series = sorted(
+        line.split()[0] for line in scrape.splitlines()
+        if line.startswith("ptgibbs_serve_slice_"))
+    pl = report["placement"]
+    total_rows = sum(j.it for j in jobs)
+    report["aggregate_samples_per_s"] = total_rows / wall if wall else None
+    report["wall_s"] = wall
+    report["unplanned_serve_retraces"] = rc.unplanned("serve")
+    report["slice_series"] = slice_series
+    print(json.dumps(report, indent=2))
+
+    bar = (args.jobs - 1) / args.jobs
+    group_ok = (len(pl["groups"]) >= 2
+                and all(g["warm_hit_rate"] >= bar
+                        for g in pl["groups"].values()))
+    wanted = {f'ptgibbs_serve_slice_{n}{{slice="{s}"}}'
+              for n in ("residents", "chunks", "losses")
+              for s in ("0", "1")}
+    ok = (all(j.state == "done" for j in jobs)
+          and rc.unplanned("serve") == 0
+          and group_ok
+          and pl["max_concurrent_groups"] >= 2
+          and wanted.issubset(set(slice_series)))
+    if not ok:
+        print("FAIL: multigroup placement contract violated",
+              file=sys.stderr)
+        if not group_ok:
+            print(f"  per-group warmth below {bar}: {pl['groups']}",
+                  file=sys.stderr)
+        if pl["max_concurrent_groups"] < 2:
+            print("  groups were serialized (cross-group drain wait)",
+                  file=sys.stderr)
+        missing = wanted - set(slice_series)
+        if missing:
+            print(f"  slice series missing from the scrape: {missing}",
+                  file=sys.stderr)
+        sys.exit(1)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--jobs", type=int, default=3,
@@ -162,10 +259,17 @@ def main():
     ap.add_argument("--gateway", action="store_true",
                     help="drive the same assertions through the HTTP "
                     "gateway instead of the in-process API")
+    ap.add_argument("--multigroup", action="store_true",
+                    help="drive --jobs analyses in EACH of two buckets "
+                    "concurrently on two placement slices and assert "
+                    "per-group warm steady state with no cross-group "
+                    "drain waits")
     args = ap.parse_args()
 
     if args.gateway:
         return _gateway_probe(args)
+    if args.multigroup:
+        return _multigroup_probe(args)
 
     from pulsar_timing_gibbsspec_tpu.analysis.jaxprcheck.entries import (
         build_model, synthetic_pulsars)
